@@ -1,0 +1,79 @@
+"""Flatten/inflate semantics, incl. hostile keys (reference
+tests/test_flatten.py:15-29)."""
+
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.flatten import flatten, inflate
+from torchsnapshot_tpu.manifest import DictEntry, ListEntry, OrderedDictEntry
+
+
+def test_roundtrip_nested():
+    state = {
+        "model": OrderedDict(
+            [("w", np.arange(6).reshape(2, 3)), ("b", np.zeros(3))]
+        ),
+        "step": 7,
+        "history": [1.0, 2.0, {"nested": "x"}],
+        "opts": {"lr": 0.1, "betas": (0.9, 0.999)},
+    }
+    manifest, flattened = flatten(state)
+    rebuilt = inflate(manifest, flattened)
+    assert rebuilt["step"] == 7
+    assert isinstance(rebuilt["model"], OrderedDict)
+    assert list(rebuilt["model"].keys()) == ["w", "b"]
+    np.testing.assert_array_equal(rebuilt["model"]["w"], state["model"]["w"])
+    assert rebuilt["history"][2]["nested"] == "x"
+    assert rebuilt["opts"]["betas"] == (0.9, 0.999)
+    assert isinstance(rebuilt["opts"]["betas"], tuple)
+
+
+def test_hostile_keys():
+    state = {"a/b": 1, "a%b": 2, "a%2Fb": 3, "": 4}
+    manifest, flattened = flatten(state, prefix="st")
+    # All four leaves must survive escaping without collision
+    assert len(flattened) == 4
+    rebuilt = inflate(manifest, flattened, prefix="st")
+    assert rebuilt == state
+
+
+def test_int_keys_roundtrip():
+    state = {"d": {0: "a", 1: "b", "2": "c"}}
+    manifest, flattened = flatten(state)
+    rebuilt = inflate(manifest, flattened)
+    assert rebuilt == state
+    assert set(rebuilt["d"].keys()) == {0, 1, "2"}
+
+
+def test_colliding_keys_kept_opaque():
+    # str(1) == "1" collides with key "1": the dict must stay a single leaf
+    state = {"d": {1: "a", "1": "b"}}
+    manifest, flattened = flatten(state)
+    assert "d" in flattened
+    assert flattened["d"] == {1: "a", "1": "b"}
+    rebuilt = inflate(manifest, flattened)
+    assert rebuilt == state
+
+
+def test_non_str_int_keys_kept_opaque():
+    state = {"d": {(1, 2): "a"}}
+    manifest, flattened = flatten(state)
+    assert flattened["d"] == {(1, 2): "a"}
+
+
+def test_prefix():
+    manifest, flattened = flatten({"x": 1}, prefix="my_stateful")
+    assert "my_stateful" in manifest
+    assert isinstance(manifest["my_stateful"], DictEntry)
+    assert flattened == {"my_stateful/x": 1}
+    rebuilt = inflate(manifest, flattened, prefix="my_stateful")
+    assert rebuilt == {"x": 1}
+
+
+def test_list_order_preserved_beyond_ten():
+    state = {"l": list(range(15))}
+    manifest, flattened = flatten(state)
+    rebuilt = inflate(manifest, flattened)
+    assert rebuilt["l"] == list(range(15))

@@ -1,0 +1,114 @@
+"""Manifest entry + metadata round-trip tests (reference
+tests/test_manifest.py:638-702)."""
+
+import json
+
+from torchsnapshot_tpu.manifest import (
+    Chunk,
+    ChunkedTensorEntry,
+    DictEntry,
+    ListEntry,
+    ObjectEntry,
+    OrderedDictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    TensorEntry,
+    TupleEntry,
+)
+
+
+def _sample_manifest():
+    return {
+        "0/model": DictEntry(keys=["w", "meta", 3]),
+        "0/model/w": TensorEntry(
+            location="0/model/w",
+            serializer="buffer_protocol",
+            dtype="bfloat16",
+            shape=[128, 256],
+            replicated=False,
+            byte_range=[0, 65536],
+        ),
+        "0/model/sharded": ShardedArrayEntry(
+            dtype="float32",
+            shape=[1024, 512],
+            shards=[
+                Shard(
+                    offsets=[0, 0],
+                    sizes=[512, 512],
+                    tensor=TensorEntry(
+                        location="sharded/model/sharded.0",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[512, 512],
+                        replicated=False,
+                    ),
+                ),
+                Shard(
+                    offsets=[512, 0],
+                    sizes=[512, 512],
+                    tensor=TensorEntry(
+                        location="sharded/model/sharded.1",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[512, 512],
+                        replicated=False,
+                    ),
+                ),
+            ],
+            mesh_shape=[2, 4],
+            axis_names=["data", "model"],
+            partition_spec=[["data"], []],
+        ),
+        "0/model/big": ChunkedTensorEntry(
+            dtype="float32",
+            shape=[4096, 128],
+            chunks=[
+                Chunk(offsets=[0, 0], sizes=[2048, 128], dtype="float32"),
+                Chunk(offsets=[2048, 0], sizes=[2048, 128], dtype="float32"),
+            ],
+            replicated=True,
+        ),
+        "0/extra": ObjectEntry(
+            location="0/extra", serializer="pickle", obj_type="MyThing", replicated=False
+        ),
+        "0/lst": ListEntry(),
+        "0/tup": TupleEntry(),
+        "0/od": OrderedDictEntry(keys=["a", "b"]),
+        "0/step": PrimitiveEntry.from_object(1234),
+        "0/lr": PrimitiveEntry.from_object(0.30000000000000004),
+        "0/name": PrimitiveEntry.from_object("run-1"),
+        "0/flag": PrimitiveEntry.from_object(True),
+        "0/blob": PrimitiveEntry.from_object(b"\x00\xff"),
+    }
+
+
+def test_metadata_json_roundtrip():
+    md = SnapshotMetadata(version="0.1.0", world_size=8, manifest=_sample_manifest())
+    s = md.to_json()
+    json.loads(s)  # must be valid JSON
+    md2 = SnapshotMetadata.from_json(s)
+    assert md2.version == md.version
+    assert md2.world_size == 8
+    assert md2.manifest == md.manifest
+    # second round-trip is byte-stable
+    assert md2.to_json() == s
+
+
+def test_primitive_exact_float():
+    e = PrimitiveEntry.from_object(0.1 + 0.2)
+    assert e.get_value() == 0.1 + 0.2  # bit-exact via packed double
+
+
+def test_primitive_values():
+    assert PrimitiveEntry.from_object(True).get_value() is True
+    assert PrimitiveEntry.from_object(False).get_value() is False
+    assert PrimitiveEntry.from_object(-17).get_value() == -17
+    assert PrimitiveEntry.from_object("x/y").get_value() == "x/y"
+    assert PrimitiveEntry.from_object(b"abc").get_value() == b"abc"
+
+
+def test_yaml_alias():
+    md = SnapshotMetadata(version="0.1.0", world_size=1, manifest={})
+    assert SnapshotMetadata.from_yaml(md.to_yaml()) == md

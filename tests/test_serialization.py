@@ -1,0 +1,86 @@
+"""Per-dtype codec round-trips (reference tests/test_serialization.py:26-33)."""
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.serialization import (
+    array_as_memoryview,
+    array_from_memoryview,
+    array_nbytes,
+    dtype_to_string,
+    pickle_load_from_bytes,
+    pickle_save_as_bytes,
+    string_to_dtype,
+    supports_buffer_protocol,
+)
+
+ALL_DTYPES = [
+    np.float64,
+    np.float32,
+    np.float16,
+    ml_dtypes.bfloat16,
+    ml_dtypes.float8_e4m3fn,
+    ml_dtypes.float8_e5m2,
+    np.complex64,
+    np.complex128,
+    np.int64,
+    np.int32,
+    np.int16,
+    np.int8,
+    np.uint8,
+    np.uint16,
+    np.uint32,
+    np.uint64,
+    np.bool_,
+]
+
+
+@pytest.mark.parametrize("dtype", ALL_DTYPES, ids=lambda d: np.dtype(d).name)
+def test_buffer_protocol_roundtrip(dtype):
+    rng = np.random.RandomState(0)
+    arr = rng.uniform(-4, 4, size=(16, 7)).astype(dtype)
+    mv = array_as_memoryview(arr)
+    s = dtype_to_string(dtype)
+    assert mv.nbytes == array_nbytes([16, 7], s)
+    out = array_from_memoryview(mv, s, [16, 7])
+    assert out.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
+def test_zero_copy():
+    arr = np.arange(8, dtype=np.float32)
+    mv = array_as_memoryview(arr)
+    arr[0] = 42.0
+    assert np.frombuffer(mv, dtype=np.float32)[0] == 42.0
+
+
+def test_bfloat16_zero_copy():
+    arr = np.ones(8, dtype=ml_dtypes.bfloat16)
+    mv = array_as_memoryview(arr)
+    arr[0] = ml_dtypes.bfloat16(3.0)
+    out = array_from_memoryview(mv, "bfloat16", [8])
+    assert float(out[0]) == 3.0
+
+
+def test_dtype_registry_roundtrip():
+    for dtype in ALL_DTYPES:
+        s = dtype_to_string(dtype)
+        assert string_to_dtype(s) == np.dtype(dtype)
+        assert supports_buffer_protocol(dtype)
+
+
+def test_pickle_fallback():
+    obj = {"a": [1, 2, 3], "b": ("x", None)}
+    assert pickle_load_from_bytes(pickle_save_as_bytes(obj)) == obj
+
+
+def test_jax_array_to_host_codec():
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4)
+    host = np.asarray(x)
+    mv = array_as_memoryview(host)
+    out = array_from_memoryview(mv, "bfloat16", [3, 4])
+    np.testing.assert_array_equal(np.asarray(out), host)

@@ -1,0 +1,114 @@
+"""Core I/O contracts: write/read requests, stagers, consumers, storage ABC.
+
+TPU-native analogue of the reference's ``torchsnapshot/io_types.py``
+(/root/reference/torchsnapshot/io_types.py:24-120).  The shapes are the same
+because they are device-agnostic: a ``WriteReq`` pairs a storage path with a
+``BufferStager`` that produces host bytes (for us: async HBM→host DMA via
+pjrt, then a zero-copy view); a ``ReadReq`` pairs a path + byte range with a
+``BufferConsumer`` that scatters bytes into the restore target.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Generic, List, Optional, TypeVar
+
+BufferType = Any  # bytes | bytearray | memoryview
+
+T = TypeVar("T")
+
+
+class Future(Generic[T]):
+    """Holds a value produced during read consumption (reference
+    io_types.py:24-30)."""
+
+    def __init__(self, obj: Optional[T] = None) -> None:
+        self.obj = obj
+
+
+@dataclass
+class WriteIO:
+    path: str
+    buf: BufferType
+
+
+@dataclass
+class ReadIO:
+    path: str
+    byte_range: Optional[List[int]] = None
+    buf: Optional[bytearray] = None
+
+
+class BufferStager(abc.ABC):
+    """Produces the host buffer for one write (reference io_types.py:36-50)."""
+
+    @abc.abstractmethod
+    async def stage_buffer(self, executor: Any = None) -> BufferType:
+        ...
+
+    @abc.abstractmethod
+    def get_staging_cost_bytes(self) -> int:
+        """Peak transient host memory needed to stage (admission control)."""
+        ...
+
+
+@dataclass
+class WriteReq:
+    path: str
+    buffer_stager: BufferStager
+
+
+class BufferConsumer(abc.ABC):
+    """Consumes the bytes read for one request (reference io_types.py:60-74)."""
+
+    @abc.abstractmethod
+    async def consume_buffer(self, buf: BufferType, executor: Any = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_consuming_cost_bytes(self) -> int:
+        ...
+
+
+@dataclass
+class ReadReq:
+    path: str
+    buffer_consumer: BufferConsumer
+    byte_range: Optional[List[int]] = None
+
+
+class StoragePlugin(abc.ABC):
+    """Async storage backend contract (reference io_types.py:80-120)."""
+
+    @abc.abstractmethod
+    async def write(self, write_io: WriteIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def read(self, read_io: ReadIO) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete(self, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def delete_dir(self, path: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    async def close(self) -> None:
+        ...
+
+    # Sync conveniences (reference io_types.py:101-120); run a private loop so
+    # they are safe to call from any thread.
+    def sync_write(self, write_io: WriteIO) -> None:
+        asyncio.run(self.write(write_io))
+
+    def sync_read(self, read_io: ReadIO) -> None:
+        asyncio.run(self.read(read_io))
+
+    def sync_close(self) -> None:
+        asyncio.run(self.close())

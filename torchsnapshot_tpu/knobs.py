@@ -1,0 +1,129 @@
+"""Environment-variable configuration knobs.
+
+TPU-native analogue of the reference's ``torchsnapshot/knobs.py`` (see
+/root/reference/torchsnapshot/knobs.py:30-132): every tunable is an env var
+with a context-manager override for tests.  Defaults mirror the reference
+(512 MB max chunk/shard, 128 MB slab threshold, 16 concurrent I/O ops per
+process) because those numbers are storage-side, not device-side.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Generator, Optional
+
+_ENV_PREFIX = "TPUSNAP_"
+
+MAX_CHUNK_SIZE_ENV_VAR = _ENV_PREFIX + "MAX_CHUNK_SIZE_BYTES"
+MAX_SHARD_SIZE_ENV_VAR = _ENV_PREFIX + "MAX_SHARD_SIZE_BYTES"
+SLAB_SIZE_THRESHOLD_ENV_VAR = _ENV_PREFIX + "SLAB_SIZE_THRESHOLD_BYTES"
+MAX_PER_RANK_IO_CONCURRENCY_ENV_VAR = _ENV_PREFIX + "MAX_PER_RANK_IO_CONCURRENCY"
+DISABLE_BATCHING_ENV_VAR = _ENV_PREFIX + "DISABLE_BATCHER"
+PER_RANK_MEMORY_BUDGET_ENV_VAR = _ENV_PREFIX + "PER_RANK_MEMORY_BUDGET_BYTES"
+ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR = (
+    _ENV_PREFIX + "ENABLE_SHARDED_ARRAY_ELASTICITY_ROOT_ONLY"
+)
+
+_DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
+_DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
+_DEFAULT_MAX_PER_RANK_IO_CONCURRENCY = 16
+
+
+def _get_int_env(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return int(val)
+
+
+def _get_bool_env(name: str) -> bool:
+    return os.environ.get(name, "0") not in ("0", "", "false", "False")
+
+
+def get_max_chunk_size_bytes() -> int:
+    return _get_int_env(MAX_CHUNK_SIZE_ENV_VAR, _DEFAULT_MAX_CHUNK_SIZE_BYTES)
+
+
+def get_max_shard_size_bytes() -> int:
+    return _get_int_env(MAX_SHARD_SIZE_ENV_VAR, _DEFAULT_MAX_SHARD_SIZE_BYTES)
+
+
+def get_slab_size_threshold_bytes() -> int:
+    return _get_int_env(
+        SLAB_SIZE_THRESHOLD_ENV_VAR, _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES
+    )
+
+
+def get_max_per_rank_io_concurrency() -> int:
+    return _get_int_env(
+        MAX_PER_RANK_IO_CONCURRENCY_ENV_VAR, _DEFAULT_MAX_PER_RANK_IO_CONCURRENCY
+    )
+
+
+def is_batching_disabled() -> bool:
+    return _get_bool_env(DISABLE_BATCHING_ENV_VAR)
+
+
+def get_per_rank_memory_budget_bytes_override() -> Optional[int]:
+    val = os.environ.get(PER_RANK_MEMORY_BUDGET_ENV_VAR)
+    return int(val) if val is not None else None
+
+
+def is_sharded_elasticity_root_only_enabled() -> bool:
+    return _get_bool_env(ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR)
+
+
+@contextmanager
+def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
+
+
+@contextmanager
+def override_max_chunk_size_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(MAX_CHUNK_SIZE_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_max_shard_size_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(MAX_SHARD_SIZE_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_slab_size_threshold_bytes(value: int) -> Generator[None, None, None]:
+    # Note: the reference's equivalent override sets the wrong env var
+    # (knobs.py:118, a latent bug); this one is correct on purpose.
+    with _override_env(SLAB_SIZE_THRESHOLD_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_max_per_rank_io_concurrency(value: int) -> Generator[None, None, None]:
+    with _override_env(MAX_PER_RANK_IO_CONCURRENCY_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_batching_disabled(disabled: bool) -> Generator[None, None, None]:
+    with _override_env(DISABLE_BATCHING_ENV_VAR, "1" if disabled else None):
+        yield
+
+
+@contextmanager
+def override_per_rank_memory_budget_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(PER_RANK_MEMORY_BUDGET_ENV_VAR, str(value)):
+        yield

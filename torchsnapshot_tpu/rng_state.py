@@ -1,0 +1,66 @@
+"""RNG-state capture for deterministic resume.
+
+TPU-native analogue of the reference's ``torchsnapshot/rng_state.py:15-46``.
+JAX RNG is explicit (``jax.random.key``), so there is no hidden global state
+to snapshot the way ``torch.get_rng_state()`` requires — a user's PRNG key is
+just data in their pytree.  What *does* exist globally is (a) numpy's legacy
+global RNG (used by data pipelines) and (b) Python's ``random``.  RNGState
+captures both, and can optionally carry an explicit JAX key.
+
+Like the reference, Snapshot.take() guarantees taking a snapshot does not
+alter RNG state (reference snapshot.py:538-574); restore leaves the global
+RNGs exactly as saved.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class RNGState:
+    """Stateful capturing python/numpy global RNG state + optional JAX key."""
+
+    def __init__(self, jax_key: Optional[Any] = None) -> None:
+        self._jax_key = jax_key
+
+    def state_dict(self) -> Dict[str, Any]:
+        state: Dict[str, Any] = {
+            "python": random.getstate(),
+            "numpy": np.random.get_state(),
+        }
+        if self._jax_key is not None:
+            import jax
+
+            state["jax_key_data"] = np.asarray(jax.random.key_data(self._jax_key))
+        return state
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        # Leaf containers may come back as lists (manifest round-trip);
+        # random.setstate requires the exact nested tuple shape.
+        py_state = _tuplify(state_dict["python"])
+        random.setstate(py_state)
+        np_state = state_dict["numpy"]
+        if isinstance(np_state, (list, tuple)):
+            np_state = tuple(
+                np.asarray(x) if isinstance(x, np.ndarray) else x for x in np_state
+            )
+        np.random.set_state(np_state)
+        if "jax_key_data" in state_dict:
+            import jax
+
+            self._jax_key = jax.random.wrap_key_data(
+                np.asarray(state_dict["jax_key_data"])
+            )
+
+    @property
+    def jax_key(self) -> Optional[Any]:
+        return self._jax_key
+
+
+def _tuplify(obj: Any) -> Any:
+    if isinstance(obj, (list, tuple)):
+        return tuple(_tuplify(x) for x in obj)
+    return obj

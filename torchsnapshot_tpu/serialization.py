@@ -1,0 +1,144 @@
+"""Zero-copy array <-> bytes codecs and the dtype string registry.
+
+TPU-native analogue of the reference's ``torchsnapshot/serialization.py``
+(/root/reference/torchsnapshot/serialization.py:59-405).  The reference goes
+through numpy's buffer protocol with an UntypedStorage escape hatch for
+bfloat16 (serialization.py:208-230); here bfloat16/fp8 are first-class TPU
+dtypes backed by ``ml_dtypes``, and the escape hatch is a zero-copy
+``view(uint8)`` since numpy's buffer protocol rejects extension dtypes.
+
+All functions operate on **host** numpy arrays; device arrays are staged to
+host by the io_preparer layer (the D2H boundary) before reaching these codecs.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from enum import Enum
+from typing import Any, Dict, List, Tuple
+
+import ml_dtypes
+import numpy as np
+
+
+class Serializer(Enum):
+    BUFFER_PROTOCOL = "buffer_protocol"
+    PICKLE = "pickle"
+
+
+# dtype string registry (reference serialization.py:72-117): stable strings in
+# the manifest, independent of numpy/jax internals.
+_DTYPE_TO_STRING: Dict[Any, str] = {
+    np.dtype(np.float64): "float64",
+    np.dtype(np.float32): "float32",
+    np.dtype(np.float16): "float16",
+    np.dtype(ml_dtypes.bfloat16): "bfloat16",
+    np.dtype(ml_dtypes.float8_e4m3fn): "float8_e4m3fn",
+    np.dtype(ml_dtypes.float8_e5m2): "float8_e5m2",
+    np.dtype(ml_dtypes.float8_e4m3b11fnuz): "float8_e4m3b11fnuz",
+    np.dtype(np.complex64): "complex64",
+    np.dtype(np.complex128): "complex128",
+    np.dtype(np.int64): "int64",
+    np.dtype(np.int32): "int32",
+    np.dtype(np.int16): "int16",
+    np.dtype(np.int8): "int8",
+    np.dtype(np.uint8): "uint8",
+    np.dtype(np.uint16): "uint16",
+    np.dtype(np.uint32): "uint32",
+    np.dtype(np.uint64): "uint64",
+    np.dtype(np.bool_): "bool",
+    np.dtype(ml_dtypes.int4): "int4",
+    np.dtype(ml_dtypes.uint4): "uint4",
+}
+_STRING_TO_DTYPE: Dict[str, Any] = {s: dt for dt, s in _DTYPE_TO_STRING.items()}
+
+# Extension dtypes that numpy's buffer protocol refuses; serialized via a
+# zero-copy uint8 view instead (probe: memoryview(bf16 array) raises).
+_EXTENSION_DTYPES = {
+    np.dtype(ml_dtypes.bfloat16),
+    np.dtype(ml_dtypes.float8_e4m3fn),
+    np.dtype(ml_dtypes.float8_e5m2),
+    np.dtype(ml_dtypes.float8_e4m3b11fnuz),
+    np.dtype(ml_dtypes.int4),
+    np.dtype(ml_dtypes.uint4),
+}
+
+
+def dtype_to_string(dtype: Any) -> str:
+    dt = np.dtype(dtype)
+    try:
+        return _DTYPE_TO_STRING[dt]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype: {dtype}") from None
+
+
+def string_to_dtype(s: str) -> np.dtype:
+    try:
+        return _STRING_TO_DTYPE[s]
+    except KeyError:
+        raise ValueError(f"Unknown dtype string: {s}") from None
+
+
+def dtype_itemsize(s: str) -> float:
+    """Bytes per element; int4/uint4 pack one element per byte in ml_dtypes."""
+    return np.dtype(string_to_dtype(s)).itemsize
+
+
+def per_element_nbytes(dtype_str: str) -> int:
+    return np.dtype(string_to_dtype(dtype_str)).itemsize
+
+
+def array_nbytes(shape: List[int], dtype_str: str) -> int:
+    n = 1
+    for dim in shape:
+        n *= dim
+    return n * per_element_nbytes(dtype_str)
+
+
+def supports_buffer_protocol(dtype: Any) -> bool:
+    """True if the dtype round-trips via the raw-bytes codec (all registry
+    dtypes do — extension dtypes through the uint8-view escape hatch)."""
+    return np.dtype(dtype) in _DTYPE_TO_STRING
+
+
+def array_as_memoryview(arr: np.ndarray) -> memoryview:
+    """Zero-copy view of a host array's bytes (reference
+    ``tensor_as_memoryview``, serialization.py:177-251).
+
+    The array must be C-contiguous; callers stage device arrays into fresh
+    host buffers, which are always contiguous.
+    """
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    if arr.dtype in _EXTENSION_DTYPES:
+        arr = arr.view(np.uint8)
+    return memoryview(arr).cast("B")
+
+
+def array_from_memoryview(
+    mv: memoryview, dtype: str, shape: List[int]
+) -> np.ndarray:
+    """Zero-copy reconstruction (reference ``tensor_from_memoryview``,
+    serialization.py:254-266).  The returned array aliases ``mv``."""
+    np_dtype = string_to_dtype(dtype)
+    return np.frombuffer(mv, dtype=np_dtype).reshape(shape)
+
+
+def pickle_save_as_bytes(obj: Any) -> bytes:
+    """Fallback serializer for opaque objects (reference torch_save_as_bytes,
+    serialization.py:268-271).  Kept off the hot path by the preparer dispatch."""
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def pickle_load_from_bytes(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def cast_copy(src: np.ndarray, dst_dtype: Any) -> np.ndarray:
+    """Dtype-converting copy used when restoring into a differently-typed
+    target (the reference's quantization-aware ``tensor_copy``,
+    io_preparers/tensor.py:385-409, generalized to plain dtype casts)."""
+    return src.astype(np.dtype(dst_dtype))

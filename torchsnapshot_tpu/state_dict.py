@@ -1,0 +1,16 @@
+"""Dict wrapper that satisfies the Stateful protocol (reference
+torchsnapshot/state_dict.py:15-29): lets plain values/pytrees participate in
+app state."""
+
+from __future__ import annotations
+
+from collections import UserDict
+from typing import Any, Dict
+
+
+class StateDict(UserDict):
+    def state_dict(self) -> Dict[str, Any]:
+        return self.data
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        self.data = dict(state_dict)

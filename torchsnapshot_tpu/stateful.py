@@ -1,0 +1,25 @@
+"""The app-state contract (reference torchsnapshot/stateful.py:15-23).
+
+``AppState`` maps names to ``Stateful`` objects: anything with
+``state_dict() -> dict`` and ``load_state_dict(dict)``.  Flax/Optax states are
+plain pytrees; wrap them in :class:`torchsnapshot_tpu.state_dict.StateDict`
+(or use the tricks adapters) to join app state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, runtime_checkable
+
+from typing_extensions import Protocol
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    def state_dict(self) -> Dict[str, Any]:
+        ...
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        ...
+
+
+AppState = Dict[str, Stateful]
