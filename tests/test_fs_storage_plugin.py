@@ -1,0 +1,53 @@
+"""FS plugin round-trip + ranged reads (reference
+tests/test_fs_storage_plugin.py)."""
+
+import asyncio
+
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugins.fs import FSStoragePlugin
+
+
+def test_fs_roundtrip(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    data = bytes(range(256)) * 10
+
+    async def go():
+        await plugin.write(WriteIO(path="a/b/c.bin", buf=data))
+        read_io = ReadIO(path="a/b/c.bin")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == data
+
+        ranged = ReadIO(path="a/b/c.bin", byte_range=[256, 512])
+        await plugin.read(ranged)
+        assert bytes(ranged.buf) == data[256:512]
+
+        await plugin.delete("a/b/c.bin")
+        await plugin.close()
+
+    asyncio.run(go())
+    assert not (tmp_path / "a" / "b" / "c.bin").exists()
+
+
+def test_fs_write_memoryview(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    data = memoryview(b"hello world")
+
+    async def go():
+        await plugin.write(WriteIO(path="mv.bin", buf=data))
+        read_io = ReadIO(path="mv.bin")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == b"hello world"
+        await plugin.close()
+
+    asyncio.run(go())
+
+
+def test_fs_sync_wrappers(tmp_path):
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    plugin.sync_write(WriteIO(path="s.bin", buf=b"sync"))
+    read_io = ReadIO(path="s.bin")
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == b"sync"
+    plugin.sync_close()

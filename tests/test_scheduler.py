@@ -1,0 +1,151 @@
+"""Scheduler admission/budget/pipeline tests (reference scheduler semantics,
+scheduler.py:222-447)."""
+
+import asyncio
+from typing import Optional
+
+import pytest
+
+from torchsnapshot_tpu.io_types import (
+    BufferConsumer,
+    BufferStager,
+    ReadReq,
+    WriteReq,
+)
+from torchsnapshot_tpu.pg_wrapper import PGWrapper
+from torchsnapshot_tpu.scheduler import (
+    get_process_memory_budget_bytes,
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+
+class _TrackingStager(BufferStager):
+    concurrent = 0
+    peak_concurrent = 0
+    peak_outstanding_bytes = 0
+    outstanding_bytes = 0
+
+    def __init__(self, payload: bytes, cost: int):
+        self.payload = payload
+        self.cost = cost
+
+    async def stage_buffer(self, executor=None):
+        cls = _TrackingStager
+        cls.concurrent += 1
+        cls.outstanding_bytes += self.cost
+        cls.peak_concurrent = max(cls.peak_concurrent, cls.concurrent)
+        cls.peak_outstanding_bytes = max(
+            cls.peak_outstanding_bytes, cls.outstanding_bytes
+        )
+        await asyncio.sleep(0.001)
+        cls.concurrent -= 1
+        cls.outstanding_bytes -= self.cost
+        return self.payload
+
+    def get_staging_cost_bytes(self) -> int:
+        return self.cost
+
+    @classmethod
+    def reset(cls):
+        cls.concurrent = cls.peak_concurrent = 0
+        cls.outstanding_bytes = cls.peak_outstanding_bytes = 0
+
+
+class _CollectConsumer(BufferConsumer):
+    def __init__(self, sink: dict, key: str, cost: int):
+        self.sink = sink
+        self.key = key
+        self.cost = cost
+
+    async def consume_buffer(self, buf, executor=None) -> None:
+        self.sink[self.key] = bytes(buf)
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self.cost
+
+
+def test_write_then_read_roundtrip():
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="test_sched")
+    _TrackingStager.reset()
+    payloads = {f"p{i}": bytes([i]) * (100 + i) for i in range(20)}
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=_TrackingStager(v, cost=len(v)))
+        for k, v in payloads.items()
+    ]
+    pending = sync_execute_write_reqs(
+        write_reqs, storage, memory_budget_bytes=1 << 20, rank=0
+    )
+    pending.sync_complete()
+    assert pending.bytes_total == sum(len(v) for v in payloads.values())
+
+    sink: dict = {}
+    read_reqs = [
+        ReadReq(path=k, buffer_consumer=_CollectConsumer(sink, k, cost=len(v)))
+        for k, v in payloads.items()
+    ]
+    sync_execute_read_reqs(read_reqs, storage, memory_budget_bytes=1 << 20, rank=0)
+    assert sink == payloads
+
+
+def test_memory_budget_respected():
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="test_budget")
+    _TrackingStager.reset()
+    # 10 requests of cost 100 with budget 250: at most 2 concurrently staged
+    write_reqs = [
+        WriteReq(path=f"p{i}", buffer_stager=_TrackingStager(b"x" * 100, cost=100))
+        for i in range(10)
+    ]
+    pending = sync_execute_write_reqs(
+        write_reqs, storage, memory_budget_bytes=250, rank=0
+    )
+    pending.sync_complete()
+    assert _TrackingStager.peak_outstanding_bytes <= 250
+
+
+def test_starvation_guard_admits_oversized_request():
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="test_starve")
+    _TrackingStager.reset()
+    # Single request far above budget must still be admitted
+    write_reqs = [
+        WriteReq(path="big", buffer_stager=_TrackingStager(b"y" * 1000, cost=10**9))
+    ]
+    pending = sync_execute_write_reqs(
+        write_reqs, storage, memory_budget_bytes=10, rank=0
+    )
+    pending.sync_complete()
+    assert storage._files["big"] == b"y" * 1000
+
+
+def test_staging_failure_raises():
+    class _FailingStager(BufferStager):
+        async def stage_buffer(self, executor=None):
+            raise RuntimeError("boom")
+
+        def get_staging_cost_bytes(self) -> int:
+            return 10
+
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="test_fail")
+    with pytest.raises(RuntimeError, match="boom"):
+        sync_execute_write_reqs(
+            [WriteReq(path="x", buffer_stager=_FailingStager())],
+            storage,
+            memory_budget_bytes=1 << 20,
+            rank=0,
+        )
+
+
+def test_memory_budget_env_override():
+    from torchsnapshot_tpu import knobs
+
+    with knobs.override_per_rank_memory_budget_bytes(12345):
+        assert get_process_memory_budget_bytes(PGWrapper()) == 12345
+
+
+def test_memory_budget_default_positive():
+    assert get_process_memory_budget_bytes(PGWrapper()) > 0

@@ -1,0 +1,81 @@
+"""Bridge to the JAX distributed coordination service.
+
+When the training job already called ``jax.distributed.initialize()``, every
+host has a connection to the coordination service (gRPC over DCN).  We expose
+its KV interface as a :class:`~torchsnapshot_tpu.dist_store.KVStore` so the
+snapshot layer can run object collectives and barriers over it without any
+extra infrastructure — the TPU-native replacement for the reference's
+c10d TCPStore bootstrap (/root/reference/torchsnapshot/dist_store.py:24-88).
+
+The service has no atomic counter, so ``add`` is emulated with per-contributor
+keys + a directory count.  That covers the snapshot layer's only usage
+pattern: each rank contributes +1 at most once per unique key, and pollers
+call ``add(key, 0)`` to read the count.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional
+
+from .dist_store import KVStore
+
+
+def _get_jax_client():
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        return state.client
+    except Exception:
+        return None
+
+
+def jax_process_info() -> Optional[tuple]:
+    """(rank, world_size) if jax.distributed is initialized, else None."""
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        if state.client is None:
+            return None
+        return state.process_id, state.num_processes
+    except Exception:
+        return None
+
+
+class JaxCoordinationStore(KVStore):
+    def __init__(self, client) -> None:
+        self._client = client
+        self._uid = uuid.uuid4().hex
+
+    def set(self, key: str, value: bytes) -> None:
+        self._client.key_value_set_bytes(key, value)
+
+    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+        return self._client.blocking_key_value_get_bytes(key, int(timeout_s * 1000))
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        try:
+            return self._client.key_value_try_get_bytes(key)
+        except Exception:
+            return None
+
+    def add(self, key: str, amount: int) -> int:
+        if amount > 0:
+            for i in range(amount):
+                self._client.key_value_set_bytes(
+                    f"{key}/contrib/{self._uid}/{uuid.uuid4().hex}", b"1"
+                )
+        try:
+            entries = self._client.key_value_dir_get_bytes(f"{key}/contrib")
+        except Exception:
+            return 0
+        return len(entries)
+
+
+def maybe_jax_coordination_store() -> Optional[KVStore]:
+    client = _get_jax_client()
+    if client is None:
+        return None
+    return JaxCoordinationStore(client)

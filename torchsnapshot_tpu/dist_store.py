@@ -1,0 +1,231 @@
+"""KV store + two-phase barrier for cross-rank coordination.
+
+TPU-native analogue of the reference's ``torchsnapshot/dist_store.py:24-196``.
+The reference leans on torch's C++ ``TCPStore``; here the store is an
+interface with three implementations:
+
+- :class:`FileStore` — shared-filesystem store (atomic rename + O_EXCL
+  counters).  Zero-dependency, used by the multi-process test harness and
+  valid in production wherever a shared FS exists (every TPU pod slice with
+  NFS/GCS-fuse).
+- :class:`TCPStore` — client for the native C++ key-value server in
+  ``torchsnapshot_tpu/_native`` (tpustore), the production path over DCN.
+- :class:`JaxCoordinationStore` — rides the JAX distributed coordination
+  service when ``jax.distributed.initialize`` was called
+  (see coordination.py).
+
+:class:`LinearBarrier` reproduces the reference's two-phase arrive/depart
+barrier (dist_store.py:91-196): usable off the main thread (async snapshots
+must not issue collectives from their completion thread — reference
+snapshot.py:1010), leader acts between the phases, and ``report_error``
+propagates failures to every waiting peer.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import tempfile
+import time
+import uuid
+from typing import List, Optional
+
+
+class StorePeerError(RuntimeError):
+    """Raised on ranks whose peer reported an error through the barrier."""
+
+
+class KVStore(abc.ABC):
+    @abc.abstractmethod
+    def set(self, key: str, value: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+        """Block until ``key`` exists, then return its value."""
+        ...
+
+    @abc.abstractmethod
+    def try_get(self, key: str) -> Optional[bytes]:
+        ...
+
+    @abc.abstractmethod
+    def add(self, key: str, amount: int) -> int:
+        """Atomically add to an integer counter; returns the new value."""
+        ...
+
+    def wait_hint(self, iteration: int) -> None:
+        """Polling back-off helper for spin-wait loops."""
+        time.sleep(min(0.001 * (2 ** min(iteration, 7)), 0.2))
+
+
+class FileStore(KVStore):
+    """Shared-filesystem KV store.
+
+    set() is atomic via write-to-temp + rename; add() serializes through an
+    O_EXCL lock file.  Polling intervals back off to 200 ms.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._root = path
+        os.makedirs(path, exist_ok=True)
+
+    def _key_path(self, key: str) -> str:
+        return os.path.join(self._root, key.replace("/", "%2F"))
+
+    def set(self, key: str, value: bytes) -> None:
+        target = self._key_path(key)
+        fd, tmp = tempfile.mkstemp(dir=self._root, prefix=".tmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(value)
+            os.replace(tmp, target)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        target = self._key_path(key)
+        try:
+            with open(target, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+        deadline = time.monotonic() + timeout_s
+        i = 0
+        while True:
+            value = self.try_get(key)
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"Timed out waiting for store key: {key}")
+            self.wait_hint(i)
+            i += 1
+
+    def add(self, key: str, amount: int) -> int:
+        lock = self._key_path(key) + ".lock"
+        i = 0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                self.wait_hint(i)
+                i += 1
+        try:
+            current = self.try_get(key)
+            value = (int(current) if current is not None else 0) + amount
+            self.set(key, str(value).encode())
+            return value
+        finally:
+            os.unlink(lock)
+
+
+class PrefixStore(KVStore):
+    """Namespaced view of another store (torch's PrefixStore equivalent)."""
+
+    def __init__(self, prefix: str, store: KVStore) -> None:
+        self._prefix = prefix
+        self._store = store
+
+    def _k(self, key: str) -> str:
+        return f"{self._prefix}/{key}"
+
+    def set(self, key: str, value: bytes) -> None:
+        self._store.set(self._k(key), value)
+
+    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+        return self._store.get(self._k(key), timeout_s)
+
+    def try_get(self, key: str) -> Optional[bytes]:
+        return self._store.try_get(self._k(key))
+
+    def add(self, key: str, amount: int) -> int:
+        return self._store.add(self._k(key), amount)
+
+
+def get_or_create_store(rank: int, world_size: int) -> KVStore:
+    """Resolve the process-group store from the environment (reference
+    dist_store.py:24-88 bootstraps a TCPStore via free-port broadcast).
+
+    Resolution order: explicit tpustore server (``TPUSNAP_STORE_ADDR``),
+    shared-FS store (``TPUSNAP_STORE_PATH``), JAX coordination service if
+    initialized.
+    """
+    addr = os.environ.get("TPUSNAP_STORE_ADDR")
+    if addr:
+        from .tpustore import TCPStore
+
+        host, _, port = addr.rpartition(":")
+        return TCPStore(host, int(port))
+    path = os.environ.get("TPUSNAP_STORE_PATH")
+    if path:
+        return FileStore(path)
+    from .coordination import maybe_jax_coordination_store
+
+    store = maybe_jax_coordination_store()
+    if store is not None:
+        return store
+    raise RuntimeError(
+        "No coordination store configured: set TPUSNAP_STORE_ADDR / "
+        "TPUSNAP_STORE_PATH or call jax.distributed.initialize()"
+    )
+
+
+class LinearBarrier:
+    """Two-phase arrive/depart barrier with leader action in between
+    (reference dist_store.py:91-196).
+
+    Safe off the main thread: only store ops, no collectives.  Error
+    propagation: any rank may ``report_error``; every peer blocked in
+    ``arrive``/``depart`` raises :class:`StorePeerError`.
+    """
+
+    def __init__(
+        self,
+        prefix: str,
+        store: KVStore,
+        rank: int,
+        world_size: int,
+        leader_rank: int = 0,
+    ) -> None:
+        self._store = PrefixStore(f"linear_barrier/{prefix}", store)
+        self._rank = rank
+        self._world_size = world_size
+        self._leader_rank = leader_rank
+
+    def _wait_counter(self, key: str, target: int, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        i = 0
+        while True:
+            err = self._store.try_get("error")
+            if err is not None:
+                raise StorePeerError(err.decode("utf-8", errors="replace"))
+            if self._store.add(key, 0) >= target:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"LinearBarrier timed out waiting on {key}")
+            self._store.wait_hint(i)
+            i += 1
+
+    def arrive(self, timeout_s: float = 1800.0) -> None:
+        self._store.add("arrived", 1)
+        if self._rank == self._leader_rank:
+            self._wait_counter("arrived", self._world_size, timeout_s)
+
+    def depart(self, timeout_s: float = 1800.0) -> None:
+        if self._rank == self._leader_rank:
+            self._store.add("departed", 1)
+        else:
+            self._wait_counter("departed", 1, timeout_s)
+
+    def report_error(self, message: str) -> None:
+        self._store.set("error", message.encode())
+
+
+def make_barrier_prefix() -> str:
+    return uuid.uuid4().hex

@@ -1,0 +1,123 @@
+"""Uniform facade over inter-rank *metadata* collectives.
+
+TPU-native analogue of the reference's ``torchsnapshot/pg_wrapper.py:17-91``.
+The reference rides torch.distributed c10d (gloo/nccl/mpi); checkpoint
+coordination only ever moves metadata-sized pickled objects (entry dicts,
+write loads, hostnames), never tensor payloads (SURVEY.md §2.4).  The
+TPU-native design therefore runs object collectives **host-side over a KV
+store** (our C++ TCP store, a file store for tests, or the JAX coordination
+service) — ICI stays dedicated to the training program, exactly as NCCL was
+only used for object collectives in the reference.
+
+Per-instance generation counters make every collective's key set unique so
+back-to-back collectives never collide.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+from .dist_store import KVStore
+
+
+class PGWrapper:
+    """Rank/world/collectives facade.
+
+    With ``store=None`` (single process) every collective degenerates to the
+    identity, matching the reference's no-dist semantics
+    (pg_wrapper.py:27-58).
+    """
+
+    def __init__(
+        self,
+        store: Optional[KVStore] = None,
+        rank: int = 0,
+        world_size: int = 1,
+        prefix: str = "pg",
+        timeout_s: float = 1800.0,
+    ) -> None:
+        if store is None and world_size != 1:
+            raise ValueError("world_size > 1 requires a KV store")
+        self._store = store
+        self._rank = rank
+        self._world_size = world_size
+        self._prefix = prefix
+        self._timeout_s = timeout_s
+        self._generation = 0
+
+    def get_rank(self) -> int:
+        return self._rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def _next_key(self, op: str) -> str:
+        self._generation += 1
+        return f"{self._prefix}/{op}/{self._generation}"
+
+    def barrier(self) -> None:
+        if self._store is None or self._world_size == 1:
+            return
+        key = self._next_key("barrier")
+        self._store.add(f"{key}/arrived", 1)
+        deadline_counter = 0
+        while self._store.add(f"{key}/arrived", 0) < self._world_size:
+            self._store.wait_hint(deadline_counter)
+            deadline_counter += 1
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """Gather one pickled object per rank, ordered by rank (reference
+        pg_wrapper.py:66-72)."""
+        if self._store is None or self._world_size == 1:
+            return [obj]
+        key = self._next_key("allgather")
+        self._store.set(f"{key}/{self._rank}", pickle.dumps(obj))
+        out: List[Any] = []
+        for r in range(self._world_size):
+            data = self._store.get(f"{key}/{r}", timeout_s=self._timeout_s)
+            out.append(pickle.loads(data))
+        return out
+
+    def broadcast_object_list(self, obj_list: List[Any], src: int = 0) -> None:
+        """In-place broadcast of a list of objects from ``src`` (reference
+        pg_wrapper.py:59-64)."""
+        if self._store is None or self._world_size == 1:
+            return
+        key = self._next_key("broadcast")
+        if self._rank == src:
+            self._store.set(key, pickle.dumps(obj_list))
+            received = obj_list
+        else:
+            received = pickle.loads(self._store.get(key, timeout_s=self._timeout_s))
+        obj_list[:] = received
+
+    def scatter_object_list(
+        self,
+        output_list: List[Any],
+        input_list: Optional[List[Any]],
+        src: int = 0,
+    ) -> None:
+        """Scatter one object per rank from ``src``.  The reference works
+        around NCCL's lack of scatter by broadcasting then indexing
+        (pg_wrapper.py:85-89); over a KV store we write per-rank keys."""
+        if self._store is None or self._world_size == 1:
+            assert input_list is not None
+            output_list[0] = input_list[0]
+            return
+        key = self._next_key("scatter")
+        if self._rank == src:
+            assert input_list is not None and len(input_list) == self._world_size
+            for r in range(self._world_size):
+                if r == src:
+                    continue
+                self._store.set(f"{key}/{r}", pickle.dumps(input_list[r]))
+            output_list[0] = input_list[src]
+        else:
+            output_list[0] = pickle.loads(
+                self._store.get(f"{key}/{self._rank}", timeout_s=self._timeout_s)
+            )
+
+    @property
+    def store(self) -> Optional[KVStore]:
+        return self._store

@@ -1,0 +1,48 @@
+"""URL → storage plugin resolver + third-party registry.
+
+TPU-native analogue of the reference's ``torchsnapshot/storage_plugin.py``
+(/root/reference/torchsnapshot/storage_plugin.py:20-80): ``fs`` (default when
+the URL has no scheme), ``gs``, ``s3``, ``memory`` (test fake) built in;
+third-party plugins via the ``torchsnapshot_tpu.storage_plugins`` entry-point
+group.
+"""
+
+from __future__ import annotations
+
+from importlib.metadata import entry_points
+from typing import Optional
+
+from .io_types import StoragePlugin
+
+
+def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+    if "://" in url_path:
+        protocol, path = url_path.split("://", 1)
+        if not protocol:
+            protocol = "fs"
+    else:
+        protocol, path = "fs", url_path
+
+    if protocol == "fs":
+        from .storage_plugins.fs import FSStoragePlugin
+
+        return FSStoragePlugin(root=path)
+    if protocol in ("gs", "gcs"):
+        from .storage_plugins.gcs import GCSStoragePlugin
+
+        return GCSStoragePlugin(root=path)
+    if protocol == "s3":
+        from .storage_plugins.s3 import S3StoragePlugin
+
+        return S3StoragePlugin(root=path)
+    if protocol == "memory":
+        from .storage_plugins.memory import MemoryStoragePlugin
+
+        return MemoryStoragePlugin(root=path)
+
+    eps = entry_points(group="torchsnapshot_tpu.storage_plugins")
+    for ep in eps:
+        if ep.name == protocol:
+            return ep.load()(path)
+
+    raise RuntimeError(f"Unsupported protocol: {protocol}")

@@ -1,0 +1,91 @@
+"""Local/posix filesystem storage plugin.
+
+TPU-native analogue of the reference's ``torchsnapshot/storage_plugins/fs.py``
+(/root/reference/torchsnapshot/storage_plugins/fs.py:21-63).  Writes/reads run
+through a thread pool (posix I/O releases the GIL); when the native helper
+library (tpusnap_io, C++ pread/pwrite pool) is built, it takes over the data
+plane for large buffers.  Parent-directory creation is cached like the
+reference (fs.py:31-34); byte-ranged reads seek (fs.py:42-51).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Set
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+_DEFAULT_IO_THREADS = 16
+
+
+class FSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self._dir_cache: Set[str] = set()
+        self._executor: Optional[ThreadPoolExecutor] = None
+        try:
+            from ..native_io import NativeFileIO
+
+            self._native: Optional[NativeFileIO] = NativeFileIO.maybe_create()
+        except Exception:
+            self._native = None
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=_DEFAULT_IO_THREADS, thread_name_prefix="fs_io"
+            )
+        return self._executor
+
+    def _prepare_parent(self, path: str) -> None:
+        parent = os.path.dirname(path)
+        if parent not in self._dir_cache:
+            os.makedirs(parent, exist_ok=True)
+            self._dir_cache.add(parent)
+
+    def _blocking_write(self, path: str, buf) -> None:
+        self._prepare_parent(path)
+        if self._native is not None:
+            self._native.write_file(path, buf)
+            return
+        with open(path, "wb") as f:
+            f.write(buf)
+
+    def _blocking_read(self, path: str, byte_range) -> bytearray:
+        if self._native is not None:
+            return self._native.read_file(path, byte_range)
+        with open(path, "rb") as f:
+            if byte_range is None:
+                return bytearray(f.read())
+            offset, end = byte_range
+            f.seek(offset)
+            return bytearray(f.read(end - offset))
+
+    async def write(self, write_io: WriteIO) -> None:
+        path = os.path.join(self.root, write_io.path)
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            self._get_executor(), self._blocking_write, path, write_io.buf
+        )
+
+    async def read(self, read_io: ReadIO) -> None:
+        path = os.path.join(self.root, read_io.path)
+        loop = asyncio.get_event_loop()
+        read_io.buf = await loop.run_in_executor(
+            self._get_executor(), self._blocking_read, path, read_io.byte_range
+        )
+
+    async def delete(self, path: str) -> None:
+        os.unlink(os.path.join(self.root, path))
+
+    async def delete_dir(self, path: str) -> None:
+        import shutil
+
+        shutil.rmtree(os.path.join(self.root, path), ignore_errors=True)
+
+    async def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown()
+            self._executor = None

@@ -1,0 +1,58 @@
+"""In-memory storage fake for tests and for RAM-disk style staging.
+
+The reference's highest-value scheduler tests fulfill write reqs straight
+into read reqs via an in-memory ``path_to_buf`` dict
+(/root/reference/tests/test_sharded_tensor_resharding.py:98-106); this plugin
+makes that pattern a first-class storage backend.  Class-level registry keyed
+by root so take/restore in one process share state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+_REGISTRY: Dict[str, Dict[str, bytes]] = {}
+_LOCK = threading.Lock()
+
+
+class MemoryStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        self.root = root
+        with _LOCK:
+            self._files = _REGISTRY.setdefault(root, {})
+
+    async def write(self, write_io: WriteIO) -> None:
+        with _LOCK:
+            self._files[write_io.path] = bytes(write_io.buf)
+
+    async def read(self, read_io: ReadIO) -> None:
+        with _LOCK:
+            data = self._files[read_io.path]
+        if read_io.byte_range is not None:
+            offset, end = read_io.byte_range
+            data = data[offset:end]
+        read_io.buf = bytearray(data)
+
+    async def delete(self, path: str) -> None:
+        with _LOCK:
+            self._files.pop(path, None)
+
+    async def delete_dir(self, path: str) -> None:
+        prefix = path.rstrip("/") + "/"
+        with _LOCK:
+            for k in [k for k in self._files if k.startswith(prefix)]:
+                del self._files[k]
+
+    async def close(self) -> None:
+        pass
+
+    @classmethod
+    def reset(cls, root: Optional[str] = None) -> None:
+        with _LOCK:
+            if root is None:
+                _REGISTRY.clear()
+            else:
+                _REGISTRY.pop(root, None)
