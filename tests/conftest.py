@@ -13,7 +13,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force off TPU: tests run on the 8-dev CPU mesh
+
+# The environment may pre-import jax (sitecustomize) with a TPU platform
+# configured; backends initialize lazily, so re-point the config at CPU before
+# any backend use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,135 @@
+"""Preparer round-trips through real scheduler + in-memory storage."""
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import io_preparer, knobs
+from torchsnapshot_tpu.manifest import (
+    ChunkedTensorEntry,
+    ObjectEntry,
+    PrimitiveEntry,
+    TensorEntry,
+)
+from torchsnapshot_tpu.scheduler import (
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+BUDGET = 1 << 30
+
+
+def roundtrip(obj, obj_out=None, rank=0, replicated=False, buffer_size_limit=None):
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="prep")
+    entry, write_reqs = io_preparer.prepare_write(
+        obj, logical_path="leaf", rank=rank, replicated=replicated
+    )
+    pending = sync_execute_write_reqs(write_reqs, storage, BUDGET, rank)
+    pending.sync_complete()
+    read_reqs, fut = io_preparer.prepare_read(
+        entry, obj_out, buffer_size_limit_bytes=buffer_size_limit
+    )
+    sync_execute_read_reqs(read_reqs, storage, BUDGET, rank)
+    return entry, fut.obj
+
+
+def test_primitive_no_io():
+    entry, out = roundtrip(42)
+    assert isinstance(entry, PrimitiveEntry)
+    assert out == 42
+
+
+def test_numpy_roundtrip():
+    arr = np.random.RandomState(0).rand(32, 16).astype(np.float32)
+    entry, out = roundtrip(arr)
+    assert isinstance(entry, TensorEntry)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_numpy_inplace():
+    arr = np.random.RandomState(1).rand(8, 8).astype(np.float64)
+    target = np.zeros((8, 8), dtype=np.float64)
+    entry, out = roundtrip(arr, obj_out=target)
+    assert out is target
+    np.testing.assert_array_equal(target, arr)
+
+
+def test_numpy_bf16_roundtrip():
+    arr = np.arange(64, dtype=ml_dtypes.bfloat16).reshape(4, 16)
+    entry, out = roundtrip(arr)
+    assert entry.dtype == "bfloat16"
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_jax_array_roundtrip():
+    arr = jnp.arange(128, dtype=jnp.bfloat16).reshape(8, 16)
+    entry, out = roundtrip(arr)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_jax_target_device_put():
+    arr = jnp.linspace(0, 1, 64, dtype=jnp.float32).reshape(8, 8)
+    target = jnp.zeros((8, 8), dtype=jnp.float32)
+    entry, out = roundtrip(np.asarray(arr), obj_out=target)
+    assert isinstance(out, jax.Array)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_dtype_cast_on_load():
+    arr = np.random.RandomState(2).rand(16).astype(np.float32)
+    target = np.zeros(16, dtype=np.float64)
+    entry, out = roundtrip(arr, obj_out=target)
+    np.testing.assert_allclose(target, arr, rtol=1e-6)
+
+
+def test_tiled_read():
+    arr = np.random.RandomState(3).rand(1000).astype(np.float32)  # 4000 bytes
+    entry, out = roundtrip(arr, buffer_size_limit=512)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_chunked_roundtrip():
+    with knobs.override_max_chunk_size_bytes(1024):
+        arr = np.random.RandomState(4).rand(64, 16).astype(np.float32)  # 4 KB
+        entry, out = roundtrip(arr)
+        assert isinstance(entry, ChunkedTensorEntry)
+        assert len(entry.chunks) == 4
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_chunked_jax_roundtrip():
+    with knobs.override_max_chunk_size_bytes(1024):
+        arr = jnp.arange(64 * 16, dtype=jnp.float32).reshape(64, 16)
+        target = jnp.zeros((64, 16), dtype=jnp.float32)
+        entry, out = roundtrip(arr, obj_out=target)
+        assert isinstance(entry, ChunkedTensorEntry)
+        assert isinstance(out, jax.Array)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_object_roundtrip():
+    obj = {"custom": [1, 2, (3, 4)], "s": {"deep"}}
+    entry, out = roundtrip(obj)
+    assert isinstance(entry, ObjectEntry)
+    assert out == obj
+
+
+def test_prng_key_roundtrip():
+    key = jax.random.key(1234)
+    entry, out = roundtrip(key)
+    assert isinstance(entry, ObjectEntry)
+    assert entry.obj_type == "jax_prng_key"
+    assert jnp.issubdtype(out.dtype, jax.dtypes.prng_key)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(out, (4,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_storage_path_namespace():
+    arr = np.zeros(4)
+    assert io_preparer.get_storage_path(arr, "p", 3, False) == "3/p"
+    assert io_preparer.get_storage_path(arr, "p", 3, True) == "replicated/p"

@@ -65,8 +65,28 @@ def _sample_manifest():
             dtype="float32",
             shape=[4096, 128],
             chunks=[
-                Chunk(offsets=[0, 0], sizes=[2048, 128], dtype="float32"),
-                Chunk(offsets=[2048, 0], sizes=[2048, 128], dtype="float32"),
+                Shard(
+                    offsets=[0, 0],
+                    sizes=[2048, 128],
+                    tensor=TensorEntry(
+                        location="0/model/big_0_0",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[2048, 128],
+                        replicated=False,
+                    ),
+                ),
+                Shard(
+                    offsets=[2048, 0],
+                    sizes=[2048, 128],
+                    tensor=TensorEntry(
+                        location="0/model/big_2048_0",
+                        serializer="buffer_protocol",
+                        dtype="float32",
+                        shape=[2048, 128],
+                        replicated=False,
+                    ),
+                ),
             ],
             replicated=True,
         ),

@@ -1,0 +1,128 @@
+"""Type-dispatched write/read planning + storage-path namespace.
+
+TPU-native analogue of the reference's ``torchsnapshot/io_preparer.py``
+(/root/reference/torchsnapshot/io_preparer.py:52-192).  Dispatch order on
+write (reference :106-148):
+
+1. python primitives → inlined :class:`PrimitiveEntry` (no storage I/O)
+2. partitioned ``jax.Array`` → :class:`ShardedArrayIOPreparer`
+3. arrays above the chunk knob (512 MB) → :class:`ChunkedArrayIOPreparer`
+4. other arrays (numpy / single-device / fully-replicated jax) →
+   :class:`ArrayIOPreparer`
+5. typed PRNG key arrays → pickled (impl, key_data) envelope, transparently
+   re-wrapped on read (JAX-specific; no reference analogue)
+6. everything else → pickle :class:`ObjectIOPreparer`
+
+Storage-path namespace (reference io_preparer.py:52-61): ``sharded/`` for
+partitioned entries (shared across ranks), ``replicated/`` for deduplicated
+replicated entries, ``<rank>/`` for rank-private payloads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from . import knobs, staging
+from .io_preparers.array import ArrayIOPreparer
+from .io_preparers.chunked_array import ChunkedArrayIOPreparer
+from .io_preparers.object import ObjectIOPreparer
+from .io_preparers.sharded_array import ShardedArrayIOPreparer
+from .io_types import Future, ReadReq, WriteReq
+from .manifest import (
+    ChunkedTensorEntry,
+    Entry,
+    ObjectEntry,
+    PrimitiveEntry,
+    ShardedArrayEntry,
+    TensorEntry,
+)
+
+def get_storage_path(
+    obj: Any, logical_path: str, rank: int, replicated: bool
+) -> str:
+    if staging.is_jax_array(obj) and staging.is_sharded(obj):
+        return f"sharded/{logical_path}"
+    if replicated:
+        return f"replicated/{logical_path}"
+    return f"{rank}/{logical_path}"
+
+
+def prepare_write(
+    obj: Any,
+    logical_path: str,
+    rank: int,
+    replicated: bool,
+    is_async_snapshot: bool = False,
+) -> Tuple[Entry, List[WriteReq]]:
+    if PrimitiveEntry.supports(obj) and not isinstance(obj, np.generic):
+        return PrimitiveEntry.from_object(obj), []
+
+    storage_path = get_storage_path(obj, logical_path, rank, replicated)
+
+    if staging.is_prng_key_array(obj):
+        entry, reqs = ObjectIOPreparer.prepare_write(
+            storage_path=storage_path, obj=staging.prng_key_envelope(obj)
+        )
+        entry.obj_type = "jax_prng_key"
+        entry.replicated = replicated
+        return entry, reqs
+
+    if staging.is_jax_array(obj) and staging.is_sharded(obj):
+        return ShardedArrayIOPreparer.prepare_write(
+            storage_path=storage_path, obj=obj, is_async_snapshot=is_async_snapshot
+        )
+
+    if staging.is_array_like(obj):
+        nbytes = _nbytes_of(obj)
+        if nbytes > knobs.get_max_chunk_size_bytes():
+            instruction = ChunkedArrayIOPreparer.chunk_instructions(
+                shape=list(np.shape(obj)),
+                dtype=np.dtype(obj.dtype),
+                chunk_size_bytes=knobs.get_max_chunk_size_bytes(),
+            )
+            entry, reqs = ChunkedArrayIOPreparer.prepare_write(
+                storage_path=storage_path,
+                obj=obj,
+                chunking_instruction=instruction,
+                is_async_snapshot=is_async_snapshot,
+            )
+            entry.replicated = replicated
+            return entry, reqs
+        entry, reqs = ArrayIOPreparer.prepare_write(
+            storage_path=storage_path, obj=obj, is_async_snapshot=is_async_snapshot
+        )
+        entry.replicated = replicated
+        return entry, reqs
+
+    entry, reqs = ObjectIOPreparer.prepare_write(storage_path=storage_path, obj=obj)
+    entry.replicated = replicated
+    return entry, reqs
+
+
+def _nbytes_of(obj: Any) -> int:
+    if staging.is_jax_array(obj):
+        return int(np.prod(obj.shape)) * np.dtype(obj.dtype).itemsize
+    return int(np.asarray(obj).nbytes)
+
+
+def prepare_read(
+    entry: Entry,
+    obj_out: Optional[Any] = None,
+    buffer_size_limit_bytes: Optional[int] = None,
+) -> Tuple[List[ReadReq], Future]:
+    """Read dispatch by entry type (reference io_preparer.py:150-182)."""
+    if isinstance(entry, PrimitiveEntry):
+        return [], Future(obj=entry.get_value())
+    if isinstance(entry, ShardedArrayEntry):
+        return ShardedArrayIOPreparer.prepare_read(entry, obj_out)
+    if isinstance(entry, ChunkedTensorEntry):
+        return ChunkedArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes
+        )
+    if isinstance(entry, TensorEntry):
+        return ArrayIOPreparer.prepare_read(entry, obj_out, buffer_size_limit_bytes)
+    if isinstance(entry, ObjectEntry):
+        return ObjectIOPreparer.prepare_read(entry, obj_out)
+    raise TypeError(f"Cannot prepare read for entry type: {type(entry)}")

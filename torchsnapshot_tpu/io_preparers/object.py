@@ -1,0 +1,81 @@
+"""Pickle fallback for arbitrary objects (reference
+torchsnapshot/io_preparers/object.py:37-95).  Kept off the hot path by the
+dispatch order in io_preparer.py."""
+
+from __future__ import annotations
+
+import sys
+from concurrent.futures import Executor
+from typing import Any, List, Optional, Tuple
+
+from .. import serialization
+from ..io_types import BufferConsumer, BufferStager, BufferType, Future, ReadReq, WriteReq
+from ..manifest import ObjectEntry
+
+
+class ObjectIOPreparer:
+    @classmethod
+    def prepare_write(
+        cls,
+        storage_path: str,
+        obj: Any,
+    ) -> Tuple[ObjectEntry, List[WriteReq]]:
+        entry = ObjectEntry(
+            location=storage_path,
+            serializer="pickle",
+            obj_type=type(obj).__name__,
+            replicated=False,
+        )
+        return entry, [
+            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj=obj))
+        ]
+
+    @classmethod
+    def prepare_read(
+        cls, entry: ObjectEntry, obj_out: Optional[Any] = None
+    ) -> Tuple[List[ReadReq], Future]:
+        # The consumer overwrites the Future rather than restoring in place
+        # (reference object.py:83-95): arbitrary objects have no in-place
+        # contract.
+        fut: Future = Future()
+        return (
+            [
+                ReadReq(
+                    path=entry.location,
+                    byte_range=None,
+                    buffer_consumer=ObjectBufferConsumer(fut=fut),
+                )
+            ],
+            fut,
+        )
+
+
+class ObjectBufferStager(BufferStager):
+    def __init__(self, obj: Any) -> None:
+        self._obj = obj
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        return serialization.pickle_save_as_bytes(self._obj)
+
+    def get_staging_cost_bytes(self) -> int:
+        # sys.getsizeof is knowingly inaccurate (reference object.py:78-80);
+        # pickling to measure would defeat the lazy staging.
+        return max(sys.getsizeof(self._obj), 4096)
+
+
+class ObjectBufferConsumer(BufferConsumer):
+    def __init__(self, fut: Future) -> None:
+        self._fut = fut
+        self._nbytes_hint = 4096
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        from .. import staging
+
+        self._fut.obj = staging.maybe_unwrap_prng_key(
+            serialization.pickle_load_from_bytes(bytes(buf))
+        )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self._nbytes_hint

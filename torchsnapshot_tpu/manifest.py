@@ -134,7 +134,9 @@ class ShardedArrayEntry(Entry):
 
 @dataclass
 class Chunk:
-    """Byte-bounded slice of a chunked array (reference manifest.py:160-169)."""
+    """Chunking instruction: one dim-0 slice of a large array (reference
+    manifest.py:160-169).  Not serialized itself — ChunkedTensorEntry stores
+    self-contained :class:`Shard` records per chunk."""
 
     offsets: List[int]
     sizes: List[int]
@@ -150,17 +152,16 @@ class Chunk:
 
 @dataclass
 class ChunkedTensorEntry(Entry):
-    """A large array split into dim-0 chunks, each its own TensorEntry
-    (reference manifest.py:171-209).  The chunk's TensorEntry lives in the
-    manifest at ``<path>_<offsets>``; here we record the chunk geometry."""
+    """A large array split into dim-0 chunks, each carried as a Shard with an
+    embedded TensorEntry (reference manifest.py:171-209)."""
 
     dtype: str
     shape: List[int]
-    chunks: List[Chunk]
+    chunks: List[Shard]
     replicated: bool
 
     def __init__(
-        self, dtype: str, shape: List[int], chunks: List[Chunk], replicated: bool
+        self, dtype: str, shape: List[int], chunks: List[Shard], replicated: bool
     ) -> None:
         super().__init__(type="ChunkedTensor")
         self.dtype = dtype
@@ -321,7 +322,7 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
         d.update(
             dtype=entry.dtype,
             shape=entry.shape,
-            chunks=[c.to_dict() for c in entry.chunks],
+            chunks=[s.to_dict() for s in entry.chunks],
             replicated=entry.replicated,
         )
     elif isinstance(entry, ObjectEntry):
@@ -372,7 +373,7 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
         return ChunkedTensorEntry(
             dtype=d["dtype"],
             shape=list(d["shape"]),
-            chunks=[Chunk.from_dict(c) for c in d["chunks"]],
+            chunks=[Shard.from_dict(c) for c in d["chunks"]],
             replicated=bool(d["replicated"]),
         )
     if typ == "object":
