@@ -1,0 +1,24 @@
+"""torchsnapshot_tpu: a TPU-native checkpointing framework.
+
+Performant, memory-efficient snapshots of JAX/XLA training state, designed
+for large GSPMD-sharded distributed workloads.  Built from scratch on
+JAX/XLA idioms with the capabilities of pytorch/torchsnapshot (the public
+API mirrors the reference's tiny surface:
+/root/reference/torchsnapshot/__init__.py:12-24).
+"""
+
+from .rng_state import RNGState
+from .snapshot import PendingSnapshot, Snapshot
+from .state_dict import StateDict
+from .stateful import AppState, Stateful
+
+__all__ = [
+    "Snapshot",
+    "PendingSnapshot",
+    "Stateful",
+    "AppState",
+    "StateDict",
+    "RNGState",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,217 @@
+"""Request batching: coalesce small writes into slab files, merge ranged reads.
+
+TPU-native analogue of the reference's ``torchsnapshot/batcher.py``
+(/root/reference/torchsnapshot/batcher.py:51-486).  Many-small-files is the
+classic checkpoint bottleneck (object stores bill per request; posix pays per
+syscall): batchable small writes are packed into ``batched/<uuid>`` slab
+files up to the slab threshold (128 MB knob), and their manifest entries are
+rewritten in place to (slab location, byte_range) — reference :335-353.
+
+Only buffer-protocol array stagers are batchable (reference is_batchable,
+:481-486): their exact byte size is known from dtype×shape before staging, so
+slab offsets can be assigned up front.  Slab staging awaits all member
+stagers concurrently — on TPU that means their D2H DMAs overlap — then packs
+into one contiguous bytearray (reference BatchedBufferStager:51-103; the
+GPU-side slab concat at :104-159 is deliberately not mirrored: pjrt D2H of
+many shards already pipelines, and a device-side concat would burn HBM
+bandwidth to save host memcpys).
+
+Read side: byte-ranged reads against the same file are merged into one
+spanning read fanned out to sub-consumers (reference batch_read_requests,
+:387-486).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from collections import defaultdict
+from concurrent.futures import Executor
+from typing import Dict, List, Optional, Tuple
+
+from . import knobs, serialization
+from .io_preparers.array import ArrayBufferStager
+from .io_types import (
+    BufferConsumer,
+    BufferStager,
+    BufferType,
+    ReadReq,
+    WriteReq,
+)
+from .manifest import (
+    ChunkedTensorEntry,
+    Entry,
+    Manifest,
+    ShardedArrayEntry,
+    TensorEntry,
+)
+from .serialization import Serializer
+
+logger = logging.getLogger(__name__)
+
+
+def _index_tensor_entries(entries: Manifest) -> Dict[str, TensorEntry]:
+    """location → TensorEntry for every array payload, including those nested
+    in sharded/chunked entries (needed to rewrite locations in place)."""
+    index: Dict[str, TensorEntry] = {}
+    for entry in entries.values():
+        if isinstance(entry, TensorEntry):
+            index[entry.location] = entry
+        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+            shards = entry.shards if isinstance(entry, ShardedArrayEntry) else entry.chunks
+            for shard in shards:
+                index[shard.tensor.location] = shard.tensor
+    return index
+
+
+def is_batchable(write_req: WriteReq, entry_index: Dict[str, TensorEntry]) -> bool:
+    stager = write_req.buffer_stager
+    if not isinstance(stager, ArrayBufferStager):
+        return False
+    entry = entry_index.get(write_req.path)
+    if entry is None or entry.serializer != Serializer.BUFFER_PROTOCOL.value:
+        return False
+    return True
+
+
+def batch_write_requests(
+    entries: Manifest, write_reqs: List[WriteReq]
+) -> Tuple[Manifest, List[WriteReq]]:
+    entry_index = _index_tensor_entries(entries)
+    slab_threshold = knobs.get_slab_size_threshold_bytes()
+
+    batchable: List[Tuple[WriteReq, TensorEntry, int]] = []
+    passthrough: List[WriteReq] = []
+    for wr in write_reqs:
+        if is_batchable(wr, entry_index):
+            entry = entry_index[wr.path]
+            nbytes = serialization.array_nbytes(entry.shape, entry.dtype)
+            if nbytes < slab_threshold:
+                batchable.append((wr, entry, nbytes))
+                continue
+        passthrough.append(wr)
+
+    if len(batchable) < 2:
+        return entries, write_reqs
+
+    # Greedy packing preserving plan order; slabs capped at the threshold.
+    out_reqs = passthrough
+    slab: List[Tuple[WriteReq, TensorEntry, int]] = []
+    slab_bytes = 0
+
+    def _flush() -> None:
+        nonlocal slab, slab_bytes
+        if not slab:
+            return
+        if len(slab) == 1:
+            out_reqs.append(slab[0][0])
+        else:
+            location = f"batched/{uuid.uuid4().hex}"
+            offset = 0
+            members: List[Tuple[BufferStager, int, int]] = []
+            for wr, entry, nbytes in slab:
+                entry.location = location
+                entry.byte_range = [offset, offset + nbytes]
+                members.append((wr.buffer_stager, offset, nbytes))
+                offset += nbytes
+            out_reqs.append(
+                WriteReq(
+                    path=location,
+                    buffer_stager=BatchedBufferStager(members=members, total=offset),
+                )
+            )
+        slab = []
+        slab_bytes = 0
+
+    for item in batchable:
+        if slab_bytes + item[2] > slab_threshold:
+            _flush()
+        slab.append(item)
+        slab_bytes += item[2]
+    _flush()
+    logger.debug(
+        "Batcher: %d small writes coalesced into %d slabs (%d passthrough)",
+        len(batchable),
+        len(out_reqs) - len(passthrough),
+        len(passthrough),
+    )
+    return entries, out_reqs
+
+
+class BatchedBufferStager(BufferStager):
+    def __init__(self, members: List[Tuple[BufferStager, int, int]], total: int) -> None:
+        self._members = members
+        self._total = total
+
+    async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        slab = bytearray(self._total)
+
+        async def _stage_one(stager: BufferStager, offset: int, nbytes: int) -> None:
+            buf = await stager.stage_buffer(executor)
+            view = memoryview(buf).cast("B")
+            if view.nbytes != nbytes:
+                raise RuntimeError(
+                    f"Batched member staged {view.nbytes} bytes, expected {nbytes}"
+                )
+            slab[offset : offset + nbytes] = view
+
+        await asyncio.gather(
+            *(_stage_one(s, o, n) for s, o, n in self._members)
+        )
+        return slab
+
+    def get_staging_cost_bytes(self) -> int:
+        return self._total + sum(s.get_staging_cost_bytes() for s, _, _ in self._members)
+
+
+def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    by_path: Dict[str, List[ReadReq]] = defaultdict(list)
+    passthrough: List[ReadReq] = []
+    for rr in read_reqs:
+        if rr.byte_range is not None:
+            by_path[rr.path].append(rr)
+        else:
+            passthrough.append(rr)
+
+    out = passthrough
+    for path, reqs in by_path.items():
+        if len(reqs) < 2:
+            out += reqs
+            continue
+        start = min(r.byte_range[0] for r in reqs)
+        end = max(r.byte_range[1] for r in reqs)
+        members = [
+            (r.byte_range[0] - start, r.byte_range[1] - start, r.buffer_consumer)
+            for r in reqs
+        ]
+        out.append(
+            ReadReq(
+                path=path,
+                byte_range=[start, end],
+                buffer_consumer=BatchedBufferConsumer(members=members, total=end - start),
+            )
+        )
+    return out
+
+
+class BatchedBufferConsumer(BufferConsumer):
+    def __init__(
+        self, members: List[Tuple[int, int, BufferConsumer]], total: int
+    ) -> None:
+        self._members = members
+        self._total = total
+
+    async def consume_buffer(
+        self, buf: BufferType, executor: Optional[Executor] = None
+    ) -> None:
+        view = memoryview(buf)
+        await asyncio.gather(
+            *(
+                consumer.consume_buffer(view[start:end], executor)
+                for start, end, consumer in self._members
+            )
+        )
+
+    def get_consuming_cost_bytes(self) -> int:
+        return self._total + sum(c.get_consuming_cost_bytes() for _, _, c in self._members)

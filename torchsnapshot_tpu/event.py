@@ -1,0 +1,12 @@
+"""Telemetry event model (reference torchsnapshot/event.py:15-27)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+
+@dataclass
+class Event:
+    name: str
+    metadata: Dict[str, Any] = field(default_factory=dict)

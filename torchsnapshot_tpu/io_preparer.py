@@ -57,7 +57,7 @@ def prepare_write(
     is_async_snapshot: bool = False,
 ) -> Tuple[Entry, List[WriteReq]]:
     if PrimitiveEntry.supports(obj) and not isinstance(obj, np.generic):
-        return PrimitiveEntry.from_object(obj), []
+        return PrimitiveEntry.from_object(obj, replicated=replicated), []
 
     storage_path = get_storage_path(obj, logical_path, rank, replicated)
 

@@ -232,29 +232,38 @@ class PrimitiveEntry(Entry):
     entry_type: str  # int | float | str | bool | bytes
     readable: str
     serialized: Optional[str] = None  # exact form for float/bytes
+    replicated: bool = False
 
     def __init__(
-        self, entry_type: str, readable: str, serialized: Optional[str] = None
+        self,
+        entry_type: str,
+        readable: str,
+        serialized: Optional[str] = None,
+        replicated: bool = False,
     ) -> None:
         super().__init__(type="primitive")
         self.entry_type = entry_type
         self.readable = readable
         self.serialized = serialized
+        self.replicated = replicated
 
     @classmethod
-    def from_object(cls, obj: Any) -> "PrimitiveEntry":
+    def from_object(cls, obj: Any, replicated: bool = False) -> "PrimitiveEntry":
         if isinstance(obj, bool):
-            return cls("bool", str(obj))
+            return cls("bool", str(obj), replicated=replicated)
         if isinstance(obj, int):
-            return cls("int", str(obj))
+            return cls("int", str(obj), replicated=replicated)
         if isinstance(obj, float):
             packed = base64.b64encode(struct.pack("<d", obj)).decode("ascii")
-            return cls("float", str(obj), serialized=packed)
+            return cls("float", str(obj), serialized=packed, replicated=replicated)
         if isinstance(obj, str):
-            return cls("str", obj)
+            return cls("str", obj, replicated=replicated)
         if isinstance(obj, bytes):
             return cls(
-                "bytes", repr(obj), serialized=base64.b64encode(obj).decode("ascii")
+                "bytes",
+                repr(obj),
+                serialized=base64.b64encode(obj).decode("ascii"),
+                replicated=replicated,
             )
         raise TypeError(f"Unsupported primitive type: {type(obj)}")
 
@@ -335,7 +344,11 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
     elif isinstance(entry, (DictEntry, OrderedDictEntry)):
         d["keys"] = entry.keys
     elif isinstance(entry, PrimitiveEntry):
-        d.update(entry_type=entry.entry_type, readable=entry.readable)
+        d.update(
+            entry_type=entry.entry_type,
+            readable=entry.readable,
+            replicated=entry.replicated,
+        )
         if entry.serialized is not None:
             d["serialized"] = entry.serialized
     elif isinstance(entry, (ListEntry, TupleEntry)):
@@ -396,6 +409,7 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
             entry_type=d["entry_type"],
             readable=d["readable"],
             serialized=d.get("serialized"),
+            replicated=bool(d.get("replicated", False)),
         )
     raise ValueError(f"Unknown manifest entry type: {typ}")
 

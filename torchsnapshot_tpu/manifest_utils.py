@@ -1,0 +1,102 @@
+"""Manifest entry predicates + replica-group math.
+
+TPU-native analogue of the reference's ``torchsnapshot/manifest_utils.py``
+(/root/reference/torchsnapshot/manifest_utils.py:36-107).  With the unified
+:class:`ShardedArrayEntry` the predicates simplify: an entry is sharded iff it
+is a ShardedArrayEntry (fully-replicated jax arrays are written as plain
+TensorEntry/ChunkedTensorEntry with ``replicated=True`` by the dispatch
+layer).
+
+Replica groups for partially-replicated (HSDP-style) arrays are derived from
+``mesh_shape``/``axis_names``/``partition_spec``: mesh axes not named in the
+partition spec are replication axes; slicing the process grid along sharded
+axes yields the rank sets that hold identical shards (the reference's
+``_get_replicated_ranks``, manifest_utils.py:70-107, reworked for named
+shardings).  The write-side partitioner additionally dedups concretely by
+(path, offsets, sizes) so this math is advisory, not load-bearing, for
+correctness.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List, Set
+
+import numpy as np
+
+from .manifest import (
+    ChunkedTensorEntry,
+    DictEntry,
+    Entry,
+    ListEntry,
+    OrderedDictEntry,
+    ShardedArrayEntry,
+    TupleEntry,
+)
+
+
+def is_container_entry(entry: Entry) -> bool:
+    return isinstance(entry, (ListEntry, TupleEntry, DictEntry, OrderedDictEntry))
+
+
+def is_dict_entry(entry: Entry) -> bool:
+    return isinstance(entry, (DictEntry, OrderedDictEntry))
+
+
+def is_sharded_entry(entry: Entry) -> bool:
+    return isinstance(entry, ShardedArrayEntry)
+
+
+def is_fully_replicated_entry(entry: Entry) -> bool:
+    if isinstance(entry, ShardedArrayEntry):
+        return False
+    return bool(getattr(entry, "replicated", False))
+
+
+def is_partially_replicated_entry(entry: Entry) -> bool:
+    """Sharded with at least one pure replication mesh axis (HSDP)."""
+    if not isinstance(entry, ShardedArrayEntry):
+        return False
+    if entry.mesh_shape is None or entry.partition_spec is None:
+        return False
+    sharded_axes = {a for dim in entry.partition_spec for a in dim}
+    assert entry.axis_names is not None
+    return 0 < len(sharded_axes) < len(entry.axis_names)
+
+
+def is_chunked_entry(entry: Entry) -> bool:
+    return isinstance(entry, ChunkedTensorEntry)
+
+
+def get_replicated_rank_sets(entry: ShardedArrayEntry, world_size: int) -> List[Set[int]]:
+    """Rank sets that hold identical shards, from the logical sharding.
+
+    Assumes the canonical process grid layout: processes laid out across the
+    mesh in device order, ``world_size`` dividing the device count evenly.
+    Returns [] when the sharding metadata is absent or inconsistent (callers
+    must then fall back to concrete (offsets, sizes) dedup).
+    """
+    if (
+        entry.mesh_shape is None
+        or entry.axis_names is None
+        or entry.partition_spec is None
+    ):
+        return []
+    n_devices = int(np.prod(entry.mesh_shape))
+    if world_size <= 0 or n_devices % world_size != 0:
+        return []
+    devices_per_rank = n_devices // world_size
+    rank_grid = (
+        np.arange(n_devices).reshape(entry.mesh_shape) // devices_per_rank
+    )
+    sharded_axes = {a for dim in entry.partition_spec for a in dim}
+    slices_per_dim = []
+    for axis_name, size in zip(entry.axis_names, entry.mesh_shape):
+        if axis_name in sharded_axes:
+            slices_per_dim.append([slice(i, i + 1) for i in range(size)])
+        else:
+            slices_per_dim.append([slice(None)])
+    return [
+        set(int(r) for r in rank_grid[s].flatten())
+        for s in itertools.product(*slices_per_dim)
+    ]

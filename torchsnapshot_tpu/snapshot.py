@@ -1,0 +1,709 @@
+"""The Snapshot API: take / async_take / restore / read_object.
+
+TPU-native analogue of the reference's ``torchsnapshot/snapshot.py``
+(/root/reference/torchsnapshot/snapshot.py:112-1068).  The orchestration
+protocol is preserved because it is device-agnostic and battle-tested:
+
+- per-stateful ``state_dict()`` calls run in global key order with barriers
+  (application code may itself issue collectives — reference :562-568)
+- replicated globs are verified by all-rank intersection (reference :637-670)
+- writes are deduped/balanced by the partitioner, then executed by the
+  budgeted scheduler
+- the manifest is gathered and ``.snapshot_metadata`` is committed by rank 0
+  only after all ranks' payloads are durable (barrier → commit, :202-209);
+  a missing metadata file IS the incomplete-snapshot signal (:847-856)
+- ``async_take`` returns after staging; a background thread drains I/O and
+  commits through a store-based two-phase barrier (no collectives off the
+  main thread — reference :962-1068)
+
+What is TPU-native here: replication is *detected, not declared* for GSPMD
+arrays (a fully-replicated jax.Array says so itself — the reference needed
+DDP module introspection, :896-912); staging is pjrt async D2H; restore
+targets are rebuilt with ``device_put`` per sharding.  Object collectives run
+over the KV-store coordination layer (pg_wrapper) instead of c10d.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import random
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from . import io_preparer, knobs, staging
+from .batcher import batch_read_requests, batch_write_requests
+from .dist_store import LinearBarrier, StorePeerError, make_barrier_prefix
+from .event import Event
+from .event_handlers import log_event
+from .flatten import flatten, inflate
+from .io_types import Future, ReadReq, StoragePlugin, WriteReq
+from .manifest import (
+    Entry,
+    Manifest,
+    PrimitiveEntry,
+    SnapshotMetadata,
+    MANIFEST_VERSION,
+)
+from .manifest_ops import get_manifest_for_rank, handle_sharded_array_elasticity
+from .manifest_utils import is_container_entry
+from .partitioner import consolidate_replicated_entries, partition_write_reqs
+from .pg_wrapper import PGWrapper
+from .rng_state import RNGState
+from .scheduler import (
+    PendingIOWork,
+    get_process_memory_budget_bytes,
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+from .stateful import AppState, Stateful
+from .storage_plugin import url_to_storage_plugin
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_METADATA_FNAME = ".snapshot_metadata"
+
+
+class Snapshot:
+    """A committed snapshot at ``path`` (any supported storage URL)."""
+
+    def __init__(
+        self,
+        path: str,
+        pg: Optional[PGWrapper] = None,
+    ) -> None:
+        self.path = path
+        self._pg = pg or PGWrapper()
+        self._metadata: Optional[SnapshotMetadata] = None
+
+    # ------------------------------------------------------------------ take
+
+    @classmethod
+    def take(
+        cls,
+        path: str,
+        app_state: AppState,
+        pg: Optional[PGWrapper] = None,
+        replicated: Optional[List[str]] = None,
+        _custom_tensor_prepare_func: Optional[Callable] = None,
+    ) -> "Snapshot":
+        pg = pg or PGWrapper()
+        unique_id = _gen_unique_id(pg)
+        event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
+        log_event(Event(name="take.start", metadata=dict(event_metadata)))
+        begin = time.monotonic()
+        try:
+            cls._validate_app_state(app_state)
+            path, replicated_patterns = cls._coalesce_path_and_replicated(
+                path, pg, replicated or []
+            )
+            storage = url_to_storage_plugin(path)
+            pending_io_work, metadata = cls._take_impl(
+                path=path,
+                app_state=app_state,
+                replicated_patterns=replicated_patterns,
+                storage=storage,
+                pg=pg,
+                is_async_snapshot=False,
+            )
+            pending_io_work.sync_complete()
+            # All ranks' payloads durable → rank 0 commits (reference :202-209).
+            pg.barrier()
+            if pg.get_rank() == 0:
+                cls._write_snapshot_metadata(metadata, storage)
+            pg.barrier()
+            storage.sync_close()
+            snapshot = cls(path=path, pg=pg)
+            snapshot._metadata = metadata
+            event_metadata["duration_s"] = time.monotonic() - begin
+            event_metadata["is_success"] = True
+            log_event(Event(name="take.end", metadata=event_metadata))
+            return snapshot
+        except Exception:
+            event_metadata["is_success"] = False
+            log_event(Event(name="take.end", metadata=event_metadata))
+            raise
+
+    @classmethod
+    def async_take(
+        cls,
+        path: str,
+        app_state: AppState,
+        pg: Optional[PGWrapper] = None,
+        replicated: Optional[List[str]] = None,
+    ) -> "PendingSnapshot":
+        """Returns once all state is staged to host memory; storage I/O and
+        the metadata commit continue on a background thread
+        (reference :229-317).  Training may resume — and donate device
+        buffers — immediately."""
+        pg = pg or PGWrapper()
+        unique_id = _gen_unique_id(pg)
+        event_metadata = {
+            "unique_id": unique_id,
+            "rank": pg.get_rank(),
+            "action": "async_take",
+        }
+        log_event(Event(name="async_take.start", metadata=dict(event_metadata)))
+        cls._validate_app_state(app_state)
+        path, replicated_patterns = cls._coalesce_path_and_replicated(
+            path, pg, replicated or []
+        )
+        storage = url_to_storage_plugin(path)
+        pending_io_work, metadata = cls._take_impl(
+            path=path,
+            app_state=app_state,
+            replicated_patterns=replicated_patterns,
+            storage=storage,
+            pg=pg,
+            is_async_snapshot=True,
+        )
+        return PendingSnapshot(
+            path=path,
+            pending_io_work=pending_io_work,
+            pg=pg,
+            metadata=metadata,
+            storage=storage,
+            unique_id=unique_id,
+        )
+
+    @classmethod
+    def _take_impl(
+        cls,
+        path: str,
+        app_state: AppState,
+        replicated_patterns: List[str],
+        storage: StoragePlugin,
+        pg: PGWrapper,
+        is_async_snapshot: bool,
+    ) -> Tuple[PendingIOWork, SnapshotMetadata]:
+        rank = pg.get_rank()
+        world_size = pg.get_world_size()
+
+        app_state = dict(app_state)
+        rng_state_item = cls._pop_rng_state(app_state)
+
+        # Taking a snapshot must not perturb RNG state (reference :532-574).
+        py_rng_state, np_rng_state = random.getstate(), np.random.get_state()
+
+        manifest: Manifest = {}
+        flattened: Dict[str, Any] = {}
+        global_keys = cls._gather_keys(app_state, pg)
+        for key in global_keys:
+            if key not in app_state:
+                raise RuntimeError(
+                    f"Rank {rank} is missing app_state key {key!r} present on "
+                    "other ranks; all ranks must snapshot the same keys"
+                )
+            # Ordered loop + barrier: the application's state_dict() may
+            # itself run collectives (reference :562-568).
+            state_dict = app_state[key].state_dict()
+            key_manifest, key_flattened = flatten(state_dict, prefix=key)
+            manifest.update(key_manifest)
+            flattened.update(key_flattened)
+            pg.barrier()
+
+        if rng_state_item is not None:
+            key, stateful = rng_state_item
+            state_dict = stateful.state_dict()
+            key_manifest, key_flattened = flatten(state_dict, prefix=key)
+            manifest.update(key_manifest)
+            flattened.update(key_flattened)
+
+        random.setstate(py_rng_state)
+        np.random.set_state(np_rng_state)
+
+        replicated_paths = cls._calculate_replicated_entries(
+            flattened, replicated_patterns, pg
+        )
+
+        entries: Manifest = dict(manifest)
+        write_reqs: List[WriteReq] = []
+        for logical_path, obj in flattened.items():
+            entry, obj_write_reqs = io_preparer.prepare_write(
+                obj=obj,
+                logical_path=logical_path,
+                rank=rank,
+                replicated=logical_path in replicated_paths,
+                is_async_snapshot=is_async_snapshot,
+            )
+            entries[logical_path] = entry
+            write_reqs += obj_write_reqs
+
+        entries, write_reqs = partition_write_reqs(entries, write_reqs, pg)
+
+        if not knobs.is_batching_disabled():
+            entries, write_reqs = batch_write_requests(entries, write_reqs)
+
+        global_manifest = cls._gather_manifest(entries, pg)
+        metadata = SnapshotMetadata(
+            version=MANIFEST_VERSION,
+            world_size=world_size,
+            manifest=global_manifest,
+        )
+        memory_budget_bytes = get_process_memory_budget_bytes(pg)
+        pending_io_work = sync_execute_write_reqs(
+            write_reqs=write_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+        )
+        return pending_io_work, metadata
+
+    # --------------------------------------------------------------- restore
+
+    def restore(self, app_state: AppState) -> None:
+        """Restores the app state in-place (reference :319-395)."""
+        self._validate_app_state(app_state)
+        pg = self._pg
+        rank = pg.get_rank()
+        event_metadata = {
+            "unique_id": _gen_unique_id(pg),
+            "rank": rank,
+            "action": "restore",
+        }
+        log_event(Event(name="restore.start", metadata=dict(event_metadata)))
+        begin = time.monotonic()
+        try:
+            storage = url_to_storage_plugin(self.path)
+            metadata = self._get_metadata(storage)
+            app_state = dict(app_state)
+            rng_state_item = self._pop_rng_state(app_state)
+            global_keys = self._gather_keys(app_state, pg)
+            memory_budget_bytes = get_process_memory_budget_bytes(pg)
+            for key in global_keys:
+                if key not in app_state:
+                    raise RuntimeError(
+                        f"Rank {rank} is missing app_state key {key!r}"
+                    )
+                self._load_stateful(
+                    stateful_key=key,
+                    stateful=app_state[key],
+                    metadata=metadata,
+                    storage=storage,
+                    memory_budget_bytes=memory_budget_bytes,
+                    pg=pg,
+                )
+                pg.barrier()
+            # RNG restored last so nothing later perturbs it (reference
+            # :371-381).
+            if rng_state_item is not None:
+                key, stateful = rng_state_item
+                self._load_stateful(
+                    stateful_key=key,
+                    stateful=stateful,
+                    metadata=metadata,
+                    storage=storage,
+                    memory_budget_bytes=memory_budget_bytes,
+                    pg=pg,
+                )
+            storage.sync_close()
+            event_metadata["duration_s"] = time.monotonic() - begin
+            event_metadata["is_success"] = True
+            log_event(Event(name="restore.end", metadata=event_metadata))
+        except Exception:
+            event_metadata["is_success"] = False
+            log_event(Event(name="restore.end", metadata=event_metadata))
+            raise
+
+    def _load_stateful(
+        self,
+        stateful_key: str,
+        stateful: Stateful,
+        metadata: SnapshotMetadata,
+        storage: StoragePlugin,
+        memory_budget_bytes: int,
+        pg: PGWrapper,
+    ) -> None:
+        rank = pg.get_rank()
+        local_manifest, merged_entries = get_manifest_for_rank(metadata, rank)
+
+        # Current state dict provides in-place restore targets, avoiding 2x
+        # memory (reference :743-762).
+        state_dict = stateful.state_dict()
+        _, target_flattened = flatten(state_dict, prefix=stateful_key)
+
+        tensor_requests = [
+            path
+            for path, obj in target_flattened.items()
+            if staging.is_jax_array(obj) or isinstance(obj, np.ndarray)
+        ]
+        handle_sharded_array_elasticity(
+            local_manifest, merged_entries, tensor_requests
+        )
+
+        # Select this stateful's subtree.
+        prefix = stateful_key + "/"
+        sub_manifest = {
+            path: entry
+            for path, entry in local_manifest.items()
+            if path == stateful_key or path.startswith(prefix)
+        }
+        if not sub_manifest:
+            logger.warning(
+                "No entries for stateful %r in snapshot (rank %d)",
+                stateful_key,
+                rank,
+            )
+            return
+
+        read_reqs: List[ReadReq] = []
+        futures: Dict[str, Future] = {}
+        container_entries: Manifest = {}
+        for path, entry in sub_manifest.items():
+            if is_container_entry(entry):
+                container_entries[path] = entry
+                continue
+            obj_out = target_flattened.get(path)
+            entry_read_reqs, fut = io_preparer.prepare_read(entry, obj_out)
+            read_reqs += entry_read_reqs
+            futures[path] = fut
+
+        read_reqs = batch_read_requests(read_reqs)
+        sync_execute_read_reqs(
+            read_reqs=read_reqs,
+            storage=storage,
+            memory_budget_bytes=memory_budget_bytes,
+            rank=rank,
+        )
+
+        resolved = {path: fut.obj for path, fut in futures.items()}
+        restored_state_dict = inflate(
+            container_entries, resolved, prefix=stateful_key
+        )
+        stateful.load_state_dict(restored_state_dict)
+
+    # ----------------------------------------------------------- read_object
+
+    def read_object(
+        self,
+        path: str,
+        obj_out: Optional[Any] = None,
+        memory_budget_bytes: Optional[int] = None,
+    ) -> Any:
+        """Random access to one value: ``path`` is ``"<rank>/<logical_path>"``
+        (reference :397-501)."""
+        event_metadata = {
+            "unique_id": _gen_unique_id(self._pg),
+            "rank": self._pg.get_rank(),
+            "action": "read_object",
+        }
+        log_event(Event(name="read_object.start", metadata=dict(event_metadata)))
+        try:
+            rank_str, _, logical_path = path.partition("/")
+            storage = url_to_storage_plugin(self.path)
+            metadata = self._get_metadata(storage)
+            manifest, _ = get_manifest_for_rank(metadata, int(rank_str))
+            if logical_path not in manifest:
+                raise RuntimeError(
+                    f"Path {path!r} does not exist in the snapshot (available "
+                    f"under rank {rank_str}: {sorted(manifest.keys())[:20]}...)"
+                )
+            entry = manifest[logical_path]
+            if isinstance(entry, PrimitiveEntry):
+                # No storage I/O needed (reference :467-468).
+                return entry.get_value()
+            read_reqs, fut = io_preparer.prepare_read(
+                entry,
+                obj_out,
+                buffer_size_limit_bytes=memory_budget_bytes,
+            )
+            read_reqs = batch_read_requests(read_reqs)
+            sync_execute_read_reqs(
+                read_reqs=read_reqs,
+                storage=storage,
+                memory_budget_bytes=memory_budget_bytes
+                or get_process_memory_budget_bytes(self._pg),
+                rank=self._pg.get_rank(),
+            )
+            storage.sync_close()
+            event_metadata["is_success"] = True
+            log_event(Event(name="read_object.end", metadata=event_metadata))
+            return fut.obj
+        except Exception:
+            event_metadata["is_success"] = False
+            log_event(Event(name="read_object.end", metadata=event_metadata))
+            raise
+
+    def get_manifest(self) -> Dict[str, Entry]:
+        """A copy of the global manifest (reference :503-516)."""
+        storage = url_to_storage_plugin(self.path)
+        metadata = self._get_metadata(storage)
+        storage.sync_close()
+        return dict(metadata.manifest)
+
+    def get_state_dict_for_key(self, key: str) -> Dict[str, Any]:
+        """Materialize the full (merged across ranks) state dict saved under
+        an app-state key, without a target stateful (reference :684-726)."""
+        storage = url_to_storage_plugin(self.path)
+        metadata = self._get_metadata(storage)
+        local_manifest, _ = get_manifest_for_rank(metadata, 0)
+        prefix = key + "/"
+        sub_manifest = {
+            path: entry
+            for path, entry in local_manifest.items()
+            if path == key or path.startswith(prefix)
+        }
+        if not sub_manifest:
+            raise RuntimeError(f"Key {key!r} not found in snapshot manifest")
+        read_reqs: List[ReadReq] = []
+        futures: Dict[str, Future] = {}
+        container_entries: Manifest = {}
+        for path, entry in sub_manifest.items():
+            if is_container_entry(entry):
+                container_entries[path] = entry
+                continue
+            entry_read_reqs, fut = io_preparer.prepare_read(entry, None)
+            read_reqs += entry_read_reqs
+            futures[path] = fut
+        read_reqs = batch_read_requests(read_reqs)
+        sync_execute_read_reqs(
+            read_reqs=read_reqs,
+            storage=storage,
+            memory_budget_bytes=get_process_memory_budget_bytes(self._pg),
+            rank=self._pg.get_rank(),
+        )
+        storage.sync_close()
+        resolved = {path: fut.obj for path, fut in futures.items()}
+        return inflate(container_entries, resolved, prefix=key)
+
+    # --------------------------------------------------------------- helpers
+
+    @property
+    def metadata(self) -> SnapshotMetadata:
+        storage = url_to_storage_plugin(self.path)
+        md = self._get_metadata(storage)
+        storage.sync_close()
+        return md
+
+    def _get_metadata(self, storage: StoragePlugin) -> SnapshotMetadata:
+        if self._metadata is None:
+            from .io_types import ReadIO
+
+            read_io = ReadIO(path=SNAPSHOT_METADATA_FNAME)
+            try:
+                storage.sync_read(read_io)
+            except Exception as e:
+                raise RuntimeError(
+                    f"{self.path} does not appear to be a valid snapshot: "
+                    f"missing or unreadable {SNAPSHOT_METADATA_FNAME} ({e}). "
+                    "The snapshot may be incomplete (metadata commits last)."
+                ) from None
+            self._metadata = SnapshotMetadata.from_json(
+                bytes(read_io.buf).decode("utf-8")
+            )
+        return self._metadata
+
+    @staticmethod
+    def _write_snapshot_metadata(
+        metadata: SnapshotMetadata, storage: StoragePlugin
+    ) -> None:
+        from .io_types import WriteIO
+
+        storage.sync_write(
+            WriteIO(
+                path=SNAPSHOT_METADATA_FNAME,
+                buf=metadata.to_json().encode("utf-8"),
+            )
+        )
+
+    @staticmethod
+    def _validate_app_state(app_state: AppState) -> None:
+        for key, value in app_state.items():
+            if not (
+                hasattr(value, "state_dict") and hasattr(value, "load_state_dict")
+            ):
+                raise TypeError(
+                    f"app_state[{key!r}] (type {type(value).__name__}) is not "
+                    "Stateful: it must define state_dict()/load_state_dict(). "
+                    "Wrap plain values/pytrees in "
+                    "torchsnapshot_tpu.StateDict."
+                )
+
+    @staticmethod
+    def _gather_keys(app_state: AppState, pg: PGWrapper) -> List[str]:
+        """Sorted union of app-state keys across ranks (reference :920-925)."""
+        gathered = pg.all_gather_object(sorted(app_state.keys()))
+        keys: Set[str] = set()
+        for k in gathered:
+            keys.update(k)
+        return sorted(keys)
+
+    @staticmethod
+    def _pop_rng_state(
+        app_state: Dict[str, Stateful],
+    ) -> Optional[Tuple[str, RNGState]]:
+        """RNG statefuls are saved last / restored last so state_dict calls of
+        other statefuls can't perturb them (reference :539-574)."""
+        rng_keys = [k for k, v in app_state.items() if isinstance(v, RNGState)]
+        if len(rng_keys) > 1:
+            raise RuntimeError(
+                f"App state cannot have more than one RNGState: {rng_keys}"
+            )
+        if rng_keys:
+            key = rng_keys[0]
+            return key, app_state.pop(key)  # type: ignore[return-value]
+        return None
+
+    @staticmethod
+    def _coalesce_path_and_replicated(
+        path: str, pg: PGWrapper, replicated: List[str]
+    ) -> Tuple[str, List[str]]:
+        """Rank 0's path wins; replicated glob lists are unioned across ranks
+        (reference :858-894)."""
+        obj_list = [(path, sorted(set(replicated)))]
+        pg.broadcast_object_list(obj_list, src=0)
+        coalesced_path = obj_list[0][0]
+        gathered = pg.all_gather_object(sorted(set(replicated)))
+        union: Set[str] = set()
+        for pats in gathered:
+            union.update(pats)
+        return coalesced_path, sorted(union)
+
+    @staticmethod
+    def _calculate_replicated_entries(
+        flattened: Dict[str, Any], replicated_patterns: List[str], pg: PGWrapper
+    ) -> Set[str]:
+        """Paths marked replicated = (glob matches ∪ self-evidently
+        replicated GSPMD arrays), verified by all-rank intersection
+        (reference :576-670)."""
+        candidates = {
+            path
+            for path in flattened
+            if any(fnmatch.fnmatch(path, pat) for pat in replicated_patterns)
+        }
+        for path, obj in flattened.items():
+            if staging.is_fully_replicated(obj):
+                candidates.add(path)
+        if pg.get_world_size() == 1:
+            return candidates
+        gathered = pg.all_gather_object(sorted(candidates))
+        verified = set(gathered[0])
+        for paths in gathered[1:]:
+            verified &= set(paths)
+        dropped = candidates - verified
+        if dropped:
+            logger.warning(
+                "Paths marked replicated on this rank but not all ranks "
+                "(flag dropped): %s",
+                sorted(dropped)[:10],
+            )
+        return verified
+
+    @staticmethod
+    def _gather_manifest(entries: Manifest, pg: PGWrapper) -> Manifest:
+        """All-gather per-rank entries, consolidate replicated copies, build
+        the rank-prefixed global manifest (reference :948-959, 620-635)."""
+        gathered: List[Manifest] = pg.all_gather_object(entries)
+        gathered = consolidate_replicated_entries(gathered)
+        global_manifest: Manifest = {}
+        for rank, rank_entries in enumerate(gathered):
+            for logical_path, entry in rank_entries.items():
+                global_manifest[f"{rank}/{logical_path}"] = entry
+        return global_manifest
+
+
+class PendingSnapshot:
+    """Handle for an in-flight async snapshot (reference :962-1068).
+
+    The background thread must not issue collectives (reference :1010);
+    cross-rank commit coordination runs through the store-based
+    :class:`LinearBarrier` instead.
+    """
+
+    DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+
+    def __init__(
+        self,
+        path: str,
+        pending_io_work: PendingIOWork,
+        pg: PGWrapper,
+        metadata: SnapshotMetadata,
+        storage: StoragePlugin,
+        unique_id: str,
+    ) -> None:
+        self.path = path
+        self.pg = pg
+        self._metadata = metadata
+        self._storage = storage
+        self._unique_id = unique_id
+        self.exception: Optional[BaseException] = None
+        self._done_event = threading.Event()
+        self._thread = threading.Thread(
+            target=self._complete_snapshot,
+            args=(pending_io_work,),
+            name="tpusnap-pending-snapshot",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _complete_snapshot(self, pending_io_work: PendingIOWork) -> None:
+        barrier = None
+        store = self.pg.store
+        if store is not None and self.pg.get_world_size() > 1:
+            barrier = LinearBarrier(
+                prefix=f"pending_snapshot/{self._unique_id}",
+                store=store,
+                rank=self.pg.get_rank(),
+                world_size=self.pg.get_world_size(),
+            )
+        try:
+            pending_io_work.sync_complete()
+            if barrier is not None:
+                barrier.arrive(timeout_s=self.DEFAULT_BARRIER_TIMEOUT_S)
+            if self.pg.get_rank() == 0:
+                Snapshot._write_snapshot_metadata(self._metadata, self._storage)
+            if barrier is not None:
+                barrier.depart(timeout_s=self.DEFAULT_BARRIER_TIMEOUT_S)
+            self._storage.sync_close()
+            log_event(
+                Event(
+                    name="async_take.end",
+                    metadata={
+                        "unique_id": self._unique_id,
+                        "rank": self.pg.get_rank(),
+                        "is_success": True,
+                    },
+                )
+            )
+        except BaseException as e:  # noqa: BLE001
+            self.exception = e
+            if barrier is not None and not isinstance(e, StorePeerError):
+                try:
+                    barrier.report_error(repr(e))
+                except Exception:
+                    pass
+            log_event(
+                Event(
+                    name="async_take.end",
+                    metadata={
+                        "unique_id": self._unique_id,
+                        "rank": self.pg.get_rank(),
+                        "is_success": False,
+                    },
+                )
+            )
+        finally:
+            self._done_event.set()
+
+    def wait(self) -> Snapshot:
+        """Blocks until commit; raises if any rank failed (reference
+        :1056-1062)."""
+        self._thread.join()
+        if self.exception is not None:
+            raise self.exception
+        snapshot = Snapshot(path=self.path, pg=self.pg)
+        snapshot._metadata = self._metadata
+        return snapshot
+
+    def done(self) -> bool:
+        return self._done_event.is_set()
+
+
+def _gen_unique_id(pg: PGWrapper) -> str:
+    obj_list = [uuid.uuid4().hex]
+    pg.broadcast_object_list(obj_list, src=0)
+    return obj_list[0]
