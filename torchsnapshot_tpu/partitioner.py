@@ -16,18 +16,31 @@ dedup **by storage path** across ranks.  Two classes of shared paths exist:
 Rank 0 greedily assigns each shared path (largest first) to its least-loaded
 candidate rank, seeding loads with each rank's private (rank-namespaced)
 bytes (reference ``_partition_write_loads``, partitioner.py:50-104); the
-assignment is broadcast and each rank keeps only its share.  Chunked tensors
-partition chunk-by-chunk for free because every chunk is its own path
-(reference needed explicit sub-partitioning, partitioner.py:40-48).
+assignment is broadcast, and each rank keeps only its assigned write reqs
+AND prunes its manifest entries to match (replicated entries survive only on
+their writer rank; sharded entries keep only locally-written shard records;
+replicated chunked entries keep only assigned chunks) — so any later
+location rewriting (batcher slabs) happens on exactly the entry copy that
+will reach the global manifest.  ``consolidate_replicated_entries`` then
+collects the writer-rank replicated entries into rank 0's manifest (merging
+chunk lists), mirroring reference partitioner.py:284-355.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .io_types import WriteReq
-from .manifest import Entry, Manifest
+from .manifest import (
+    ChunkedTensorEntry,
+    Entry,
+    Manifest,
+    ObjectEntry,
+    ShardedArrayEntry,
+    TensorEntry,
+)
+from .manifest_utils import is_fully_replicated_entry
 from .pg_wrapper import PGWrapper
 
 logger = logging.getLogger(__name__)
@@ -40,7 +53,7 @@ def _is_shared_path(path: str) -> bool:
 def partition_write_reqs(
     entries: Manifest, write_reqs: List[WriteReq], pg: PGWrapper
 ) -> Tuple[Manifest, List[WriteReq]]:
-    """Returns (entries, this rank's write reqs after dedup/balancing)."""
+    """Returns (pruned entries, this rank's write reqs after dedup/balance)."""
     world_size = pg.get_world_size()
     if world_size == 1:
         return entries, write_reqs
@@ -76,35 +89,91 @@ def partition_write_reqs(
     assignment = assignment_list[0]
 
     rank = pg.get_rank()
-    kept = [
-        wr
-        for wr in write_reqs
-        if not _is_shared_path(wr.path) or assignment.get(wr.path) == rank
-    ]
-    dropped = len(write_reqs) - len(kept)
+
+    def _mine(path: str) -> bool:
+        return not _is_shared_path(path) or assignment.get(path) == rank
+
+    kept_reqs = [wr for wr in write_reqs if _mine(wr.path)]
+
+    pruned: Manifest = {}
+    for logical_path, entry in entries.items():
+        pruned_entry = _prune_entry(entry, _mine)
+        if pruned_entry is not None:
+            pruned[logical_path] = pruned_entry
+
+    dropped = len(write_reqs) - len(kept_reqs)
     if dropped:
         logger.debug("[rank %d] partitioner dropped %d duplicate writes", rank, dropped)
-    return entries, kept
+    return pruned, kept_reqs
+
+
+def _prune_entry(entry: Entry, mine) -> Optional[Entry]:
+    """Drop (parts of) an entry whose payload this rank will not write.
+    Container/primitive entries carry no payload and always survive."""
+    if isinstance(entry, ShardedArrayEntry):
+        shards = [s for s in entry.shards if mine(s.tensor.location)]
+        if not shards and entry.shards:
+            return None
+        return ShardedArrayEntry(
+            dtype=entry.dtype,
+            shape=entry.shape,
+            shards=shards,
+            mesh_shape=entry.mesh_shape,
+            axis_names=entry.axis_names,
+            partition_spec=entry.partition_spec,
+        )
+    if isinstance(entry, ChunkedTensorEntry) and entry.replicated:
+        chunks = [c for c in entry.chunks if mine(c.tensor.location)]
+        if not chunks:
+            return None
+        return ChunkedTensorEntry(
+            dtype=entry.dtype,
+            shape=entry.shape,
+            chunks=chunks,
+            replicated=True,
+        )
+    if isinstance(entry, (TensorEntry, ObjectEntry)) and entry.replicated:
+        if not mine(entry.location):
+            return None
+        return entry
+    return entry
 
 
 def consolidate_replicated_entries(
     rank_to_entries: List[Manifest],
 ) -> List[Manifest]:
-    """Keep fully-replicated entries only in rank 0's manifest (reference
-    consolidate_replicated_entries, partitioner.py:311-368): restore re-injects
-    them for every rank (manifest_ops._manifest_for_existing_rank)."""
-    from .manifest_utils import is_fully_replicated_entry
-
+    """Collect writer-rank replicated entries into rank 0's manifest, merging
+    partitioned chunked entries (reference consolidate_replicated_entries +
+    _consolidate_replicated_chunked_tensor_entries, partitioner.py:284-355).
+    Restore re-injects them for every rank
+    (manifest_ops._manifest_for_existing_rank)."""
+    chunked_groups: Dict[str, List[ChunkedTensorEntry]] = {}
+    replicated: Dict[str, Entry] = {}
     out: List[Manifest] = []
-    for rank, entries in enumerate(rank_to_entries):
-        if rank == 0:
-            out.append(dict(entries))
-            continue
-        out.append(
-            {
-                path: entry
-                for path, entry in entries.items()
-                if not is_fully_replicated_entry(entry)
-            }
+    for entries in rank_to_entries:
+        kept: Manifest = {}
+        for logical_path, entry in entries.items():
+            if not is_fully_replicated_entry(entry):
+                kept[logical_path] = entry
+                continue
+            if isinstance(entry, ChunkedTensorEntry):
+                chunked_groups.setdefault(logical_path, []).append(entry)
+            elif logical_path not in replicated:
+                replicated[logical_path] = entry
+        out.append(kept)
+
+    for logical_path, group in chunked_groups.items():
+        merged_chunks = sorted(
+            (chunk for e in group for chunk in e.chunks),
+            key=lambda c: c.offsets,
         )
+        replicated[logical_path] = ChunkedTensorEntry(
+            dtype=group[0].dtype,
+            shape=group[0].shape,
+            chunks=merged_chunks,
+            replicated=True,
+        )
+
+    if out:
+        out[0].update(replicated)
     return out

@@ -84,17 +84,14 @@ def rand_state_dict(seed: int, shapes: Dict[str, tuple]) -> Dict[str, np.ndarray
 
 
 def _proc_entry(
-    fn_pickle: bytes, rank: int, world_size: int, store_path: str, conn: Any
+    fn: Callable, rank: int, world_size: int, store_path: str, conn: Any
 ) -> None:
-    import pickle
-
     os.environ["TPUSNAP_STORE_PATH"] = store_path
     os.environ["TPUSNAP_RANK"] = str(rank)
     os.environ["TPUSNAP_WORLD_SIZE"] = str(world_size)
     # Subprocesses run on the CPU backend (tests): single device per proc.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
-        fn = pickle.loads(fn_pickle)
         fn()
         conn.send(None)
     except BaseException:  # noqa: BLE001
@@ -122,18 +119,15 @@ def run_with_procs(nproc: int) -> Callable:
     def decorator(fn: Callable) -> Callable:
         @functools.wraps(fn)
         def wrapper(*args: Any, **kwargs: Any) -> None:
-            import pickle
-
             ctx = mp.get_context("fork")
             with tempfile.TemporaryDirectory() as store_path:
-                fn_pickle = pickle.dumps(fn)
                 procs = []
                 conns = []
                 for rank in range(nproc):
                     parent_conn, child_conn = ctx.Pipe()
                     p = ctx.Process(
                         target=_proc_entry,
-                        args=(fn_pickle, rank, nproc, store_path, child_conn),
+                        args=(fn, rank, nproc, store_path, child_conn),
                     )
                     p.start()
                     procs.append(p)
