@@ -1,0 +1,131 @@
+"""Native TCP store + native file IO tests."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.test_utils import run_with_procs
+
+
+def _native_available():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    return get_native_lib_path() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native library failed to build"
+)
+
+
+def test_server_client_basics():
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        client = TCPStore("127.0.0.1", server.port)
+        client.set("k1", b"hello")
+        assert client.get("k1", timeout_s=5) == b"hello"
+        assert client.try_get("k1") == b"hello"
+        assert client.try_get("missing") is None
+        assert client.add("counter", 3) == 3
+        assert client.add("counter", 4) == 7
+        assert client.add("counter", 0) == 7
+        with pytest.raises(TimeoutError):
+            client.get("never", timeout_s=0.2)
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_blocking_get_wakes_on_set():
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        waiter = TCPStore("127.0.0.1", server.port)
+        setter = TCPStore("127.0.0.1", server.port)
+        result = {}
+
+        def _wait():
+            result["value"] = waiter.get("slow_key", timeout_s=10)
+
+        t = threading.Thread(target=_wait)
+        t.start()
+        import time
+
+        time.sleep(0.1)
+        setter.set("slow_key", b"payload")
+        t.join(timeout=5)
+        assert result["value"] == b"payload"
+        waiter.close()
+        setter.close()
+    finally:
+        server.stop()
+
+
+def test_large_value():
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        client = TCPStore("127.0.0.1", server.port)
+        blob = os.urandom(4 << 20)  # 4 MB manifest-sized object
+        client.set("big", blob)
+        assert client.get("big", timeout_s=10) == blob
+        client.close()
+    finally:
+        server.stop()
+
+
+@run_with_procs(nproc=4)
+def _tcpstore_pg_body():
+    """Full PGWrapper collectives over the native TCP store."""
+    from torchsnapshot_tpu.dist_store import FileStore
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    rank = int(os.environ["TPUSNAP_RANK"])
+    world_size = int(os.environ["TPUSNAP_WORLD_SIZE"])
+    bootstrap = FileStore(os.environ["TPUSNAP_STORE_PATH"])
+    if rank == 0:
+        server = TCPStoreServer()
+        bootstrap.set("addr", f"127.0.0.1:{server.port}".encode())
+    addr = bootstrap.get("addr", timeout_s=30).decode()
+    host, _, port = addr.rpartition(":")
+    store = TCPStore(host, int(port))
+    pg = PGWrapper(store=store, rank=rank, world_size=world_size)
+
+    gathered = pg.all_gather_object(rank * rank)
+    assert gathered == [0, 1, 4, 9]
+    pg.barrier()
+    objs = [None]
+    if rank == 0:
+        objs = ["cfg"]
+    pg.broadcast_object_list(objs, src=0)
+    assert objs[0] == "cfg"
+
+
+def test_tcpstore_collectives_multiprocess():
+    _tcpstore_pg_body()
+
+
+def test_native_file_io(tmp_path):
+    from torchsnapshot_tpu.native_io import NativeFileIO
+
+    io = NativeFileIO.maybe_create()
+    assert io is not None
+    path = str(tmp_path / "f.bin")
+    data = np.arange(1000, dtype=np.float32)
+    io.write_file(path, memoryview(data))
+    out = io.read_file(path, None)
+    np.testing.assert_array_equal(np.frombuffer(out, np.float32), data)
+    ranged = io.read_file(path, [400, 800])
+    np.testing.assert_array_equal(
+        np.frombuffer(ranged, np.float32), data[100:200]
+    )
+    # readonly buffer write
+    io.write_file(path, b"small")
+    assert bytes(io.read_file(path, None)) == b"small"

@@ -1,0 +1,48 @@
+"""Lazy build of the native library (g++ → libtpusnap.so).
+
+Built on first use and cached next to the source; rebuilt when the source is
+newer than the .so.  No pybind11 — the library exposes a C ABI consumed via
+ctypes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tpustore.cc")
+_LIB = os.path.join(_HERE, "libtpusnap.so")
+_LOCK = threading.Lock()
+
+
+def get_native_lib_path() -> Optional[str]:
+    """Path to the built library, building if needed; None if unavailable."""
+    with _LOCK:
+        try:
+            if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
+                _SRC
+            ):
+                return _LIB
+            cmd = [
+                "g++",
+                "-O2",
+                "-std=c++17",
+                "-shared",
+                "-fPIC",
+                "-pthread",
+                _SRC,
+                "-o",
+                _LIB + ".tmp",
+            ]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(_LIB + ".tmp", _LIB)
+            return _LIB
+        except Exception as e:  # noqa: BLE001
+            logger.warning("Native library unavailable (%s); using fallbacks", e)
+            return None

@@ -1,0 +1,406 @@
+// tpustore: TCP key-value store + native file I/O for checkpoint coordination.
+//
+// TPU-native replacement for the two native dependencies the reference leans
+// on (SURVEY.md §2.2): torch.distributed's C++ TCPStore
+// (/root/reference/torchsnapshot/dist_store.py:79-88 bootstraps one) and the
+// posix I/O data plane under aiofiles.  One .so, C ABI, driven from Python
+// via ctypes — no pybind11 required.
+//
+// Server: one acceptor thread + one handler thread per connection (metadata
+// traffic is tiny: entry dicts, write loads, barrier counters — SURVEY.md
+// §2.4).  State: bytes map + int counters, guarded by one mutex, with a
+// condition variable for blocking GETs/WAITs.
+//
+// Protocol (all integers little-endian uint32 unless noted):
+//   request:  op(1) keylen(4) key value_len(4) value
+//   response: status(1) value_len(4) value
+//   ops: 0=SET 1=GET(blocking, timeout_ms in value) 2=TRYGET
+//        3=ADD(int64 delta in value, returns int64) 4=PING
+//   status: 0=ok 1=not_found 2=timeout 3=error
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+int read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, p + got, n - got);
+    if (r == 0) return -1;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+int write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t put = 0;
+  while (put < n) {
+    ssize_t r = ::write(fd, p + put, n - put);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    put += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+bool send_response(int fd, uint8_t status, const std::string& value) {
+  uint32_t len = static_cast<uint32_t>(value.size());
+  std::string out;
+  out.reserve(5 + value.size());
+  out.push_back(static_cast<char>(status));
+  out.append(reinterpret_cast<const char*>(&len), 4);
+  out.append(value);
+  return write_full(fd, out.data(), out.size()) == 0;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread acceptor;
+  std::vector<std::thread> handlers;
+  std::mutex handlers_mu;
+  Store store;
+  volatile bool stopping = false;
+
+  void handle_conn(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      uint32_t keylen, vallen;
+      if (read_full(fd, &op, 1) < 0) break;
+      if (read_full(fd, &keylen, 4) < 0) break;
+      std::string key(keylen, '\0');
+      if (keylen && read_full(fd, &key[0], keylen) < 0) break;
+      if (read_full(fd, &vallen, 4) < 0) break;
+      std::string value(vallen, '\0');
+      if (vallen && read_full(fd, &value[0], vallen) < 0) break;
+
+      bool ok = true;
+      switch (op) {
+        case 0: {  // SET
+          {
+            std::lock_guard<std::mutex> lock(store.mu);
+            store.data[key] = value;
+          }
+          store.cv.notify_all();
+          ok = send_response(fd, 0, "");
+          break;
+        }
+        case 1: {  // blocking GET with timeout_ms payload
+          int64_t timeout_ms = 1800000;
+          if (value.size() == 8) memcpy(&timeout_ms, value.data(), 8);
+          std::unique_lock<std::mutex> lock(store.mu);
+          auto deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(timeout_ms);
+          bool found = store.cv.wait_until(lock, deadline, [&] {
+            return stopping || store.data.count(key) > 0;
+          });
+          if (stopping) { ok = send_response(fd, 3, ""); break; }
+          if (!found) {
+            ok = send_response(fd, 2, "");
+          } else {
+            ok = send_response(fd, 0, store.data[key]);
+          }
+          break;
+        }
+        case 2: {  // TRYGET
+          std::lock_guard<std::mutex> lock(store.mu);
+          auto it = store.data.find(key);
+          if (it == store.data.end()) {
+            ok = send_response(fd, 1, "");
+          } else {
+            ok = send_response(fd, 0, it->second);
+          }
+          break;
+        }
+        case 3: {  // ADD int64
+          int64_t delta = 0;
+          if (value.size() == 8) memcpy(&delta, value.data(), 8);
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> lock(store.mu);
+            int64_t current = 0;
+            auto it = store.data.find(key);
+            if (it != store.data.end() && it->second.size() == 8) {
+              memcpy(&current, it->second.data(), 8);
+            }
+            result = current + delta;
+            std::string packed(8, '\0');
+            memcpy(&packed[0], &result, 8);
+            store.data[key] = packed;
+          }
+          store.cv.notify_all();
+          std::string out(8, '\0');
+          memcpy(&out[0], &result, 8);
+          ok = send_response(fd, 0, out);
+          break;
+        }
+        case 4: {  // PING
+          ok = send_response(fd, 0, "");
+          break;
+        }
+        default:
+          ok = send_response(fd, 3, "");
+      }
+      if (!ok) break;
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping) return;
+        if (errno == EINTR) continue;
+        return;
+      }
+      std::lock_guard<std::mutex> lock(handlers_mu);
+      handlers.emplace_back([this, fd] { handle_conn(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::string last_value;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- server
+
+void* tpustore_server_start(int port) {
+  auto* srv = new Server();
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) { delete srv; return nullptr; }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(srv->listen_fd, 128) < 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  if (port == 0) {
+    socklen_t len = sizeof(addr);
+    getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  }
+  srv->port = ntohs(addr.sin_port);
+  srv->acceptor = std::thread([srv] { srv->accept_loop(); });
+  return srv;
+}
+
+int tpustore_server_port(void* handle) {
+  return static_cast<Server*>(handle)->port;
+}
+
+void tpustore_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  srv->stopping = true;
+  srv->store.cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->acceptor.joinable()) srv->acceptor.join();
+  {
+    std::lock_guard<std::mutex> lock(srv->handlers_mu);
+    for (auto& t : srv->handlers) {
+      if (t.joinable()) t.detach();  // blocked conns exit on closed fds
+    }
+  }
+  // Leak srv intentionally: detached handlers may still touch the store for
+  // a moment during teardown; process exit reclaims. (Servers are one per
+  // job, not churned.)
+}
+
+// ----------------------------------------------------------------- client
+
+void* tpustore_client_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* client = new Client();
+  client->fd = fd;
+  return client;
+}
+
+static int client_request(Client* c, uint8_t op, const char* key,
+                          const void* value, uint32_t value_len) {
+  std::string req;
+  uint32_t keylen = static_cast<uint32_t>(strlen(key));
+  req.push_back(static_cast<char>(op));
+  req.append(reinterpret_cast<const char*>(&keylen), 4);
+  req.append(key, keylen);
+  req.append(reinterpret_cast<const char*>(&value_len), 4);
+  if (value_len) req.append(static_cast<const char*>(value), value_len);
+  if (write_full(c->fd, req.data(), req.size()) < 0) return -1;
+  uint8_t status;
+  uint32_t resp_len;
+  if (read_full(c->fd, &status, 1) < 0) return -1;
+  if (read_full(c->fd, &resp_len, 4) < 0) return -1;
+  c->last_value.resize(resp_len);
+  if (resp_len && read_full(c->fd, &c->last_value[0], resp_len) < 0) return -1;
+  return static_cast<int>(status);
+}
+
+// returns status; value fetched with tpustore_client_value/_value_len
+int tpustore_client_set(void* handle, const char* key, const void* value,
+                        uint32_t value_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  return client_request(c, 0, key, value, value_len);
+}
+
+int tpustore_client_get(void* handle, const char* key, int64_t timeout_ms) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  return client_request(c, 1, key, &timeout_ms, 8);
+}
+
+int tpustore_client_tryget(void* handle, const char* key) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  return client_request(c, 2, key, nullptr, 0);
+}
+
+int tpustore_client_add(void* handle, const char* key, int64_t delta,
+                        int64_t* result) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  int status = client_request(c, 3, key, &delta, 8);
+  if (status == 0 && c->last_value.size() == 8) {
+    memcpy(result, c->last_value.data(), 8);
+  }
+  return status;
+}
+
+int tpustore_client_ping(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  return client_request(c, 4, "", nullptr, 0);
+}
+
+uint32_t tpustore_client_value_len(void* handle) {
+  return static_cast<uint32_t>(static_cast<Client*>(handle)->last_value.size());
+}
+
+void tpustore_client_value(void* handle, void* out) {
+  auto* c = static_cast<Client*>(handle);
+  memcpy(out, c->last_value.data(), c->last_value.size());
+}
+
+void tpustore_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+// ------------------------------------------------------------ file I/O
+// Native data plane for the fs storage plugin: plain p{read,write} with the
+// GIL released on the Python side (ctypes releases it for us).  Returns 0 on
+// success, -errno on failure.
+
+int tpusnap_write_file(const char* path, const void* buf, int64_t nbytes) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  const char* p = static_cast<const char*>(buf);
+  int64_t put = 0;
+  while (put < nbytes) {
+    ssize_t r = ::write(fd, p + put, static_cast<size_t>(nbytes - put));
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      int err = errno;
+      ::close(fd);
+      return -err;
+    }
+    put += r;
+  }
+  if (::close(fd) < 0) return -errno;
+  return 0;
+}
+
+int tpusnap_read_range(const char* path, void* buf, int64_t offset,
+                       int64_t nbytes) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  char* p = static_cast<char*>(buf);
+  int64_t got = 0;
+  while (got < nbytes) {
+    ssize_t r = ::pread(fd, p + got, static_cast<size_t>(nbytes - got),
+                        offset + got);
+    if (r == 0) break;
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      int err = errno;
+      ::close(fd);
+      return -err;
+    }
+    got += r;
+  }
+  ::close(fd);
+  return got == nbytes ? 0 : -EIO;
+}
+
+int64_t tpusnap_file_size(const char* path) {
+  struct stat st;
+  if (::stat(path, &st) < 0) return -errno;
+  return st.st_size;
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+"""Native file I/O data plane (ctypes over libtpusnap).
+
+Replaces aiofiles' thread-pooled Python I/O in the hot path (reference
+/root/reference/torchsnapshot/storage_plugins/fs.py): whole-buffer writes and
+(ranged) reads happen in one C call each, with the GIL released by ctypes for
+the entire syscall loop — no Python-level chunking overhead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+
+class NativeFileIO:
+    _instance: Optional["NativeFileIO"] = None
+    _failed = False
+
+    def __init__(self) -> None:
+        from ._native.build import get_native_lib_path
+
+        path = get_native_lib_path()
+        if path is None:
+            raise RuntimeError("native IO library unavailable")
+        lib = ctypes.CDLL(path)
+        lib.tpusnap_write_file.restype = ctypes.c_int
+        lib.tpusnap_write_file.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.tpusnap_read_range.restype = ctypes.c_int
+        lib.tpusnap_read_range.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.tpusnap_file_size.restype = ctypes.c_int64
+        lib.tpusnap_file_size.argtypes = [ctypes.c_char_p]
+        self._lib = lib
+
+    @classmethod
+    def maybe_create(cls) -> Optional["NativeFileIO"]:
+        if cls._failed:
+            return None
+        if cls._instance is None:
+            try:
+                cls._instance = cls()
+            except Exception:
+                cls._failed = True
+                return None
+        return cls._instance
+
+    def write_file(self, path: str, buf) -> None:
+        view = memoryview(buf)
+        if not view.c_contiguous:
+            view = memoryview(bytes(view))
+        nbytes = view.nbytes
+        if nbytes == 0:
+            with open(path, "wb"):
+                return
+        if view.readonly:
+            # bytes payloads (pickles, metadata) — small; one copy acceptable
+            c_buf: ctypes.Array = (ctypes.c_char * nbytes).from_buffer_copy(view)
+        else:
+            # zero-copy for staged array buffers (the hot path)
+            c_buf = (ctypes.c_char * nbytes).from_buffer(view)
+        rc = self._lib.tpusnap_write_file(path.encode(), c_buf, nbytes)
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)
+
+    def read_file(self, path: str, byte_range: Optional[List[int]]) -> bytearray:
+        if byte_range is None:
+            size = self._lib.tpusnap_file_size(path.encode())
+            if size < 0:
+                raise OSError(-size, os.strerror(-size), path)
+            offset, nbytes = 0, size
+        else:
+            offset = byte_range[0]
+            nbytes = byte_range[1] - byte_range[0]
+        out = bytearray(nbytes)
+        if nbytes:
+            c_buf = (ctypes.c_char * nbytes).from_buffer(out)
+            rc = self._lib.tpusnap_read_range(path.encode(), c_buf, offset, nbytes)
+            if rc != 0:
+                raise OSError(-rc, os.strerror(-rc), path)
+        return out
