@@ -107,6 +107,15 @@ def _tcpstore_pg_body():
     pg.broadcast_object_list(objs, src=0)
     assert objs[0] == "cfg"
 
+    # Keep the server alive until every rank is done with it.
+    bootstrap.add("done", 1)
+    if rank == 0:
+        i = 0
+        while bootstrap.add("done", 0) < world_size:
+            bootstrap.wait_hint(i)
+            i += 1
+        server.stop()
+
 
 def test_tcpstore_collectives_multiprocess():
     _tcpstore_pg_body()
