@@ -169,12 +169,19 @@ class ArrayBufferStager(BufferStager):
             self._obj = None
             return data
         if staging.is_jax_array(obj):
-            staging.enqueue_d2h(obj)
+            # Enqueue the async DMA now (we are being admitted by the
+            # scheduler), materialize in the executor so concurrent stagers'
+            # transfers overlap.
+            handle = staging.begin_d2h(obj)
+            dtype = serialization.string_to_dtype(self._entry.dtype)
+            shape = self._entry.shape
             loop = asyncio.get_event_loop()
             if executor is not None:
-                host = await loop.run_in_executor(executor, staging.to_host, obj)
+                host = await loop.run_in_executor(
+                    executor, staging.finish_d2h, handle, dtype, shape
+                )
             else:
-                host = staging.to_host(obj)
+                host = staging.finish_d2h(handle, dtype, shape)
         else:
             host = np.asarray(obj)
             if self._is_async_snapshot:

@@ -1,0 +1,10 @@
+from .llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_partition_specs,
+    shard_train_state,
+    state_partition_specs,
+)

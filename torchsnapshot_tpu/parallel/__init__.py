@@ -1,0 +1,1 @@
+from .mesh import factor_mesh, make_mesh

@@ -1,0 +1,52 @@
+"""Device-mesh helpers for dp/fsdp/tp(/sp) layouts.
+
+The checkpoint layer is sharding-agnostic (it reads shardings off
+``jax.Array``s); these helpers standardize how benchmark/demo workloads build
+meshes so collectives ride ICI within a slice: the model axis innermost
+(highest-bandwidth neighbor links), fsdp next, data outermost (may span DCN).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    data: int = 1,
+    fsdp: int = -1,
+    model: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Mesh with axes (data, fsdp, model); ``fsdp=-1`` absorbs the rest."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if fsdp == -1:
+        if n % (data * model) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by data*model={data * model}"
+            )
+        fsdp = n // (data * model)
+    if data * fsdp * model != n:
+        raise ValueError(
+            f"mesh {data}x{fsdp}x{model} != {n} devices"
+        )
+    grid = np.array(devices).reshape(data, fsdp, model)
+    return Mesh(grid, ("data", "fsdp", "model"))
+
+
+def factor_mesh(n_devices: int) -> Tuple[int, int, int]:
+    """A sensible (data, fsdp, model) factorization for n devices: model axis
+    up to 4, then fsdp, then data."""
+    model = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0 and n_devices >= cand * 2:
+            model = cand
+            break
+    rest = n_devices // model
+    data = 2 if rest % 2 == 0 and rest >= 4 else 1
+    fsdp = rest // data
+    return data, fsdp, model
