@@ -1,0 +1,90 @@
+"""Batcher unit tests (reference tests/test_batcher.py)."""
+
+import numpy as np
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.batcher import batch_read_requests, batch_write_requests
+from torchsnapshot_tpu.io_preparer import prepare_read, prepare_write
+from torchsnapshot_tpu.scheduler import (
+    sync_execute_read_reqs,
+    sync_execute_write_reqs,
+)
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+BUDGET = 1 << 30
+
+
+def test_small_writes_coalesced_into_slab():
+    arrays = {f"a{i}": np.full((16,), i, np.float32) for i in range(10)}
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries[name] = entry
+        write_reqs += reqs
+
+    with knobs.override_slab_size_threshold_bytes(1 << 20):
+        entries, batched = batch_write_requests(entries, write_reqs)
+    assert len(batched) == 1
+    assert batched[0].path.startswith("batched/")
+    for entry in entries.values():
+        assert entry.location == batched[0].path
+        assert entry.byte_range is not None
+
+    # byte ranges must tile without overlap
+    ranges = sorted(tuple(e.byte_range) for e in entries.values())
+    for (s1, e1), (s2, e2) in zip(ranges, ranges[1:]):
+        assert e1 == s2
+
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="batch")
+    sync_execute_write_reqs(batched, storage, BUDGET, 0).sync_complete()
+
+    read_reqs = []
+    futs = {}
+    for name, entry in entries.items():
+        rr, fut = prepare_read(entry)
+        read_reqs += rr
+        futs[name] = fut
+    merged = batch_read_requests(read_reqs)
+    assert len(merged) == 1  # spanning read over the slab
+    sync_execute_read_reqs(merged, storage, BUDGET, 0)
+    for name, arr in arrays.items():
+        np.testing.assert_array_equal(futs[name].obj, arr)
+
+
+def test_slab_threshold_respected():
+    arrays = {f"a{i}": np.zeros(256, np.float32) for i in range(8)}  # 1 KB each
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries[name] = entry
+        write_reqs += reqs
+    with knobs.override_slab_size_threshold_bytes(2048):
+        entries, batched = batch_write_requests(entries, write_reqs)
+    # 8 KB of payload with a 2 KB cap: at least 4 slabs
+    assert len(batched) >= 4
+    for wr in batched:
+        cost = wr.buffer_stager.get_staging_cost_bytes()
+        assert cost <= 4096  # slab + member costs stay bounded
+
+
+def test_large_writes_pass_through():
+    arr = np.zeros(1 << 20, np.uint8)
+    entry, reqs = prepare_write(arr, "big", rank=0, replicated=False)
+    with knobs.override_slab_size_threshold_bytes(1024):
+        _, out = batch_write_requests({"big": entry}, reqs)
+    assert out == reqs
+    assert entry.location == "0/big"
+
+
+def test_object_entries_not_batched():
+    entries = {}
+    write_reqs = []
+    for i in range(4):
+        entry, reqs = prepare_write({"obj": i}, f"o{i}", rank=0, replicated=False)
+        entries[f"o{i}"] = entry
+        write_reqs += reqs
+    _, out = batch_write_requests(entries, write_reqs)
+    assert len(out) == 4

@@ -1,0 +1,108 @@
+"""Per-rank manifest views, shard merging, elasticity reconciliation
+(reference tests/test_manifest.py:638-702 + manifest_ops behavior)."""
+
+from torchsnapshot_tpu.manifest import (
+    DictEntry,
+    PrimitiveEntry,
+    Shard,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    TensorEntry,
+)
+from torchsnapshot_tpu.manifest_ops import (
+    get_manifest_for_rank,
+    handle_sharded_array_elasticity,
+)
+
+
+def _tensor(loc, replicated=False):
+    return TensorEntry(
+        location=loc,
+        serializer="buffer_protocol",
+        dtype="float32",
+        shape=[4, 4],
+        replicated=replicated,
+    )
+
+
+def _shard(offsets, sizes, loc):
+    return Shard(
+        offsets=offsets,
+        sizes=sizes,
+        tensor=TensorEntry(
+            location=loc,
+            serializer="buffer_protocol",
+            dtype="float32",
+            shape=sizes,
+            replicated=False,
+        ),
+    )
+
+
+def _metadata():
+    manifest = {
+        "0/m": DictEntry(keys=["w", "s", "p", "r"]),
+        "1/m": DictEntry(keys=["w", "s"]),
+        "0/m/w": _tensor("0/m/w"),
+        "1/m/w": _tensor("1/m/w"),
+        "0/m/s": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[_shard([0, 0], [4, 4], "sharded/m/s.0_0")],
+        ),
+        "1/m/s": ShardedArrayEntry(
+            dtype="float32",
+            shape=[8, 4],
+            shards=[_shard([4, 0], [4, 4], "sharded/m/s.4_0")],
+        ),
+        "0/m/p": PrimitiveEntry.from_object(17),
+        "0/m/r": _tensor("replicated/m/r", replicated=True),
+    }
+    return SnapshotMetadata(version="0.1.0", world_size=2, manifest=manifest)
+
+
+def test_existing_rank_gets_merged_shards_and_replicated():
+    local, merged = get_manifest_for_rank(_metadata(), rank=1)
+    # merged sharded entry exposes all shards to every rank
+    assert len(local["m/s"].shards) == 2
+    offsets = sorted(tuple(s.offsets) for s in local["m/s"].shards)
+    assert offsets == [(0, 0), (4, 0)]
+    # replicated entry from rank 0 injected into rank 1's view
+    assert "m/r" in local
+    assert local["m/r"].replicated
+    # rank-private entries stay private
+    assert local["m/w"].location == "1/m/w"
+    # merged entries exposed separately too
+    assert "m/s" in merged
+
+
+def test_new_rank_gets_only_replicated_and_containers():
+    local, _ = get_manifest_for_rank(_metadata(), rank=5)
+    assert "m/r" in local
+    assert "m/w" not in local
+    assert "m/s" not in local
+    assert "m" in local  # container survives with pruned keys
+    assert "w" not in local["m"].keys
+    assert "r" in local["m"].keys
+
+
+def test_shard_dedup_on_merge():
+    md = _metadata()
+    # rank 1 also carries a duplicate record of shard (0,0)
+    md.manifest["1/m/s"].shards.append(_shard([0, 0], [4, 4], "sharded/m/s.0_0"))
+    local, _ = get_manifest_for_rank(md, rank=0)
+    assert len(local["m/s"].shards) == 2  # duplicate collapsed
+
+
+def test_elasticity_adds_requested_missing_entry():
+    local, merged = get_manifest_for_rank(_metadata(), rank=5)
+    assert "m/s" not in local
+    handle_sharded_array_elasticity(local, merged, ["m/s", "m/w"])
+    assert "m/s" in local  # requested & available from merge -> injected
+    assert "s" in local["m"].keys
+
+
+def test_elasticity_removes_unrequested_entry():
+    local, merged = get_manifest_for_rank(_metadata(), rank=0)
+    handle_sharded_array_elasticity(local, merged, [])  # nothing requested
+    assert "m/s" not in local

@@ -1,0 +1,108 @@
+"""Adapter tests: flax TrainState / optax pytrees through full snapshots."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchsnapshot_tpu import Snapshot
+from torchsnapshot_tpu.tricks.flax import PytreeAdapter, TrainStateAdapter
+
+
+def _make_train_state(seed):
+    from flax.training import train_state
+
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    model = MLP()
+    params = model.init(jax.random.key(seed), jnp.ones((1, 8)))
+    return train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.adamw(1e-3)
+    )
+
+
+def test_flax_train_state_roundtrip(tmp_path):
+    state = _make_train_state(0)
+    # advance one step so opt_state is non-trivial
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    state = state.apply_gradients(grads=grads)
+
+    adapter = TrainStateAdapter(state)
+    Snapshot.take(str(tmp_path / "snap"), {"train": adapter})
+
+    dst_state = _make_train_state(1)
+    dst = TrainStateAdapter(dst_state)
+    snapshot = Snapshot(str(tmp_path / "snap"))
+    snapshot.restore({"train": dst})
+
+    restored = dst.tree
+    assert type(restored) is type(state)
+    assert int(restored.step) == int(state.step)
+    for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(state.opt_state), jax.tree.leaves(restored.opt_state)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pytree_adapter_plain_tree(tmp_path):
+    tree = {"a": [jnp.arange(4), {"b": (jnp.ones(2), 3.5)}]}
+    Snapshot.take(str(tmp_path / "snap"), {"t": PytreeAdapter(tree)})
+    dst = PytreeAdapter({"a": [jnp.zeros(4), {"b": (jnp.zeros(2), 0.0)}]})
+    Snapshot(str(tmp_path / "snap")).restore({"t": dst})
+    np.testing.assert_array_equal(np.asarray(dst.tree["a"][0]), np.arange(4))
+    assert dst.tree["a"][1]["b"][1] == 3.5
+    assert isinstance(dst.tree["a"][1]["b"], tuple)
+
+
+def test_pytree_adapter_missing_leaf_raises(tmp_path):
+    tree = {"a": jnp.ones(2)}
+    Snapshot.take(str(tmp_path / "snap"), {"t": PytreeAdapter(tree)})
+    dst = PytreeAdapter({"a": jnp.zeros(2), "extra": jnp.zeros(3)})
+    with pytest.raises(KeyError, match="extra"):
+        Snapshot(str(tmp_path / "snap")).restore({"t": dst})
+
+
+def test_host_offload_helpers():
+    from torchsnapshot_tpu.utils.host_offload import (
+        is_host_resident,
+        supports_host_memory,
+        to_device_memory,
+        to_host_memory,
+    )
+
+    if not supports_host_memory():
+        pytest.skip("backend has no pinned_host memory space")
+    x = jnp.arange(16, dtype=jnp.float32)
+    h = to_host_memory(x)
+    assert is_host_resident(h)
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(x))
+    d = to_device_memory(h)
+    assert not is_host_resident(d)
+
+
+def test_host_offloaded_array_snapshot(tmp_path):
+    from torchsnapshot_tpu import StateDict
+    from torchsnapshot_tpu.utils.host_offload import (
+        supports_host_memory,
+        to_host_memory,
+    )
+
+    if not supports_host_memory():
+        pytest.skip("backend has no pinned_host memory space")
+    emb = to_host_memory(jnp.arange(64, dtype=jnp.float32).reshape(8, 8))
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict({"emb": emb})})
+    dst = {"m": StateDict({"emb": jnp.zeros((8, 8), jnp.float32)})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(
+        np.asarray(dst["m"]["emb"]), np.arange(64).reshape(8, 8)
+    )

@@ -1,0 +1,35 @@
+"""RSS memory profiling for benchmarks (reference
+torchsnapshot/rss_profiler.py:35-60): context manager sampling RSS deltas on
+a thread at a fixed interval."""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Generator, List
+
+import psutil
+
+
+@contextmanager
+def measure_rss_deltas(
+    rss_deltas: List[int], interval_ms: float = 100.0
+) -> Generator[None, None, None]:
+    proc = psutil.Process()
+    baseline = proc.memory_info().rss
+    stop = threading.Event()
+
+    def sample() -> None:
+        while not stop.is_set():
+            rss_deltas.append(proc.memory_info().rss - baseline)
+            stop.wait(interval_ms / 1000.0)
+
+    thread = threading.Thread(target=sample, daemon=True)
+    thread.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        thread.join()
+        rss_deltas.append(proc.memory_info().rss - baseline)

@@ -129,6 +129,8 @@ def _use_bitcast_staging(arr: Any) -> bool:
     if flag is not None:
         return flag not in ("0", "false", "")
     try:
+        if getattr(arr.sharding, "memory_kind", None) == "pinned_host":
+            return False  # already host-resident: no transfer to speed up
         if arr.sharding.device_set and next(
             iter(arr.sharding.device_set)
         ).platform == "cpu":

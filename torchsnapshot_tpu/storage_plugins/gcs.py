@@ -1,0 +1,254 @@
+"""Google Cloud Storage plugin — the production target (BASELINE.md: v5e
+slices checkpoint to GCS).
+
+TPU-native analogue of the reference's ``torchsnapshot/storage_plugins/gcs.py``
+(/root/reference/torchsnapshot/storage_plugins/gcs.py:43-277):
+
+- resumable chunked uploads (100 MB chunks) on a thread pool with a pooled
+  authorized session (reference :80-88)
+- transient-error classification and upload-recovery rewind (reference
+  :91-126)
+- a **shared-deadline retry strategy**: concurrent transfers share one
+  deadline that refreshes whenever *any* of them makes progress, so a global
+  stall fails fast while steady collective progress never times out
+  (reference _RetryStrategy, :221-277); exponential backoff with jitter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import os
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..memoryview_stream import MemoryviewStream
+
+logger = logging.getLogger(__name__)
+
+_CHUNK_SIZE_BYTES = 100 * 1024 * 1024  # reference gcs.py:43
+_IO_THREADS = 16
+_DEFAULT_DEADLINE_S = 600.0
+
+
+class _SharedDeadlineRetryStrategy:
+    """Deadline shared by all concurrent transfers, refreshed on any
+    progress (reference gcs.py:221-277)."""
+
+    def __init__(self, deadline_s: float = _DEFAULT_DEADLINE_S) -> None:
+        self._deadline_s = deadline_s
+        self._lock = threading.Lock()
+        self._expires_at = time.monotonic() + deadline_s
+        self._attempts = 0
+
+    def report_progress(self) -> None:
+        with self._lock:
+            self._expires_at = time.monotonic() + self._deadline_s
+            self._attempts = 0
+
+    def check_and_backoff(self, exc: BaseException) -> None:
+        """Raise if the shared deadline expired, else sleep with jittered
+        exponential backoff."""
+        with self._lock:
+            if time.monotonic() > self._expires_at:
+                raise TimeoutError(
+                    f"GCS transfers made no collective progress for "
+                    f"{self._deadline_s}s"
+                ) from exc
+            self._attempts += 1
+            attempts = self._attempts
+        backoff = min(2 ** min(attempts, 6), 32.0) * (0.5 + random.random())
+        logger.warning("GCS transient error (%r); retrying in %.1fs", exc, backoff)
+        time.sleep(backoff)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """(reference gcs.py:91-111)"""
+    import requests.exceptions
+
+    transient_codes = {408, 429, 500, 502, 503, 504}
+    status = getattr(getattr(exc, "response", None), "status_code", None)
+    if status in transient_codes:
+        return True
+    return isinstance(
+        exc,
+        (
+            ConnectionError,
+            TimeoutError,
+            requests.exceptions.ConnectionError,
+            requests.exceptions.Timeout,
+            requests.exceptions.ChunkedEncodingError,
+        ),
+    )
+
+
+class GCSStoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        # root: "bucket/optional/prefix"
+        bucket, _, prefix = root.partition("/")
+        self.bucket_name = bucket
+        self.prefix = prefix.strip("/")
+        self._executor: Optional[ThreadPoolExecutor] = None
+        self._retry = _SharedDeadlineRetryStrategy()
+        self._local = threading.local()
+        try:
+            import google.auth
+            import google.auth.transport.requests as tr_requests
+
+            self._credentials, self._project = google.auth.default()
+            self._tr_requests = tr_requests
+        except Exception as e:  # noqa: BLE001
+            raise RuntimeError(
+                "GCS storage requires application-default credentials "
+                f"(google.auth.default failed: {e})"
+            ) from e
+
+    # One authorized session per worker thread (reference pools sessions,
+    # gcs.py:80-88).
+    def _session(self):
+        if not hasattr(self._local, "session"):
+            self._local.session = self._tr_requests.AuthorizedSession(
+                self._credentials
+            )
+        return self._local.session
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=_IO_THREADS, thread_name_prefix="gcs_io"
+            )
+        return self._executor
+
+    def _blob_url(self, path: str) -> str:
+        name = f"{self.prefix}/{path}" if self.prefix else path
+        return name
+
+    def _blocking_write(self, path: str, buf) -> None:
+        from google.resumable_media.requests import ResumableUpload
+
+        url = (
+            "https://www.googleapis.com/upload/storage/v1/b/"
+            f"{self.bucket_name}/o?uploadType=resumable"
+        )
+        view = memoryview(buf).cast("B")
+        stream = MemoryviewStream(view)
+        metadata = {"name": self._blob_url(path)}
+        while True:
+            try:
+                upload = ResumableUpload(url, _CHUNK_SIZE_BYTES)
+                upload.initiate(
+                    self._session(),
+                    stream,
+                    metadata,
+                    "application/octet-stream",
+                    total_bytes=view.nbytes,
+                )
+                while not upload.finished:
+                    try:
+                        upload.transmit_next_chunk(self._session())
+                        self._retry.report_progress()
+                    except Exception as e:  # noqa: BLE001
+                        if not _is_transient(e):
+                            raise
+                        self._retry.check_and_backoff(e)
+                        # Recover the upload: ask GCS how far it got and
+                        # rewind the stream (reference gcs.py:113-126).
+                        upload.recover(self._session())
+                return
+            except Exception as e:  # noqa: BLE001
+                if not _is_transient(e):
+                    raise
+                self._retry.check_and_backoff(e)
+                stream.seek(0)
+
+    def _blocking_read(self, path: str, byte_range) -> bytearray:
+        from google.resumable_media.requests import ChunkedDownload
+
+        url = (
+            "https://storage.googleapis.com/download/storage/v1/b/"
+            f"{self.bucket_name}/o/"
+            + self._blob_url(path).replace("/", "%2F")
+            + "?alt=media"
+        )
+        out = io.BytesIO()
+        kwargs = {}
+        if byte_range is not None:
+            kwargs = {"start": byte_range[0], "end": byte_range[1] - 1}
+        while True:
+            try:
+                download = ChunkedDownload(url, _CHUNK_SIZE_BYTES, out, **kwargs)
+                while not download.finished:
+                    download.consume_next_chunk(self._session())
+                    self._retry.report_progress()
+                return bytearray(out.getvalue())
+            except Exception as e:  # noqa: BLE001
+                if not _is_transient(e):
+                    raise
+                self._retry.check_and_backoff(e)
+                out.seek(0)
+                out.truncate()
+
+    async def write(self, write_io: WriteIO) -> None:
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            self._get_executor(), self._blocking_write, write_io.path, write_io.buf
+        )
+
+    async def read(self, read_io: ReadIO) -> None:
+        loop = asyncio.get_event_loop()
+        read_io.buf = await loop.run_in_executor(
+            self._get_executor(),
+            self._blocking_read,
+            read_io.path,
+            read_io.byte_range,
+        )
+
+    async def delete(self, path: str) -> None:
+        def _delete() -> None:
+            url = (
+                f"https://storage.googleapis.com/storage/v1/b/"
+                f"{self.bucket_name}/o/"
+                + self._blob_url(path).replace("/", "%2F")
+            )
+            resp = self._session().delete(url)
+            if resp.status_code not in (200, 204, 404):
+                resp.raise_for_status()
+
+        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _delete)
+
+    async def delete_dir(self, path: str) -> None:
+        def _list_and_delete() -> None:
+            prefix = self._blob_url(path).rstrip("/") + "/"
+            url = (
+                f"https://storage.googleapis.com/storage/v1/b/"
+                f"{self.bucket_name}/o"
+            )
+            session = self._session()
+            page_token = None
+            while True:
+                params = {"prefix": prefix}
+                if page_token:
+                    params["pageToken"] = page_token
+                resp = session.get(url, params=params)
+                resp.raise_for_status()
+                data = resp.json()
+                for item in data.get("items", []):
+                    durl = url + "/" + item["name"].replace("/", "%2F")
+                    session.delete(durl)
+                page_token = data.get("nextPageToken")
+                if not page_token:
+                    return
+
+        await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), _list_and_delete
+        )
+
+    async def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown()
+            self._executor = None

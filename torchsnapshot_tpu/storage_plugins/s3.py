@@ -1,0 +1,96 @@
+"""S3 storage plugin (reference torchsnapshot/storage_plugins/s3.py:18-80).
+
+Gated: this environment ships no aiobotocore/botocore.  When boto3/botocore
+is present the plugin works (thread-pooled puts/gets, HTTP Range reads with
+the inclusive-end correction the reference applies at s3.py:60-66, zero-copy
+streaming via MemoryviewStream); otherwise construction raises with a clear
+message.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..memoryview_stream import MemoryviewStream
+
+_IO_THREADS = 16
+
+
+class S3StoragePlugin(StoragePlugin):
+    def __init__(self, root: str) -> None:
+        try:
+            import boto3  # type: ignore[import-not-found]
+        except ImportError as e:
+            raise RuntimeError(
+                "S3 storage requires boto3/botocore, which is not installed "
+                "in this environment"
+            ) from e
+        bucket, _, prefix = root.partition("/")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self._client = boto3.client("s3")
+        self._executor: Optional[ThreadPoolExecutor] = None
+
+    def _get_executor(self) -> ThreadPoolExecutor:
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=_IO_THREADS, thread_name_prefix="s3_io"
+            )
+        return self._executor
+
+    def _key(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    async def write(self, write_io: WriteIO) -> None:
+        def _put() -> None:
+            body = MemoryviewStream(memoryview(write_io.buf))
+            self._client.put_object(
+                Bucket=self.bucket, Key=self._key(write_io.path), Body=body
+            )
+
+        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _put)
+
+    async def read(self, read_io: ReadIO) -> None:
+        def _get() -> bytearray:
+            kwargs = {}
+            if read_io.byte_range is not None:
+                start, end = read_io.byte_range
+                # HTTP Range is inclusive on both ends (reference s3.py:60-66)
+                kwargs["Range"] = f"bytes={start}-{end - 1}"
+            resp = self._client.get_object(
+                Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
+            )
+            return bytearray(resp["Body"].read())
+
+        read_io.buf = await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), _get
+        )
+
+    async def delete(self, path: str) -> None:
+        def _delete() -> None:
+            self._client.delete_object(Bucket=self.bucket, Key=self._key(path))
+
+        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _delete)
+
+    async def delete_dir(self, path: str) -> None:
+        def _delete_dir() -> None:
+            prefix = self._key(path).rstrip("/") + "/"
+            paginator = self._client.get_paginator("list_objects_v2")
+            for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+                keys = [{"Key": o["Key"]} for o in page.get("Contents", [])]
+                if keys:
+                    self._client.delete_objects(
+                        Bucket=self.bucket, Delete={"Objects": keys}
+                    )
+
+        await asyncio.get_event_loop().run_in_executor(
+            self._get_executor(), _delete_dir
+        )
+
+    async def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown()
+            self._executor = None

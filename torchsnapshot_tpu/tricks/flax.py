@@ -1,0 +1,80 @@
+"""Adapters that let arbitrary JAX pytrees (flax TrainState, optax states,
+haiku params) join app state.
+
+The reference's ``tricks/`` package adapts framework-specific state-dict
+quirks (DDP prefixes, FSDP optimizer gathering, DeepSpeed ZeRO-3 —
+/root/reference/torchsnapshot/tricks/{ddp,fsdp,deepspeed}.py).  JAX has no
+such quirks — everything is a pytree — so the one adapter that matters is
+pytree ↔ Stateful: :class:`PytreeAdapter` exposes any pytree as nested
+containers for the manifest, and rebuilds the original structure (including
+custom PyTreeNode dataclasses like flax's TrainState) on load.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+
+def _key_str(k: Any) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+class PytreeAdapter:
+    """Stateful wrapper around any jax pytree.
+
+    ``state_dict`` flattens the tree into nested dicts keyed by pytree path
+    components (attribute names for dataclass nodes, keys for dicts, indices
+    for sequences).  ``load_state_dict`` restores leaves **by path** into the
+    existing tree structure, so the wrapped object keeps its exact type
+    (e.g. flax ``TrainState``) and shardings are taken from the current
+    leaves (in-place restore targets).
+    """
+
+    def __init__(self, tree: Any) -> None:
+        self._tree = tree
+
+    @property
+    def tree(self) -> Any:
+        return self._tree
+
+    def state_dict(self) -> Dict[str, Any]:
+        leaves = jax.tree_util.tree_flatten_with_path(self._tree)[0]
+        out: Dict[str, Any] = {}
+        for path, leaf in leaves:
+            node = out
+            parts = [_key_str(k) for k in path] or ["value"]
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = leaf
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(self._tree)
+        new_leaves = []
+        for path, old_leaf in paths_and_leaves:
+            node: Any = state_dict
+            parts = [_key_str(k) for k in path] or ["value"]
+            try:
+                for part in parts:
+                    if isinstance(node, dict) and part not in node and part.isdigit():
+                        node = node[int(part)] if int(part) in node else node[part]
+                    else:
+                        node = node[part]
+            except (KeyError, TypeError) as e:
+                raise KeyError(
+                    f"Restored state dict is missing leaf {'/'.join(parts)}"
+                ) from e
+            new_leaves.append(node)
+        self._tree = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+class TrainStateAdapter(PytreeAdapter):
+    """Convenience alias for flax.training.train_state.TrainState pytrees."""

@@ -1,0 +1,45 @@
+"""Host-offloaded arrays: the TPU analogue of UVM embedding tables.
+
+The reference pages fbgemm UVM embeddings to CPU before serialization
+(/root/reference/torchsnapshot/uvm_tensor.py:28-47,
+io_preparers/tensor.py:259-262).  TPUs have no UVM; the equivalent is arrays
+placed in the host memory space (``memory_kind="pinned_host"``), which XLA
+can stream into device computations (Pathways-style host offload for
+embeddings / optimizer state).  Snapshotting such arrays needs no D2H DMA —
+``np.asarray`` reads host memory directly — so these helpers exist to (a)
+place arrays there and (b) let staging recognize them.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def supports_host_memory() -> bool:
+    try:
+        dev = jax.devices()[0]
+        kinds = {m.kind for m in dev.addressable_memories()}
+        return "pinned_host" in kinds
+    except Exception:
+        return False
+
+
+def to_host_memory(arr: Any) -> Any:
+    """Move a jax.Array to the pinned-host memory space, preserving its
+    (logical) sharding."""
+    sharding = arr.sharding.with_memory_kind("pinned_host")
+    return jax.device_put(arr, sharding)
+
+
+def to_device_memory(arr: Any) -> Any:
+    sharding = arr.sharding.with_memory_kind("device")
+    return jax.device_put(arr, sharding)
+
+
+def is_host_resident(arr: Any) -> bool:
+    try:
+        return arr.sharding.memory_kind == "pinned_host"
+    except Exception:
+        return False
