@@ -1,0 +1,78 @@
+"""Partitioner balance/dedup unit tests (reference tests/test_partitioner.py)."""
+
+import numpy as np
+
+from torchsnapshot_tpu.io_preparer import prepare_write
+from torchsnapshot_tpu.manifest import TensorEntry
+from torchsnapshot_tpu.partitioner import (
+    consolidate_replicated_entries,
+    partition_write_reqs,
+)
+from torchsnapshot_tpu.test_utils import make_test_pg, run_with_procs
+
+
+@run_with_procs(nproc=4)
+def _dedup_and_balance_body():
+    pg = make_test_pg()
+    rank = pg.get_rank()
+
+    entries = {}
+    write_reqs = []
+    # 8 replicated arrays of different sizes + 1 private array per rank
+    for i in range(8):
+        arr = np.zeros(128 * (i + 1), np.float32)
+        entry, reqs = prepare_write(arr, f"m/w{i}", rank=rank, replicated=True)
+        entries[f"m/w{i}"] = entry
+        write_reqs += reqs
+    priv, priv_reqs = prepare_write(
+        np.zeros(64, np.float32), "m/priv", rank=rank, replicated=False
+    )
+    entries["m/priv"] = priv
+    write_reqs += priv_reqs
+
+    pruned, kept = partition_write_reqs(entries, write_reqs, pg)
+
+    kept_shared = [wr.path for wr in kept if wr.path.startswith("replicated/")]
+    gathered = pg.all_gather_object(kept_shared)
+    all_paths = [p for paths in gathered for p in paths]
+    # every replicated payload written exactly once across ranks
+    assert sorted(all_paths) == sorted(f"replicated/m/w{i}" for i in range(8))
+    # work spread across ranks, not all on one
+    n_per_rank = [len(paths) for paths in gathered]
+    assert max(n_per_rank) <= 4
+
+    # private writes never dropped
+    assert any(wr.path == f"{rank}/m/priv" for wr in kept)
+
+    # pruned entries: replicated entry present iff this rank writes it
+    for i in range(8):
+        has_entry = f"m/w{i}" in pruned
+        writes_it = f"replicated/m/w{i}" in kept_shared
+        assert has_entry == writes_it
+
+    # consolidation puts every replicated entry in rank 0's manifest
+    gathered_entries = pg.all_gather_object(pruned)
+    consolidated = consolidate_replicated_entries(gathered_entries)
+    for i in range(8):
+        assert f"m/w{i}" in consolidated[0]
+    for r in (1, 2, 3):
+        assert not any(
+            isinstance(e, TensorEntry) and e.replicated
+            for e in consolidated[r].values()
+        )
+
+
+def test_partitioner_dedup_and_balance():
+    _dedup_and_balance_body()
+
+
+def test_single_process_identity():
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    entries = {}
+    arr = np.zeros(64, np.float32)
+    entry, reqs = prepare_write(arr, "m/w", rank=0, replicated=True)
+    entries["m/w"] = entry
+    out_entries, out_reqs = partition_write_reqs(entries, reqs, PGWrapper())
+    assert out_entries is entries
+    assert out_reqs is reqs

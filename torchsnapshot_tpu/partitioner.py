@@ -50,6 +50,31 @@ def _is_shared_path(path: str) -> bool:
     return path.startswith("replicated/") or path.startswith("sharded/")
 
 
+def _payload_sizes(entries: Manifest) -> Dict[str, int]:
+    """location → storage bytes, from manifest geometry (the reference
+    balances by storage size, partitioner.py:264-268 — staging cost is the
+    wrong measure: it is 0 for zero-copy host buffers)."""
+    from . import serialization
+
+    sizes: Dict[str, int] = {}
+    for entry in entries.values():
+        if isinstance(entry, TensorEntry):
+            sizes[entry.location] = serialization.array_nbytes(
+                entry.shape, entry.dtype
+            )
+        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+            shards = (
+                entry.shards
+                if isinstance(entry, ShardedArrayEntry)
+                else entry.chunks
+            )
+            for shard in shards:
+                sizes[shard.tensor.location] = serialization.array_nbytes(
+                    shard.tensor.shape, shard.tensor.dtype
+                )
+    return sizes
+
+
 def partition_write_reqs(
     entries: Manifest, write_reqs: List[WriteReq], pg: PGWrapper
 ) -> Tuple[Manifest, List[WriteReq]]:
@@ -58,10 +83,13 @@ def partition_write_reqs(
     if world_size == 1:
         return entries, write_reqs
 
+    payload_sizes = _payload_sizes(entries)
     local_sizes: Dict[str, int] = {}
     private_bytes = 0
     for wr in write_reqs:
-        cost = wr.buffer_stager.get_staging_cost_bytes()
+        cost = payload_sizes.get(
+            wr.path, wr.buffer_stager.get_staging_cost_bytes()
+        )
         if _is_shared_path(wr.path):
             local_sizes[wr.path] = cost
         else:
