@@ -1,0 +1,41 @@
+"""The equality helpers themselves are tested (reference
+tests/test_test_utils.py:28-33 — watch the watchmen)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchsnapshot_tpu.test_utils import (
+    assert_state_dict_eq,
+    check_state_dict_eq,
+    tensor_eq,
+)
+
+
+def test_tensor_eq():
+    assert tensor_eq(np.arange(4), np.arange(4))
+    assert not tensor_eq(np.arange(4), np.arange(5))
+    assert not tensor_eq(np.arange(4), np.arange(4).astype(np.float32))
+    assert tensor_eq(jnp.arange(4), np.arange(4))
+    assert not tensor_eq(np.arange(4), [0, 1, 2, 3])
+    assert tensor_eq(3, 3)
+    assert not tensor_eq(3, 4)
+
+
+def test_check_state_dict_eq():
+    a = {"x": np.ones(3), "y": {"z": [1, 2, (3,)]}}
+    b = {"x": np.ones(3), "y": {"z": [1, 2, (3,)]}}
+    assert check_state_dict_eq(a, b)
+    b["y"]["z"][2] = (4,)
+    assert not check_state_dict_eq(a, b)
+    assert not check_state_dict_eq({"x": 1}, {"x": 1, "extra": 2})
+    # list vs tuple is a structural difference
+    assert not check_state_dict_eq({"x": [1]}, {"x": (1,)})
+
+
+def test_assert_state_dict_eq_message():
+    try:
+        assert_state_dict_eq({"x": np.ones(2)}, {"x": np.zeros(2)})
+    except AssertionError as e:
+        assert "/x" in str(e)
+    else:
+        raise AssertionError("expected failure")

@@ -89,7 +89,6 @@ class Snapshot:
         app_state: AppState,
         pg: Optional[PGWrapper] = None,
         replicated: Optional[List[str]] = None,
-        _custom_tensor_prepare_func: Optional[Callable] = None,
     ) -> "Snapshot":
         pg = pg or PGWrapper()
         unique_id = _gen_unique_id(pg)
