@@ -15,7 +15,7 @@ def test_tensor_eq():
     assert tensor_eq(np.arange(4), np.arange(4))
     assert not tensor_eq(np.arange(4), np.arange(5))
     assert not tensor_eq(np.arange(4), np.arange(4).astype(np.float32))
-    assert tensor_eq(jnp.arange(4), np.arange(4))
+    assert tensor_eq(jnp.arange(4), np.arange(4, dtype=np.int32))
     assert not tensor_eq(np.arange(4), [0, 1, 2, 3])
     assert tensor_eq(3, 3)
     assert not tensor_eq(3, 4)
