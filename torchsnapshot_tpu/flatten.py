@@ -23,6 +23,7 @@ from .manifest import (
     Entry,
     ListEntry,
     Manifest,
+    NamedTupleEntry,
     OrderedDictEntry,
     TupleEntry,
 )
@@ -79,10 +80,19 @@ def _flatten_inner(
         for idx, value in enumerate(obj):
             _flatten_inner(value, manifest, flattened, _join(prefix, str(idx)))
     elif isinstance(obj, tuple) and type(obj) is tuple:
-        # NamedTuples and other tuple subclasses are preserved opaquely.
         manifest[prefix] = TupleEntry()
         for idx, value in enumerate(obj):
             _flatten_inner(value, manifest, flattened, _join(prefix, str(idx)))
+    elif isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        # NamedTuples are first-class containers: optax optimizer states
+        # (ScaleByAdamState & co.) must not collapse into opaque pickles —
+        # their array fields need the sharded-array machinery.
+        cls = type(obj)
+        manifest[prefix] = NamedTupleEntry(
+            keys=list(obj._fields), cls=f"{cls.__module__}:{cls.__qualname__}"
+        )
+        for field, value in zip(obj._fields, obj):
+            _flatten_inner(value, manifest, flattened, _join(prefix, field))
     else:
         flattened[prefix] = obj
 
@@ -140,6 +150,9 @@ def inflate(
             items = sorted(((int(_decode(c)), v) for c, v in kid_map.items()))
             seq = [v for _, v in items]
             result: Any = tuple(seq) if isinstance(entry, TupleEntry) else seq
+        elif isinstance(entry, NamedTupleEntry):
+            values = [kid_map[_encode(field)] for field in entry.keys]
+            result = _reconstruct_namedtuple(entry, values)
         elif isinstance(entry, (DictEntry, OrderedDictEntry)):
             cls = OrderedDict if isinstance(entry, OrderedDictEntry) else dict
             result = cls()
@@ -157,3 +170,20 @@ def inflate(
             f"inflate: prefix {prefix!r} not present in manifest or leaves"
         )
     return _build(prefix)
+
+
+def _reconstruct_namedtuple(entry: Any, values: list) -> Any:
+    import importlib
+    from collections import namedtuple as _namedtuple
+
+    try:
+        module_name, _, qualname = entry.cls.partition(":")
+        obj: Any = importlib.import_module(module_name)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj(*values)
+    except Exception:
+        # Class not importable here: degrade to an anonymous namedtuple with
+        # the same fields (still a pytree with attribute access).
+        anon = _namedtuple("RestoredNamedTuple", entry.keys)
+        return anon(*values)

@@ -120,8 +120,10 @@ class ShardedArrayIOPreparer:
         for offsets, data in staging.local_shards(obj):
             sizes = list(data.shape)
             for p_off, p_sz in _subdivide(offsets, sizes, dtype_str, max_shard_sz):
-                rel = _box_slices(p_off, p_sz, offsets)
-                piece = data[rel] if rel else data
+                if list(p_off) == list(offsets) and p_sz == sizes:
+                    piece = data  # whole shard: no device slice dispatch
+                else:
+                    piece = data[_box_slices(p_off, p_sz, offsets)]
                 location = cls.storage_path_for_piece(storage_path, p_off)
                 tensor_entry, piece_reqs = ArrayIOPreparer.prepare_write(
                     storage_path=location,

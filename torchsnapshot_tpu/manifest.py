@@ -205,6 +205,23 @@ class TupleEntry(Entry):
 
 
 @dataclass
+class NamedTupleEntry(Entry):
+    """JAX addition: optax optimizer states are NamedTuples (ScaleByAdamState
+    etc.) — they must flatten as containers, not opaque pickles, so their
+    array fields go through the sharded-array machinery.  ``cls`` records
+    ``module:qualname`` for exact reconstruction; inflate degrades to a
+    same-shaped anonymous namedtuple if the class cannot be imported."""
+
+    keys: List[str]
+    cls: str
+
+    def __init__(self, keys: List[str], cls: str) -> None:
+        super().__init__(type="namedtuple")
+        self.keys = keys
+        self.cls = cls
+
+
+@dataclass
 class DictEntry(Entry):
     keys: List[Union[str, int]]
 
@@ -297,6 +314,7 @@ _ENTRY_TYPE_TO_CLS: Dict[str, type] = {
     "object": ObjectEntry,
     "list": ListEntry,
     "tuple": TupleEntry,
+    "namedtuple": NamedTupleEntry,
     "dict": DictEntry,
     "OrderedDict": OrderedDictEntry,
     "primitive": PrimitiveEntry,
@@ -343,6 +361,9 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
         )
     elif isinstance(entry, (DictEntry, OrderedDictEntry)):
         d["keys"] = entry.keys
+    elif isinstance(entry, NamedTupleEntry):
+        d["keys"] = entry.keys
+        d["cls"] = entry.cls
     elif isinstance(entry, PrimitiveEntry):
         d.update(
             entry_type=entry.entry_type,
@@ -400,6 +421,8 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
         return ListEntry()
     if typ == "tuple":
         return TupleEntry()
+    if typ == "namedtuple":
+        return NamedTupleEntry(keys=list(d["keys"]), cls=d["cls"])
     if typ == "dict":
         return DictEntry(keys=list(d["keys"]))
     if typ == "OrderedDict":

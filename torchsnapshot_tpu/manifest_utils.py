@@ -29,6 +29,7 @@ from .manifest import (
     DictEntry,
     Entry,
     ListEntry,
+    NamedTupleEntry,
     OrderedDictEntry,
     ShardedArrayEntry,
     TupleEntry,
@@ -36,7 +37,10 @@ from .manifest import (
 
 
 def is_container_entry(entry: Entry) -> bool:
-    return isinstance(entry, (ListEntry, TupleEntry, DictEntry, OrderedDictEntry))
+    return isinstance(
+        entry,
+        (ListEntry, TupleEntry, NamedTupleEntry, DictEntry, OrderedDictEntry),
+    )
 
 
 def is_dict_entry(entry: Entry) -> bool:

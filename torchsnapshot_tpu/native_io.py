@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import List, Optional
+from typing import Any, List, Optional
 
 
 class NativeFileIO:
@@ -61,9 +61,11 @@ class NativeFileIO:
         if nbytes == 0:
             with open(path, "wb"):
                 return
-        if view.readonly:
-            # bytes payloads (pickles, metadata) — small; one copy acceptable
-            c_buf: ctypes.Array = (ctypes.c_char * nbytes).from_buffer_copy(view)
+        if isinstance(buf, bytes):
+            # c_char_p borrows the bytes object's pointer — no copy
+            c_buf: Any = ctypes.c_char_p(buf)
+        elif view.readonly:
+            c_buf = (ctypes.c_char * nbytes).from_buffer_copy(view)
         else:
             # zero-copy for staged array buffers (the hot path)
             c_buf = (ctypes.c_char * nbytes).from_buffer(view)
