@@ -1,0 +1,51 @@
+"""GCS plugin integration test, gated on credentials + bucket env var
+(reference tests/test_gcs_storage_plugin.py:25-33)."""
+
+import asyncio
+import os
+import uuid
+
+import pytest
+
+
+def _gcs_available() -> bool:
+    if not os.environ.get("TPUSNAP_TEST_GCS_BUCKET"):
+        return False
+    try:
+        import google.auth
+
+        google.auth.default()
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _gcs_available(),
+    reason="set TPUSNAP_TEST_GCS_BUCKET and provide application-default "
+    "credentials to run GCS integration tests",
+)
+gcs_integration_test = pytest.mark.gcs_integration_test
+
+
+@gcs_integration_test
+def test_gcs_roundtrip():
+    from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    bucket = os.environ["TPUSNAP_TEST_GCS_BUCKET"]
+    plugin = GCSStoragePlugin(root=f"{bucket}/tpusnap_test_{uuid.uuid4().hex}")
+    data = bytes(range(256)) * 64
+
+    async def go():
+        await plugin.write(WriteIO(path="x/y.bin", buf=data))
+        read_io = ReadIO(path="x/y.bin")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == data
+        ranged = ReadIO(path="x/y.bin", byte_range=[128, 512])
+        await plugin.read(ranged)
+        assert bytes(ranged.buf) == data[128:512]
+        await plugin.delete_dir("x")
+        await plugin.close()
+
+    asyncio.run(go())

@@ -48,6 +48,18 @@ def test_buffer_protocol_roundtrip(dtype):
     np.testing.assert_array_equal(np.asarray(out), arr)
 
 
+@pytest.mark.parametrize("dtype_name", ["int4", "uint4"])
+def test_int4_roundtrip(dtype_name):
+    # ml_dtypes packs one int4 element per byte; quantized-model states
+    # (the reference's qtensor analogue on TPU) round-trip bit-exactly
+    dtype = string_to_dtype(dtype_name)
+    lo, hi = (-8, 7) if dtype_name == "int4" else (0, 15)
+    arr = np.random.RandomState(1).randint(lo, hi + 1, size=(9, 5)).astype(dtype)
+    mv = array_as_memoryview(arr)
+    out = array_from_memoryview(mv, dtype_name, [9, 5])
+    np.testing.assert_array_equal(np.asarray(out), arr)
+
+
 def test_zero_copy():
     arr = np.arange(8, dtype=np.float32)
     mv = array_as_memoryview(arr)

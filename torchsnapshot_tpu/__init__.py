@@ -21,4 +21,4 @@ __all__ = [
     "RNGState",
 ]
 
-__version__ = "0.1.0"
+from .version import __version__
