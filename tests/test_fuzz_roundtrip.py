@@ -1,0 +1,75 @@
+"""Randomized nested-state round-trip fuzz + scale sanity."""
+
+import random
+import time
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+
+def _random_leaf(rng: random.Random):
+    choice = rng.randrange(8)
+    np_rng = np.random.RandomState(rng.randrange(1 << 31))
+    if choice == 0:
+        return rng.randrange(-(10**12), 10**12)
+    if choice == 1:
+        return rng.random() * 1e6 - 5e5
+    if choice == 2:
+        return "".join(chr(rng.randrange(32, 1000)) for _ in range(rng.randrange(20)))
+    if choice == 3:
+        return bool(rng.randrange(2))
+    if choice == 4:
+        dtype = rng.choice([np.float32, np.float64, np.int16, ml_dtypes.bfloat16])
+        shape = tuple(rng.randrange(1, 5) for _ in range(rng.randrange(0, 3)))
+        return np_rng.uniform(-10, 10, size=shape).astype(dtype)
+    if choice == 5:
+        return jnp.asarray(np_rng.rand(rng.randrange(1, 6)).astype(np.float32))
+    if choice == 6:
+        return bytes(np_rng.bytes(rng.randrange(0, 30)))
+    return None  # pickled object path
+
+
+def _random_state(rng: random.Random, depth: int = 0):
+    if depth >= 3 or rng.random() < 0.4:
+        return _random_leaf(rng)
+    kind = rng.randrange(3)
+    if kind == 0:
+        return {
+            f"k{i}_{rng.randrange(100)}": _random_state(rng, depth + 1)
+            for i in range(rng.randrange(1, 4))
+        }
+    if kind == 1:
+        return [_random_state(rng, depth + 1) for _ in range(rng.randrange(1, 4))]
+    return tuple(_random_state(rng, depth + 1) for _ in range(rng.randrange(1, 3)))
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_roundtrip(tmp_path, seed):
+    rng = random.Random(seed)
+    state = {f"top{i}": _random_state(rng) for i in range(4)}
+    app_state = {"s": StateDict(state)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), app_state)
+    dst = {"s": StateDict({})}
+    snapshot.restore(dst)
+    assert_state_dict_eq(dst["s"].state_dict(), state)
+
+
+def test_many_leaves_scale(tmp_path):
+    # 3000 small leaves: exercises flatten/manifest/batcher/scheduler breadth
+    state = {f"w{i}": np.full((4,), i, np.float32) for i in range(3000)}
+    begin = time.monotonic()
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    take_s = time.monotonic() - begin
+    dst = {"m": StateDict({})}
+    begin = time.monotonic()
+    snapshot.restore(dst)
+    restore_s = time.monotonic() - begin
+    assert len(dst["m"].state_dict()) == 3000
+    np.testing.assert_array_equal(dst["m"]["w2999"], np.full((4,), 2999, np.float32))
+    # sanity bounds, generous for shared CI hardware
+    assert take_s < 60 and restore_s < 60, (take_s, restore_s)

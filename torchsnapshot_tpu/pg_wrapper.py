@@ -46,6 +46,23 @@ class PGWrapper:
         self._timeout_s = timeout_s
         self._generation = 0
 
+    @classmethod
+    def from_jax(cls, prefix: str = "pg") -> "PGWrapper":
+        """Process group for the current jax.distributed job: rank/world from
+        the runtime, store resolved from the environment (tpustore addr,
+        shared-FS path, or the JAX coordination service)."""
+        from .coordination import jax_process_info
+        from .dist_store import get_or_create_store
+
+        info = jax_process_info()
+        if info is None:
+            return cls()
+        rank, world_size = info
+        if world_size == 1:
+            return cls()
+        store = get_or_create_store(rank, world_size)
+        return cls(store=store, rank=rank, world_size=world_size, prefix=prefix)
+
     def get_rank(self) -> int:
         return self._rank
 
