@@ -403,4 +403,70 @@ int64_t tpusnap_file_size(const char* path) {
   return st.st_size;
 }
 
+// ------------------------------------------------------------ checksums
+// xxHash64 (Yann Collet's public algorithm, implemented from the spec) for
+// payload integrity: recorded in the manifest at write time, verified on
+// restore.  ~5 GB/s single-threaded — off the critical path at checkpoint
+// bandwidths.
+
+static inline uint64_t xx_rotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
+  static const uint64_t P1 = 11400714785074694791ULL;
+  static const uint64_t P2 = 14029467366897019727ULL;
+  static const uint64_t P3 = 1609587929392839161ULL;
+  static const uint64_t P4 = 9650029242287828579ULL;
+  static const uint64_t P5 = 2870177450012600261ULL;
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      uint64_t k;
+      memcpy(&k, p, 8); v1 = xx_rotl(v1 + k * P2, 31) * P1; p += 8;
+      memcpy(&k, p, 8); v2 = xx_rotl(v2 + k * P2, 31) * P1; p += 8;
+      memcpy(&k, p, 8); v3 = xx_rotl(v3 + k * P2, 31) * P1; p += 8;
+      memcpy(&k, p, 8); v4 = xx_rotl(v4 + k * P2, 31) * P1; p += 8;
+    } while (p <= limit);
+    h = xx_rotl(v1, 1) + xx_rotl(v2, 7) + xx_rotl(v3, 12) + xx_rotl(v4, 18);
+    uint64_t vs[4] = {v1, v2, v3, v4};
+    for (uint64_t v : vs) {
+      h ^= xx_rotl(v * P2, 31) * P1;
+      h = h * P1 + P4;
+    }
+  } else {
+    h = seed + P5;
+  }
+  h += static_cast<uint64_t>(len);
+  while (p + 8 <= end) {
+    uint64_t k;
+    memcpy(&k, p, 8);
+    h ^= xx_rotl(k * P2, 31) * P1;
+    h = xx_rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t k;
+    memcpy(&k, p, 4);
+    h ^= static_cast<uint64_t>(k) * P1;
+    h = xx_rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = xx_rotl(h, 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
 }  // extern "C"

@@ -1,0 +1,54 @@
+"""Payload integrity: checksums recorded in the manifest, verified on restore.
+
+A capability beyond the reference (which trusts storage end-to-end): every
+array/object payload gets an xxHash64 digest (native C++, ~5 GB/s — off the
+critical path at checkpoint bandwidths) computed from the exact staged bytes,
+stored on its manifest entry as ``"xxh64:<hex>"``, and verified whenever a
+consumer receives a payload in full (whole-file reads, slab byte-ranges,
+sharded pieces).  Tiled partial reads skip verification.  Disable with
+``TPUSNAP_CHECKSUM=0``.  Checksums are silently skipped when the native
+library is unavailable; restore only verifies entries that carry a digest.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ChecksumError(RuntimeError):
+    pass
+
+
+def checksums_enabled() -> bool:
+    return os.environ.get("TPUSNAP_CHECKSUM", "1") not in ("0", "false", "")
+
+
+def compute(buf) -> Optional[str]:
+    if not checksums_enabled():
+        return None
+    from .native_io import NativeFileIO
+
+    native = NativeFileIO.maybe_create()
+    if native is None:
+        return None
+    return f"xxh64:{native.xxhash64(buf):016x}"
+
+
+def verify(buf, expected: Optional[str], location: str) -> None:
+    if expected is None or not checksums_enabled():
+        return
+    algo, _, digest = expected.partition(":")
+    if algo != "xxh64":
+        return  # unknown algorithm: tolerate (forward compat)
+    from .native_io import NativeFileIO
+
+    native = NativeFileIO.maybe_create()
+    if native is None:
+        return
+    actual = f"{native.xxhash64(buf):016x}"
+    if actual != digest:
+        raise ChecksumError(
+            f"Checksum mismatch for {location}: stored xxh64:{digest}, "
+            f"computed xxh64:{actual} — the payload is corrupt"
+        )

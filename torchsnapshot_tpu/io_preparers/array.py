@@ -126,7 +126,11 @@ class ArrayIOPreparer:
                     path=entry.location,
                     byte_range=entry.byte_range,
                     buffer_consumer=ArrayBufferConsumer(
-                        assembly=assembly, flat_offset=0, nbytes=total_bytes
+                        assembly=assembly,
+                        flat_offset=0,
+                        nbytes=total_bytes,
+                        checksum=entry.checksum,
+                        location=entry.location,
                     ),
                 )
             ]
@@ -163,10 +167,13 @@ class ArrayBufferStager(BufferStager):
         self._is_async_snapshot = is_async_snapshot
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
+        from .. import integrity
+
         obj = self._obj
         if self._entry.serializer == Serializer.PICKLE.value:
             data = serialization.pickle_save_as_bytes(staging.to_host(obj))
             self._obj = None
+            self._entry.checksum = integrity.compute(data)
             return data
         if staging.is_jax_array(obj):
             # Enqueue the async DMA now (we are being admitted by the
@@ -189,7 +196,9 @@ class ArrayBufferStager(BufferStager):
                 # async_take returns (reference tensor.py:283-293).
                 host = host.copy()
         self._obj = None  # drop the device reference promptly
-        return serialization.array_as_memoryview(host)
+        mv = serialization.array_as_memoryview(host)
+        self._entry.checksum = integrity.compute(mv)
+        return mv
 
     def get_staging_cost_bytes(self) -> int:
         nbytes = serialization.array_nbytes(
@@ -271,15 +280,27 @@ def _device_put_like(host: np.ndarray, like: Any) -> Any:
 
 
 class ArrayBufferConsumer(BufferConsumer):
-    def __init__(self, assembly: ArrayAssembly, flat_offset: int, nbytes: int) -> None:
+    def __init__(
+        self,
+        assembly: ArrayAssembly,
+        flat_offset: int,
+        nbytes: int,
+        checksum: Optional[str] = None,
+        location: str = "",
+    ) -> None:
         self._assembly = assembly
         self._flat_offset = flat_offset
         self._nbytes = nbytes
+        self._checksum = checksum
+        self._location = location
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _copy() -> None:
+            from .. import integrity
+
+            integrity.verify(buf, self._checksum, self._location)
             view = self._assembly.flat_u8()
             src = np.frombuffer(buf, dtype=np.uint8, count=self._nbytes)
             view[self._flat_offset : self._flat_offset + self._nbytes] = src

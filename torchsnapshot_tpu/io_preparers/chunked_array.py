@@ -129,7 +129,11 @@ class ChunkedArrayIOPreparer:
                     path=tensor_entry.location,
                     byte_range=tensor_entry.byte_range,
                     buffer_consumer=ArrayBufferConsumer(
-                        assembly=assembly, flat_offset=flat_offset, nbytes=nbytes
+                        assembly=assembly,
+                        flat_offset=flat_offset,
+                        nbytes=nbytes,
+                        checksum=tensor_entry.checksum,
+                        location=tensor_entry.location,
                     ),
                 )
             )

@@ -27,7 +27,10 @@ class ObjectIOPreparer:
             replicated=False,
         )
         return entry, [
-            WriteReq(path=storage_path, buffer_stager=ObjectBufferStager(obj=obj))
+            WriteReq(
+                path=storage_path,
+                buffer_stager=ObjectBufferStager(obj=obj, entry=entry),
+            )
         ]
 
     @classmethod
@@ -43,7 +46,7 @@ class ObjectIOPreparer:
                 ReadReq(
                     path=entry.location,
                     byte_range=None,
-                    buffer_consumer=ObjectBufferConsumer(fut=fut),
+                    buffer_consumer=ObjectBufferConsumer(fut=fut, entry=entry),
                 )
             ],
             fut,
@@ -51,11 +54,17 @@ class ObjectIOPreparer:
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any) -> None:
+    def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
         self._obj = obj
+        self._entry = entry
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        return serialization.pickle_save_as_bytes(self._obj)
+        from .. import integrity
+
+        data = serialization.pickle_save_as_bytes(self._obj)
+        if self._entry is not None:
+            self._entry.checksum = integrity.compute(data)
+        return data
 
     def get_staging_cost_bytes(self) -> int:
         # sys.getsizeof is knowingly inaccurate (reference object.py:78-80);
@@ -64,15 +73,18 @@ class ObjectBufferStager(BufferStager):
 
 
 class ObjectBufferConsumer(BufferConsumer):
-    def __init__(self, fut: Future) -> None:
+    def __init__(self, fut: Future, entry: Optional[ObjectEntry] = None) -> None:
         self._fut = fut
+        self._entry = entry
         self._nbytes_hint = 4096
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
-        from .. import staging
+        from .. import integrity, staging
 
+        if self._entry is not None:
+            integrity.verify(buf, self._entry.checksum, self._entry.location)
         self._fut.obj = staging.maybe_unwrap_prng_key(
             serialization.pickle_load_from_bytes(bytes(buf))
         )

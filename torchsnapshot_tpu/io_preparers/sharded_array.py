@@ -325,6 +325,9 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         def _work() -> None:
+            from .. import integrity
+
+            integrity.verify(buf, self._piece_entry.checksum, self._piece_entry.location)
             piece = serialization.array_from_memoryview(
                 memoryview(buf), self._piece_entry.dtype, self._piece_sizes
             )

@@ -48,6 +48,7 @@ class TensorEntry(Entry):
     shape: List[int]
     replicated: bool
     byte_range: Optional[List[int]] = None
+    checksum: Optional[str] = None  # "xxh64:<hex>" of the payload bytes
 
     def __init__(
         self,
@@ -57,6 +58,7 @@ class TensorEntry(Entry):
         shape: List[int],
         replicated: bool,
         byte_range: Optional[List[int]] = None,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -65,6 +67,7 @@ class TensorEntry(Entry):
         self.shape = shape
         self.replicated = replicated
         self.byte_range = byte_range
+        self.checksum = checksum
 
     @property
     def byte_range_tuple(self) -> Optional[tuple]:
@@ -178,15 +181,22 @@ class ObjectEntry(Entry):
     serializer: str
     obj_type: str
     replicated: bool
+    checksum: Optional[str] = None
 
     def __init__(
-        self, location: str, serializer: str, obj_type: str, replicated: bool
+        self,
+        location: str,
+        serializer: str,
+        obj_type: str,
+        replicated: bool,
+        checksum: Optional[str] = None,
     ) -> None:
         super().__init__(type="object")
         self.location = location
         self.serializer = serializer
         self.obj_type = obj_type
         self.replicated = replicated
+        self.checksum = checksum
 
 
 @dataclass
@@ -333,6 +343,8 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
         )
         if entry.byte_range is not None:
             d["byte_range"] = entry.byte_range
+        if entry.checksum is not None:
+            d["checksum"] = entry.checksum
     elif isinstance(entry, ShardedArrayEntry):
         d.update(
             dtype=entry.dtype,
@@ -359,6 +371,8 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
             obj_type=entry.obj_type,
             replicated=entry.replicated,
         )
+        if entry.checksum is not None:
+            d["checksum"] = entry.checksum
     elif isinstance(entry, (DictEntry, OrderedDictEntry)):
         d["keys"] = entry.keys
     elif isinstance(entry, NamedTupleEntry):
@@ -389,6 +403,7 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
             shape=list(d["shape"]),
             replicated=bool(d["replicated"]),
             byte_range=list(d["byte_range"]) if d.get("byte_range") else None,
+            checksum=d.get("checksum"),
         )
     if typ == "ShardedArray":
         return ShardedArrayEntry(
@@ -416,6 +431,7 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
             serializer=d["serializer"],
             obj_type=d["obj_type"],
             replicated=bool(d["replicated"]),
+            checksum=d.get("checksum"),
         )
     if typ == "list":
         return ListEntry()

@@ -39,7 +39,29 @@ class NativeFileIO:
         ]
         lib.tpusnap_file_size.restype = ctypes.c_int64
         lib.tpusnap_file_size.argtypes = [ctypes.c_char_p]
+        lib.tpusnap_xxhash64.restype = ctypes.c_uint64
+        lib.tpusnap_xxhash64.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+        ]
         self._lib = lib
+
+    def xxhash64(self, buf) -> int:
+        view = memoryview(buf)
+        if not view.c_contiguous:
+            view = memoryview(bytes(view))
+        view = view.cast("B")
+        nbytes = view.nbytes
+        if nbytes == 0:
+            return int(self._lib.tpusnap_xxhash64(b"", 0, 0))
+        if isinstance(buf, bytes):
+            c_buf: Any = ctypes.c_char_p(buf)
+        elif view.readonly:
+            c_buf = (ctypes.c_char * nbytes).from_buffer_copy(view)
+        else:
+            c_buf = (ctypes.c_char * nbytes).from_buffer(view)
+        return int(self._lib.tpusnap_xxhash64(c_buf, nbytes, 0))
 
     @classmethod
     def maybe_create(cls) -> Optional["NativeFileIO"]:

@@ -237,18 +237,22 @@ class Snapshot:
         if not knobs.is_batching_disabled():
             entries, write_reqs = batch_write_requests(entries, write_reqs)
 
-        global_manifest = cls._gather_manifest(entries, pg)
-        metadata = SnapshotMetadata(
-            version=MANIFEST_VERSION,
-            world_size=world_size,
-            manifest=global_manifest,
-        )
         memory_budget_bytes = get_process_memory_budget_bytes(pg)
         pending_io_work = sync_execute_write_reqs(
             write_reqs=write_reqs,
             storage=storage,
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
+        )
+        # Gather the manifest AFTER staging (sync_execute_write_reqs returns
+        # once staging is drained): stagers annotate their entries with
+        # payload checksums, which must reach the gathered copy.  Still on
+        # the main thread — collectives are forbidden off it.
+        global_manifest = cls._gather_manifest(entries, pg)
+        metadata = SnapshotMetadata(
+            version=MANIFEST_VERSION,
+            world_size=world_size,
+            manifest=global_manifest,
         )
         return pending_io_work, metadata
 
