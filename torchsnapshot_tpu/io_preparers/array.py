@@ -324,6 +324,9 @@ class _PickleArrayConsumer(BufferConsumer):
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        from .. import integrity
+
+        integrity.verify(buf, self._entry.checksum, self._entry.location)
         value = serialization.pickle_load_from_bytes(bytes(buf))
         target = self._obj_out
         if isinstance(target, np.ndarray) and target.flags.writeable and list(

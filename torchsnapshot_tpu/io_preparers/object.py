@@ -54,7 +54,7 @@ class ObjectIOPreparer:
 
 
 class ObjectBufferStager(BufferStager):
-    def __init__(self, obj: Any, entry: Optional[ObjectEntry] = None) -> None:
+    def __init__(self, obj: Any, entry: ObjectEntry) -> None:
         self._obj = obj
         self._entry = entry
 
@@ -62,8 +62,7 @@ class ObjectBufferStager(BufferStager):
         from .. import integrity
 
         data = serialization.pickle_save_as_bytes(self._obj)
-        if self._entry is not None:
-            self._entry.checksum = integrity.compute(data)
+        self._entry.checksum = integrity.compute(data)
         return data
 
     def get_staging_cost_bytes(self) -> int:
@@ -73,7 +72,7 @@ class ObjectBufferStager(BufferStager):
 
 
 class ObjectBufferConsumer(BufferConsumer):
-    def __init__(self, fut: Future, entry: Optional[ObjectEntry] = None) -> None:
+    def __init__(self, fut: Future, entry: ObjectEntry) -> None:
         self._fut = fut
         self._entry = entry
         self._nbytes_hint = 4096
@@ -83,8 +82,7 @@ class ObjectBufferConsumer(BufferConsumer):
     ) -> None:
         from .. import integrity, staging
 
-        if self._entry is not None:
-            integrity.verify(buf, self._entry.checksum, self._entry.location)
+        integrity.verify(buf, self._entry.checksum, self._entry.location)
         self._fut.obj = staging.maybe_unwrap_prng_key(
             serialization.pickle_load_from_bytes(bytes(buf))
         )

@@ -57,10 +57,14 @@ class NativeFileIO:
             return int(self._lib.tpusnap_xxhash64(b"", 0, 0))
         if isinstance(buf, bytes):
             c_buf: Any = ctypes.c_char_p(buf)
-        elif view.readonly:
-            c_buf = (ctypes.c_char * nbytes).from_buffer_copy(view)
         else:
-            c_buf = (ctypes.c_char * nbytes).from_buffer(view)
+            # Zero-copy even for read-only views (np.asarray of a jax.Array
+            # is read-only — the common TPU save path): np.frombuffer aliases
+            # the buffer without copying and exposes its address.
+            import numpy as np
+
+            arr = np.frombuffer(view, np.uint8)
+            c_buf = ctypes.c_void_p(arr.ctypes.data)
         return int(self._lib.tpusnap_xxhash64(c_buf, nbytes, 0))
 
     @classmethod
