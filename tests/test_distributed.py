@@ -242,6 +242,53 @@ def test_elastic_downscale_restore():
 
 
 @run_with_procs(nproc=2)
+def _successive_snapshots_body():
+    """Multiple takes + restores through ONE pg over a persistent store:
+    collective key generations must stay monotonic (regression for the
+    stale-generation torn-snapshot hazard of per-call wrappers)."""
+    import shutil
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+    pg = make_test_pg()
+    rank = pg.get_rank()
+    root = os.path.join(SNAP_ROOT, "successive")
+    if rank == 0:
+        shutil.rmtree(root, ignore_errors=True)
+    pg.barrier()
+
+    for step in (1, 2, 3):
+        app_state = {
+            "m": StateDict(
+                {
+                    "w": np.full((8,), float(step * 10 + rank), np.float32),
+                    "shared": np.full((4,), float(step), np.float32),
+                }
+            )
+        }
+        snapshot = Snapshot.take(
+            os.path.join(root, f"step{step}"), app_state, pg=pg,
+            replicated=["m/shared"],
+        )
+        dst = {"m": StateDict({})}
+        snapshot.restore(dst)
+        assert_state_dict_eq(dst["m"].state_dict(), app_state["m"].state_dict())
+
+    # older snapshots still restore correctly after later ones were taken
+    early = Snapshot(os.path.join(root, "step1"), pg=pg)
+    dst = {"m": StateDict({})}
+    early.restore(dst)
+    np.testing.assert_array_equal(
+        dst["m"]["shared"], np.full((4,), 1.0, np.float32)
+    )
+
+
+def test_successive_snapshots_one_pg():
+    _successive_snapshots_body()
+
+
+@run_with_procs(nproc=2)
 def _async_take_body():
     import shutil
 

@@ -87,14 +87,13 @@ class NativeFileIO:
         if nbytes == 0:
             with open(path, "wb"):
                 return
-        if isinstance(buf, bytes):
-            # c_char_p borrows the bytes object's pointer — no copy
-            c_buf: Any = ctypes.c_char_p(buf)
-        elif view.readonly:
-            c_buf = (ctypes.c_char * nbytes).from_buffer_copy(view)
-        else:
-            # zero-copy for staged array buffers (the hot path)
-            c_buf = (ctypes.c_char * nbytes).from_buffer(view)
+        # Zero-copy regardless of writability: np.frombuffer aliases any
+        # buffer (incl. the read-only host views jax staging produces) and
+        # exposes its address for the GIL-released native write.
+        import numpy as np
+
+        arr = np.frombuffer(view, np.uint8)
+        c_buf = ctypes.c_void_p(arr.ctypes.data)
         rc = self._lib.tpusnap_write_file(path.encode(), c_buf, nbytes)
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)

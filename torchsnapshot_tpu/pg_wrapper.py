@@ -46,11 +46,23 @@ class PGWrapper:
         self._timeout_s = timeout_s
         self._generation = 0
 
+    _from_jax_cache: Optional["PGWrapper"] = None
+
     @classmethod
     def from_jax(cls, prefix: str = "pg") -> "PGWrapper":
         """Process group for the current jax.distributed job: rank/world from
         the runtime, store resolved from the environment (tpustore addr,
-        shared-FS path, or the JAX coordination service)."""
+        shared-FS path, or the JAX coordination service).
+
+        The instance is cached per process: collective key namespaces are
+        generation-numbered per wrapper, so every default-pg call sharing one
+        wrapper keeps generations monotonic across successive snapshots.  The
+        backing store must be job-scoped (tpustore and the JAX coordination
+        service are by construction; a TPUSNAP_STORE_PATH directory must be
+        unique per job, like torch's FileStore).
+        """
+        if cls._from_jax_cache is not None:
+            return cls._from_jax_cache
         from .coordination import jax_process_info
         from .dist_store import get_or_create_store
 
@@ -61,7 +73,9 @@ class PGWrapper:
         if world_size == 1:
             return cls()
         store = get_or_create_store(rank, world_size)
-        return cls(store=store, rank=rank, world_size=world_size, prefix=prefix)
+        pg = cls(store=store, rank=rank, world_size=world_size, prefix=prefix)
+        cls._from_jax_cache = pg
+        return pg
 
     def get_rank(self) -> int:
         return self._rank

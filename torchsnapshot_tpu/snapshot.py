@@ -77,7 +77,7 @@ class Snapshot:
         pg: Optional[PGWrapper] = None,
     ) -> None:
         self.path = path
-        self._pg = pg or PGWrapper()
+        self._pg = pg or PGWrapper.from_jax()
         self._metadata: Optional[SnapshotMetadata] = None
 
     # ------------------------------------------------------------------ take
@@ -90,7 +90,7 @@ class Snapshot:
         pg: Optional[PGWrapper] = None,
         replicated: Optional[List[str]] = None,
     ) -> "Snapshot":
-        pg = pg or PGWrapper()
+        pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
         log_event(Event(name="take.start", metadata=dict(event_metadata)))
@@ -139,7 +139,7 @@ class Snapshot:
         the metadata commit continue on a background thread
         (reference :229-317).  Training may resume — and donate device
         buffers — immediately."""
-        pg = pg or PGWrapper()
+        pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         event_metadata = {
             "unique_id": unique_id,
@@ -388,9 +388,14 @@ class Snapshot:
         memory_budget_bytes: Optional[int] = None,
     ) -> Any:
         """Random access to one value: ``path`` is ``"<rank>/<logical_path>"``
-        (reference :397-501)."""
+        (reference :397-501).
+
+        Deliberately NON-collective: any rank may call it alone (the local
+        uuid below and the local PGWrapper for the budget keep it free of
+        store traffic), unlike restore(), which is collective by contract.
+        """
         event_metadata = {
-            "unique_id": _gen_unique_id(self._pg),
+            "unique_id": uuid.uuid4().hex,
             "rank": self._pg.get_rank(),
             "action": "read_object",
         }
@@ -419,7 +424,7 @@ class Snapshot:
                 read_reqs=read_reqs,
                 storage=storage,
                 memory_budget_bytes=memory_budget_bytes
-                or get_process_memory_budget_bytes(self._pg),
+                or get_process_memory_budget_bytes(PGWrapper()),
                 rank=self._pg.get_rank(),
             )
             storage.sync_close()
@@ -440,7 +445,8 @@ class Snapshot:
 
     def get_state_dict_for_key(self, key: str) -> Dict[str, Any]:
         """Materialize the full (merged across ranks) state dict saved under
-        an app-state key, without a target stateful (reference :684-726)."""
+        an app-state key, without a target stateful (reference :684-726).
+        Non-collective, like read_object."""
         storage = url_to_storage_plugin(self.path)
         metadata = self._get_metadata(storage)
         local_manifest, _ = get_manifest_for_rank(metadata, 0)
@@ -466,7 +472,7 @@ class Snapshot:
         sync_execute_read_reqs(
             read_reqs=read_reqs,
             storage=storage,
-            memory_budget_bytes=get_process_memory_budget_bytes(self._pg),
+            memory_budget_bytes=get_process_memory_budget_bytes(PGWrapper()),
             rank=self._pg.get_rank(),
         )
         storage.sync_close()
