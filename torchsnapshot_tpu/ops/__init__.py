@@ -1,0 +1,12 @@
+"""Device-side ops for the checkpoint path.
+
+A checkpointing framework's device work is memory movement, not FLOPs: the
+only on-device transforms are (a) shard/chunk slicing and (b) the
+bitcast-to-u8 staging repack (staging.py) — each a single XLA op that the
+compiler already emits optimally (a slice is one DMA; a bitcast is free or
+one HBM pass).  A hand-written Pallas kernel cannot beat a DMA, so this
+package deliberately contains no kernels today; it exists as the landing
+spot for future device-side work where a fused kernel *would* pay off —
+e.g. on-device dequantization fused into restore device_puts, or CRC
+computed during the D2H stream.
+"""
