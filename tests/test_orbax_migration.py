@@ -1,0 +1,33 @@
+"""Orbax → torchsnapshot_tpu migration round-trip."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def test_migrate_from_orbax(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")
+    from torchsnapshot_tpu.tricks.orbax import migrate_from_orbax
+
+    tree = {
+        "params": {"w": jnp.arange(32, dtype=jnp.float32).reshape(8, 4)},
+        "step": np.int64(17),
+    }
+    orbax_dir = str(tmp_path / "orbax_ckpt")
+    ocp.PyTreeCheckpointer().save(orbax_dir, tree)
+
+    snapshot = migrate_from_orbax(orbax_dir, str(tmp_path / "snap"), key="train")
+    dst = {"train": StateDict({})}
+    snapshot.restore(dst)
+    restored = dst["train"].state_dict()
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(32).reshape(8, 4)
+    )
+    assert int(restored["step"]) == 17
+
+    # reopened from disk too
+    snapshot2 = Snapshot(str(tmp_path / "snap"))
+    w = snapshot2.read_object("0/train/params/w")
+    np.testing.assert_array_equal(np.asarray(w), np.arange(32).reshape(8, 4))

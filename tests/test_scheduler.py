@@ -140,6 +140,61 @@ def test_staging_failure_raises():
         )
 
 
+def test_read_budget_respected():
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="test_read_budget")
+    payloads = {f"p{i}": bytes([i]) * 100 for i in range(10)}
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=_TrackingStager(v, cost=100))
+        for k, v in payloads.items()
+    ]
+    sync_execute_write_reqs(write_reqs, storage, 1 << 20, 0).sync_complete()
+
+    outstanding = {"now": 0, "peak": 0}
+
+    class _CostedConsumer(_CollectConsumer):
+        async def consume_buffer(self, buf, executor=None):
+            outstanding["now"] += self.cost
+            outstanding["peak"] = max(outstanding["peak"], outstanding["now"])
+            await asyncio.sleep(0.001)
+            await super().consume_buffer(buf, executor)
+            outstanding["now"] -= self.cost
+
+    sink: dict = {}
+    read_reqs = [
+        ReadReq(path=k, buffer_consumer=_CostedConsumer(sink, k, cost=100))
+        for k in payloads
+    ]
+    # budget 250 with cost-100 items: at most 2 concurrently consuming
+    sync_execute_read_reqs(read_reqs, storage, memory_budget_bytes=250, rank=0)
+    assert sink == payloads
+    assert outstanding["peak"] <= 250
+
+
+def test_sync_take_failure_no_metadata(tmp_path):
+    """Sync-save failure must not commit .snapshot_metadata (commit
+    protocol, sync side — async side covered in test_distributed)."""
+    import os
+    from unittest import mock
+
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    class FaultyFS(fs_mod.FSStoragePlugin):
+        async def write(self, write_io):
+            raise RuntimeError("injected write failure")
+
+    with mock.patch.object(fs_mod, "FSStoragePlugin", FaultyFS):
+        with pytest.raises(RuntimeError, match="injected"):
+            Snapshot.take(
+                str(tmp_path / "snap"),
+                {"m": StateDict({"w": np.ones(8, np.float32)})},
+            )
+    assert not os.path.exists(tmp_path / "snap" / ".snapshot_metadata")
+
+
 def test_memory_budget_env_override():
     from torchsnapshot_tpu import knobs
 

@@ -1,0 +1,49 @@
+"""Migration helpers from orbax.checkpoint — the adoption path for existing
+JAX training jobs (the reference's tricks/ package plays the same role for
+DDP/FSDP/DeepSpeed users; here the incumbent ecosystem is orbax).
+
+``migrate_from_orbax`` reads an orbax PyTree checkpoint and writes it as a
+torchsnapshot_tpu snapshot; ``restore_into`` loads an orbax checkpoint
+directly into app-state form without writing anything.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..snapshot import Snapshot
+from ..state_dict import StateDict
+
+
+def _load_orbax_tree(orbax_path: str, abstract_tree: Optional[Any] = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if abstract_tree is not None:
+        restore_args = ocp.checkpoint_utils.construct_restore_args(abstract_tree)
+        return ckptr.restore(
+            orbax_path, args=ocp.args.PyTreeRestore(restore_args=restore_args)
+        )
+    return ckptr.restore(orbax_path)
+
+
+def migrate_from_orbax(
+    orbax_path: str,
+    snapshot_path: str,
+    key: str = "state",
+    abstract_tree: Optional[Any] = None,
+) -> Snapshot:
+    """Convert an orbax PyTree checkpoint into a torchsnapshot_tpu snapshot.
+
+    ``abstract_tree`` (a pytree of jax.ShapeDtypeStruct with shardings) makes
+    orbax restore sharded arrays onto devices; without it values come back as
+    host numpy arrays — fine for conversion.
+    """
+    tree = _load_orbax_tree(orbax_path, abstract_tree)
+    app_state: Dict[str, Any] = {key: StateDict(tree if isinstance(tree, dict) else {"tree": tree})}
+    return Snapshot.take(snapshot_path, app_state)
+
+
+def restore_into(orbax_path: str, abstract_tree: Optional[Any] = None) -> Any:
+    """Load an orbax checkpoint as a plain pytree (no snapshot written)."""
+    return _load_orbax_tree(orbax_path, abstract_tree)
