@@ -124,6 +124,20 @@ def _run_world(worker, world: int) -> None:
 
 def test_multihost_gspmd_snapshot():
     _run_world(_worker, world=2)
+    # Elastic cross-world restore: the snapshot saved by 2 processes restores
+    # in THIS single process (world size 1) — merged shard records reassemble
+    # the global array host-side (reference manifest_ops merge + overlap
+    # reads, SURVEY.md §3.5).
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    snapshot = Snapshot(SNAP_PATH)
+    dst = {"m": StateDict({"w": np.zeros((16, 4), np.float32)})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(
+        dst["m"]["w"], np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    )
 
 
 def _hsdp_worker(rank: int, world: int, coord_port: int, store_path: str, conn) -> None:
