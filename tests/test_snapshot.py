@@ -153,6 +153,32 @@ def test_replicated_glob_single_process(tmp_path):
     assert manifest["0/m/w"].location.startswith("replicated/")
 
 
+def test_restore_strict_false_forwarded(tmp_path):
+    """strict=False reaches statefuls whose load_state_dict accepts it
+    (reference snapshot.py:775-778)."""
+    calls = {}
+
+    class StrictAware:
+        def __init__(self):
+            self.state = {"x": 1}
+
+        def state_dict(self):
+            return self.state
+
+        def load_state_dict(self, sd, strict=True):
+            calls["strict"] = strict
+            self.state = dict(sd)
+
+    app = {"m": StrictAware()}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), app)
+    dst = StrictAware()
+    snapshot.restore({"m": dst}, strict=False)
+    assert calls["strict"] is False
+    assert dst.state == {"x": 1}
+    snapshot.restore({"m": dst})  # default strict path
+    assert calls["strict"] is True
+
+
 def test_non_stateful_value_raises(tmp_path):
     with pytest.raises(TypeError, match="not.*Stateful|Stateful"):
         Snapshot.take(str(tmp_path / "snap"), {"m": {"w": 1}})

@@ -122,11 +122,24 @@ class PendingIOWork:
 
     def sync_complete(self) -> None:
         begin = time.monotonic()
-        if self._io_tasks:
-            self._loop.run_until_complete(asyncio.gather(*self._io_tasks))
-        if self._executor is not None:
-            self._executor.shutdown()
-        self._loop.close()
+        try:
+            if self._io_tasks:
+                self._loop.run_until_complete(asyncio.gather(*self._io_tasks))
+        except BaseException:
+            # First failure propagates; cancel and drain the rest so the loop
+            # closes clean and staged host buffers release promptly.
+            pending = [t for t in self._io_tasks if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+            raise
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown()
+            self._loop.close()
         elapsed = time.monotonic() - begin
         if elapsed > 0 and self.bytes_total:
             logger.debug(

@@ -101,21 +101,24 @@ class Snapshot:
                 path, pg, replicated or []
             )
             storage = url_to_storage_plugin(path)
-            pending_io_work, metadata = cls._take_impl(
-                path=path,
-                app_state=app_state,
-                replicated_patterns=replicated_patterns,
-                storage=storage,
-                pg=pg,
-                is_async_snapshot=False,
-            )
-            pending_io_work.sync_complete()
-            # All ranks' payloads durable → rank 0 commits (reference :202-209).
-            pg.barrier()
-            if pg.get_rank() == 0:
-                cls._write_snapshot_metadata(metadata, storage)
-            pg.barrier()
-            storage.sync_close()
+            try:
+                pending_io_work, metadata = cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    replicated_patterns=replicated_patterns,
+                    storage=storage,
+                    pg=pg,
+                    is_async_snapshot=False,
+                )
+                pending_io_work.sync_complete()
+                # All ranks' payloads durable → rank 0 commits (reference
+                # :202-209).
+                pg.barrier()
+                if pg.get_rank() == 0:
+                    cls._write_snapshot_metadata(metadata, storage)
+                pg.barrier()
+            finally:
+                storage.sync_close()
             snapshot = cls(path=path, pg=pg)
             snapshot._metadata = metadata
             event_metadata["duration_s"] = time.monotonic() - begin
@@ -152,14 +155,18 @@ class Snapshot:
             path, pg, replicated or []
         )
         storage = url_to_storage_plugin(path)
-        pending_io_work, metadata = cls._take_impl(
-            path=path,
-            app_state=app_state,
-            replicated_patterns=replicated_patterns,
-            storage=storage,
-            pg=pg,
-            is_async_snapshot=True,
-        )
+        try:
+            pending_io_work, metadata = cls._take_impl(
+                path=path,
+                app_state=app_state,
+                replicated_patterns=replicated_patterns,
+                storage=storage,
+                pg=pg,
+                is_async_snapshot=True,
+            )
+        except BaseException:
+            storage.sync_close()
+            raise
         return PendingSnapshot(
             path=path,
             pending_io_work=pending_io_work,
@@ -258,8 +265,12 @@ class Snapshot:
 
     # --------------------------------------------------------------- restore
 
-    def restore(self, app_state: AppState) -> None:
-        """Restores the app state in-place (reference :319-395)."""
+    def restore(self, app_state: AppState, strict: bool = True) -> None:
+        """Restores the app state in-place (reference :319-395).
+
+        ``strict=False`` is forwarded to any stateful whose
+        ``load_state_dict`` accepts it (reference :775-778) — useful for
+        partial restores into modules with extra/missing keys."""
         self._validate_app_state(app_state)
         pg = self._pg
         rank = pg.get_rank()
@@ -272,38 +283,41 @@ class Snapshot:
         begin = time.monotonic()
         try:
             storage = url_to_storage_plugin(self.path)
-            metadata = self._get_metadata(storage)
-            app_state = dict(app_state)
-            rng_state_item = self._pop_rng_state(app_state)
-            global_keys = self._gather_keys(app_state, pg)
-            memory_budget_bytes = get_process_memory_budget_bytes(pg)
-            for key in global_keys:
-                if key not in app_state:
-                    raise RuntimeError(
-                        f"Rank {rank} is missing app_state key {key!r}"
+            try:
+                metadata = self._get_metadata(storage)
+                app_state = dict(app_state)
+                rng_state_item = self._pop_rng_state(app_state)
+                global_keys = self._gather_keys(app_state, pg)
+                memory_budget_bytes = get_process_memory_budget_bytes(pg)
+                for key in global_keys:
+                    if key not in app_state:
+                        raise RuntimeError(
+                            f"Rank {rank} is missing app_state key {key!r}"
+                        )
+                    self._load_stateful(
+                        stateful_key=key,
+                        stateful=app_state[key],
+                        metadata=metadata,
+                        storage=storage,
+                        memory_budget_bytes=memory_budget_bytes,
+                        pg=pg,
+                        strict=strict,
                     )
-                self._load_stateful(
-                    stateful_key=key,
-                    stateful=app_state[key],
-                    metadata=metadata,
-                    storage=storage,
-                    memory_budget_bytes=memory_budget_bytes,
-                    pg=pg,
-                )
-                pg.barrier()
-            # RNG restored last so nothing later perturbs it (reference
-            # :371-381).
-            if rng_state_item is not None:
-                key, stateful = rng_state_item
-                self._load_stateful(
-                    stateful_key=key,
-                    stateful=stateful,
-                    metadata=metadata,
-                    storage=storage,
-                    memory_budget_bytes=memory_budget_bytes,
-                    pg=pg,
-                )
-            storage.sync_close()
+                    pg.barrier()
+                # RNG restored last so nothing later perturbs it (reference
+                # :371-381).
+                if rng_state_item is not None:
+                    key, stateful = rng_state_item
+                    self._load_stateful(
+                        stateful_key=key,
+                        stateful=stateful,
+                        metadata=metadata,
+                        storage=storage,
+                        memory_budget_bytes=memory_budget_bytes,
+                        pg=pg,
+                    )
+            finally:
+                storage.sync_close()
             event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = True
             log_event(Event(name="restore.end", metadata=event_metadata))
@@ -320,6 +334,7 @@ class Snapshot:
         storage: StoragePlugin,
         memory_budget_bytes: int,
         pg: PGWrapper,
+        strict: bool = True,
     ) -> None:
         rank = pg.get_rank()
         local_manifest, merged_entries = get_manifest_for_rank(metadata, rank)
@@ -377,7 +392,10 @@ class Snapshot:
         restored_state_dict = inflate(
             container_entries, resolved, prefix=stateful_key
         )
-        stateful.load_state_dict(restored_state_dict)
+        if not strict and _accepts_strict(stateful):
+            stateful.load_state_dict(restored_state_dict, strict=False)  # type: ignore[call-arg]
+        else:
+            stateful.load_state_dict(restored_state_dict)
 
     # ----------------------------------------------------------- read_object
 
@@ -403,31 +421,34 @@ class Snapshot:
         try:
             rank_str, _, logical_path = path.partition("/")
             storage = url_to_storage_plugin(self.path)
-            metadata = self._get_metadata(storage)
-            manifest, _ = get_manifest_for_rank(metadata, int(rank_str))
-            if logical_path not in manifest:
-                raise RuntimeError(
-                    f"Path {path!r} does not exist in the snapshot (available "
-                    f"under rank {rank_str}: {sorted(manifest.keys())[:20]}...)"
+            try:
+                metadata = self._get_metadata(storage)
+                manifest, _ = get_manifest_for_rank(metadata, int(rank_str))
+                if logical_path not in manifest:
+                    raise RuntimeError(
+                        f"Path {path!r} does not exist in the snapshot "
+                        f"(available under rank {rank_str}: "
+                        f"{sorted(manifest.keys())[:20]}...)"
+                    )
+                entry = manifest[logical_path]
+                if isinstance(entry, PrimitiveEntry):
+                    # No storage I/O needed (reference :467-468).
+                    return entry.get_value()
+                read_reqs, fut = io_preparer.prepare_read(
+                    entry,
+                    obj_out,
+                    buffer_size_limit_bytes=memory_budget_bytes,
                 )
-            entry = manifest[logical_path]
-            if isinstance(entry, PrimitiveEntry):
-                # No storage I/O needed (reference :467-468).
-                return entry.get_value()
-            read_reqs, fut = io_preparer.prepare_read(
-                entry,
-                obj_out,
-                buffer_size_limit_bytes=memory_budget_bytes,
-            )
-            read_reqs = batch_read_requests(read_reqs)
-            sync_execute_read_reqs(
-                read_reqs=read_reqs,
-                storage=storage,
-                memory_budget_bytes=memory_budget_bytes
-                or get_process_memory_budget_bytes(PGWrapper()),
-                rank=self._pg.get_rank(),
-            )
-            storage.sync_close()
+                read_reqs = batch_read_requests(read_reqs)
+                sync_execute_read_reqs(
+                    read_reqs=read_reqs,
+                    storage=storage,
+                    memory_budget_bytes=memory_budget_bytes
+                    or get_process_memory_budget_bytes(PGWrapper()),
+                    rank=self._pg.get_rank(),
+                )
+            finally:
+                storage.sync_close()
             event_metadata["is_success"] = True
             log_event(Event(name="read_object.end", metadata=event_metadata))
             return fut.obj
@@ -448,34 +469,36 @@ class Snapshot:
         an app-state key, without a target stateful (reference :684-726).
         Non-collective, like read_object."""
         storage = url_to_storage_plugin(self.path)
-        metadata = self._get_metadata(storage)
-        local_manifest, _ = get_manifest_for_rank(metadata, 0)
-        prefix = key + "/"
-        sub_manifest = {
-            path: entry
-            for path, entry in local_manifest.items()
-            if path == key or path.startswith(prefix)
-        }
-        if not sub_manifest:
-            raise RuntimeError(f"Key {key!r} not found in snapshot manifest")
-        read_reqs: List[ReadReq] = []
-        futures: Dict[str, Future] = {}
-        container_entries: Manifest = {}
-        for path, entry in sub_manifest.items():
-            if is_container_entry(entry):
-                container_entries[path] = entry
-                continue
-            entry_read_reqs, fut = io_preparer.prepare_read(entry, None)
-            read_reqs += entry_read_reqs
-            futures[path] = fut
-        read_reqs = batch_read_requests(read_reqs)
-        sync_execute_read_reqs(
-            read_reqs=read_reqs,
-            storage=storage,
-            memory_budget_bytes=get_process_memory_budget_bytes(PGWrapper()),
-            rank=self._pg.get_rank(),
-        )
-        storage.sync_close()
+        try:
+            metadata = self._get_metadata(storage)
+            local_manifest, _ = get_manifest_for_rank(metadata, 0)
+            prefix = key + "/"
+            sub_manifest = {
+                path: entry
+                for path, entry in local_manifest.items()
+                if path == key or path.startswith(prefix)
+            }
+            if not sub_manifest:
+                raise RuntimeError(f"Key {key!r} not found in snapshot manifest")
+            read_reqs: List[ReadReq] = []
+            futures: Dict[str, Future] = {}
+            container_entries: Manifest = {}
+            for path, entry in sub_manifest.items():
+                if is_container_entry(entry):
+                    container_entries[path] = entry
+                    continue
+                entry_read_reqs, fut = io_preparer.prepare_read(entry, None)
+                read_reqs += entry_read_reqs
+                futures[path] = fut
+            read_reqs = batch_read_requests(read_reqs)
+            sync_execute_read_reqs(
+                read_reqs=read_reqs,
+                storage=storage,
+                memory_budget_bytes=get_process_memory_budget_bytes(PGWrapper()),
+                rank=self._pg.get_rank(),
+            )
+        finally:
+            storage.sync_close()
         resolved = {path: fut.obj for path, fut in futures.items()}
         return inflate(container_entries, resolved, prefix=key)
 
@@ -685,6 +708,10 @@ class PendingSnapshot:
                     barrier.report_error(repr(e))
                 except Exception:
                     pass
+            try:
+                self._storage.sync_close()
+            except Exception:
+                pass
             log_event(
                 Event(
                     name="async_take.end",
@@ -710,6 +737,19 @@ class PendingSnapshot:
 
     def done(self) -> bool:
         return self._done_event.is_set()
+
+
+def _accepts_strict(stateful: Stateful) -> bool:
+    import inspect
+
+    try:
+        params = inspect.signature(stateful.load_state_dict).parameters
+    except (TypeError, ValueError):
+        return False
+    if "strict" in params:
+        return True
+    # **kwargs delegation patterns forward strict to an inner module
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
 
 
 def _gen_unique_id(pg: PGWrapper) -> str:
