@@ -1,0 +1,36 @@
+"""Inspection CLI tests."""
+
+import numpy as np
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.__main__ import main
+
+
+def _snap(tmp_path):
+    state = {"w": np.arange(8, dtype=np.float32), "step": 12}
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    return str(tmp_path / "snap")
+
+
+def test_cli_info(tmp_path, capsys):
+    path = _snap(tmp_path)
+    assert main(["info", path]) == 0
+    out = capsys.readouterr().out
+    assert "world_size:  1" in out
+    assert "entries:" in out
+
+
+def test_cli_ls(tmp_path, capsys):
+    path = _snap(tmp_path)
+    assert main(["ls", path]) == 0
+    out = capsys.readouterr().out
+    assert "0/m/w" in out and "float32" in out
+    assert "primitive:int=12" in out
+
+
+def test_cli_cat(tmp_path, capsys):
+    path = _snap(tmp_path)
+    assert main(["cat", path, "0/m/step"]) == 0
+    assert capsys.readouterr().out.strip() == "12"
+    assert main(["cat", path, "0/m/w"]) == 0
+    assert "0." in capsys.readouterr().out

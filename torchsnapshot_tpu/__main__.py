@@ -1,0 +1,142 @@
+"""Snapshot inspection CLI.
+
+    python -m torchsnapshot_tpu ls <snapshot-url> [--rank N]
+    python -m torchsnapshot_tpu cat <snapshot-url> <rank/logical/path>
+    python -m torchsnapshot_tpu info <snapshot-url>
+
+Read-only; works against any storage backend URL.  (Beyond reference parity:
+the reference ships no CLI.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _human(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def _entry_size(entry) -> int:
+    from . import serialization
+    from .manifest import ChunkedTensorEntry, ShardedArrayEntry, TensorEntry
+
+    if isinstance(entry, TensorEntry):
+        try:
+            return serialization.array_nbytes(entry.shape, entry.dtype)
+        except ValueError:
+            return 0
+    if isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+        shards = entry.shards if isinstance(entry, ShardedArrayEntry) else entry.chunks
+        return sum(_entry_size(s.tensor) for s in shards)
+    return 0
+
+
+def cmd_info(args: argparse.Namespace) -> int:
+    from .manifest import ShardedArrayEntry
+    from .snapshot import Snapshot
+
+    md = Snapshot(args.path).metadata
+    # Un-partitioned saves may leave identical shard records on several
+    # ranks; count each (logical path, offsets, sizes) once, like the
+    # restore-time merge does (manifest_ops._get_merged_sharded_entries).
+    total = 0
+    seen_shards = set()
+    for path, entry in md.manifest.items():
+        if isinstance(entry, ShardedArrayEntry):
+            _, _, logical = path.partition("/")
+            for shard in entry.shards:
+                key = (logical, tuple(shard.offsets), tuple(shard.sizes))
+                if key in seen_shards:
+                    continue
+                seen_shards.add(key)
+                total += _entry_size(shard.tensor)
+        else:
+            total += _entry_size(entry)
+    print(f"path:        {args.path}")
+    print(f"version:     {md.version}")
+    print(f"world_size:  {md.world_size}")
+    print(f"entries:     {len(md.manifest)}")
+    print(f"array bytes: {_human(total)}")
+    return 0
+
+
+def cmd_ls(args: argparse.Namespace) -> int:
+    from .manifest import PrimitiveEntry, ShardedArrayEntry
+    from .manifest_ops import get_manifest_for_rank
+    from .snapshot import Snapshot
+
+    md = Snapshot(args.path).metadata
+    if args.rank is not None:
+        # The per-rank view re-injects consolidated replicated entries and
+        # merges shards — what the rank would actually restore.
+        local, _ = get_manifest_for_rank(md, args.rank)
+        manifest = {f"{args.rank}/{p}": e for p, e in local.items()}
+    else:
+        manifest = md.manifest
+    for path in sorted(manifest):
+        entry = manifest[path]
+        desc = entry.type
+        if hasattr(entry, "dtype") and hasattr(entry, "shape"):
+            desc = f"{entry.type}[{entry.dtype}{list(entry.shape)}]"
+            size = _entry_size(entry)
+            if size:
+                desc += f" {_human(size)}"
+        if isinstance(entry, ShardedArrayEntry):
+            desc += f" shards={len(entry.shards)}"
+            if entry.partition_spec is not None:
+                desc += f" spec={entry.partition_spec}"
+        if isinstance(entry, PrimitiveEntry):
+            desc = f"primitive:{entry.entry_type}={entry.readable[:40]}"
+        if getattr(entry, "replicated", False):
+            desc += " (replicated)"
+        print(f"{path}  {desc}")
+    return 0
+
+
+def cmd_cat(args: argparse.Namespace) -> int:
+    from .snapshot import Snapshot
+
+    value = Snapshot(args.path).read_object(args.object_path)
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray) or hasattr(value, "shape"):
+            with np.printoptions(threshold=64, edgeitems=4):
+                print(np.asarray(value))
+            return 0
+    except Exception:
+        pass
+    print(value)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info", help="snapshot summary")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_info)
+
+    p = sub.add_parser("ls", help="list manifest entries")
+    p.add_argument("path")
+    p.add_argument("--rank", type=int, default=None)
+    p.set_defaults(fn=cmd_ls)
+
+    p = sub.add_parser("cat", help="print one value (rank/logical/path)")
+    p.add_argument("path")
+    p.add_argument("object_path")
+    p.set_defaults(fn=cmd_cat)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
