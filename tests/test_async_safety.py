@@ -1,0 +1,54 @@
+"""Async-snapshot safety: after async_take returns, the caller may mutate
+host arrays and donate/overwrite device buffers without corrupting the
+snapshot (the reference's defensive-copy contract, tensor.py:283-307; our
+contract is staging-complete-before-return, SURVEY.md §3.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def test_host_mutation_after_async_take(tmp_path):
+    arr = np.arange(1024, dtype=np.float32)
+    app_state = {"m": StateDict({"w": arr})}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+    # Training resumes: mutate the host array before I/O completes
+    arr[:] = -1.0
+    snapshot = pending.wait()
+    dst = {"m": StateDict({})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(
+        dst["m"]["w"], np.arange(1024, dtype=np.float32)
+    )
+
+
+def test_device_donation_after_async_take(tmp_path):
+    x = jnp.arange(2048, dtype=jnp.float32)
+    expected = np.asarray(x).copy()
+    app_state = {"m": StateDict({"w": x})}
+    pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+
+    # Training step donates the buffer: x's storage may be reused/invalidated
+    step = jax.jit(lambda a: a * 0 - 7.0, donate_argnums=(0,))
+    y = jax.block_until_ready(step(x))
+    assert float(y[0]) == -7.0
+
+    snapshot = pending.wait()
+    dst = {"m": StateDict({})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), expected)
+
+
+def test_two_async_takes_back_to_back(tmp_path):
+    a1 = {"m": StateDict({"w": np.full(64, 1.0, np.float32)})}
+    a2 = {"m": StateDict({"w": np.full(64, 2.0, np.float32)})}
+    p1 = Snapshot.async_take(str(tmp_path / "s1"), a1)
+    p2 = Snapshot.async_take(str(tmp_path / "s2"), a2)
+    s1, s2 = p1.wait(), p2.wait()
+    d1, d2 = {"m": StateDict({})}, {"m": StateDict({})}
+    s1.restore(d1)
+    s2.restore(d2)
+    np.testing.assert_array_equal(d1["m"]["w"], np.full(64, 1.0))
+    np.testing.assert_array_equal(d2["m"]["w"], np.full(64, 2.0))
