@@ -40,6 +40,19 @@ def _collectives_body():
     pg.scatter_object_list(out, [f"item{r}" for r in range(ws)] if rank == 0 else None, src=0)
     assert out[0] == f"item{rank}"
 
+    gathered_root = pg.gather_object_root({"r": rank})
+    if rank == 0:
+        assert [g["r"] for g in gathered_root] == [0, 1, 2, 3]
+    else:
+        assert gathered_root is None
+
+    # non-zero root: root's own object spliced at its index, others None
+    gathered_r2 = pg.gather_object_root(rank * 100, root=2)
+    if rank == 2:
+        assert gathered_r2 == [0, 100, 200, 300]
+    else:
+        assert gathered_r2 is None
+
     pg.barrier()
 
 

@@ -95,10 +95,11 @@ def partition_write_reqs(
         else:
             private_bytes += cost
 
-    gathered = pg.all_gather_object((local_sizes, private_bytes))
+    # Rank 0 alone needs the per-rank loads: gather-to-root, not all-gather.
+    gathered = pg.gather_object_root((local_sizes, private_bytes))
 
     assignment_list: List[Dict[str, int]] = [{}]
-    if pg.get_rank() == 0:
+    if gathered is not None:
         loads = [g[1] for g in gathered]
         candidates: Dict[str, List[int]] = {}
         sizes: Dict[str, int] = {}

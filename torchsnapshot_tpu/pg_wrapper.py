@@ -110,6 +110,27 @@ class PGWrapper:
             out.append(pickle.loads(data))
         return out
 
+    def gather_object_root(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        """Gather to ``root`` only: O(world) store ops vs all_gather's
+        O(world²).  The reference's all_gather_object of full manifests is
+        O(world²) bytes at scale (SURVEY.md §7 'hard parts'); heavyweight
+        payloads (manifests, write loads) use this + one broadcast instead.
+        Returns the rank-ordered list on root, None elsewhere."""
+        if self._store is None or self._world_size == 1:
+            return [obj]
+        key = self._next_key("gather")
+        if self._rank == root:
+            out: List[Any] = []
+            for r in range(self._world_size):
+                if r == root:
+                    out.append(obj)
+                    continue
+                data = self._store.get(f"{key}/{r}", timeout_s=self._timeout_s)
+                out.append(pickle.loads(data))
+            return out
+        self._store.set(f"{key}/{self._rank}", pickle.dumps(obj))
+        return None
+
     def broadcast_object_list(self, obj_list: List[Any], src: int = 0) -> None:
         """In-place broadcast of a list of objects from ``src`` (reference
         pg_wrapper.py:59-64)."""

@@ -627,15 +627,22 @@ class Snapshot:
 
     @staticmethod
     def _gather_manifest(entries: Manifest, pg: PGWrapper) -> Manifest:
-        """All-gather per-rank entries, consolidate replicated copies, build
-        the rank-prefixed global manifest (reference :948-959, 620-635)."""
-        gathered: List[Manifest] = pg.all_gather_object(entries)
-        gathered = consolidate_replicated_entries(gathered)
-        global_manifest: Manifest = {}
-        for rank, rank_entries in enumerate(gathered):
-            for logical_path, entry in rank_entries.items():
-                global_manifest[f"{rank}/{logical_path}"] = entry
-        return global_manifest
+        """Gather per-rank entries to rank 0, consolidate replicated copies,
+        build the rank-prefixed global manifest, broadcast it once
+        (reference :948-959, 620-635 — but rank-0 gather + one broadcast is
+        O(world) store traffic where the reference's all_gather of full
+        manifests is O(world²), SURVEY.md §7)."""
+        gathered: Optional[List[Manifest]] = pg.gather_object_root(entries)
+        obj_list: List[Manifest] = [{}]
+        if gathered is not None:
+            consolidated = consolidate_replicated_entries(gathered)
+            global_manifest: Manifest = {}
+            for rank, rank_entries in enumerate(consolidated):
+                for logical_path, entry in rank_entries.items():
+                    global_manifest[f"{rank}/{logical_path}"] = entry
+            obj_list[0] = global_manifest
+        pg.broadcast_object_list(obj_list, src=0)
+        return obj_list[0]
 
 
 class PendingSnapshot:

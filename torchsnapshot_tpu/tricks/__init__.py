@@ -1,0 +1,1 @@
+from .flax import PytreeAdapter, TrainStateAdapter

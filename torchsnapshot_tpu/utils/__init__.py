@@ -1,0 +1,6 @@
+from .host_offload import (
+    is_host_resident,
+    supports_host_memory,
+    to_device_memory,
+    to_host_memory,
+)
