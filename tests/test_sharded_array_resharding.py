@@ -105,6 +105,29 @@ def test_sharded_subdivision():
         np.testing.assert_array_equal(np.asarray(fut.obj), value)
 
 
+def test_resharding_across_device_counts():
+    """Save sharded over 8 devices, restore sharded over a 4-device subset —
+    the in-process analogue of world-size elasticity for GSPMD arrays."""
+    value = np.random.RandomState(3).rand(*GLOBAL_SHAPE).astype(np.float32)
+    src = _make_sharded(value, NamedSharding(_mesh((8,), ("x",)), P("x", None)))
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="reshard_dc")
+    entry, write_reqs = io_preparer.prepare_write(
+        src, logical_path="w", rank=0, replicated=False
+    )
+    sync_execute_write_reqs(write_reqs, storage, BUDGET, 0).sync_complete()
+
+    dst_mesh = Mesh(np.array(jax.devices()[:4]), ("y",))
+    dst = _make_sharded(
+        np.zeros(GLOBAL_SHAPE, np.float32), NamedSharding(dst_mesh, P(None, "y"))
+    )
+    read_reqs, fut = io_preparer.prepare_read(entry, dst)
+    sync_execute_read_reqs(read_reqs, storage, BUDGET, 0)
+    out = fut.obj
+    assert len(out.sharding.device_set) == 4
+    np.testing.assert_array_equal(np.asarray(out), value)
+
+
 def test_partition_spec_recorded():
     value = np.zeros(GLOBAL_SHAPE, np.float32)
     src = _make_sharded(value, SHARDINGS[2][1]())

@@ -190,6 +190,20 @@ def test_missing_metadata_is_invalid_snapshot(tmp_path):
         snapshot.restore({"m": StateDict({"x": 0})})
 
 
+def test_corrupt_metadata_is_clear_error(tmp_path):
+    path = tmp_path / "snap"
+    Snapshot.take(str(path), {"m": StateDict({"x": 1})})
+    (path / ".snapshot_metadata").write_text("{not json!!")
+    with pytest.raises(Exception):
+        Snapshot(str(path)).restore({"m": StateDict({"x": 0})})
+
+
+def test_read_object_unknown_path(tmp_path):
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict({"x": 1})})
+    with pytest.raises(RuntimeError, match="does not exist"):
+        snapshot.read_object("0/m/nope")
+
+
 def test_chunked_through_snapshot(tmp_path, toggle_chunking):
     arr = np.random.RandomState(7).rand(64, 8).astype(np.float32)
     app_state = {"m": StateDict({"big": arr})}
