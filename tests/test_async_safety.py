@@ -41,6 +41,62 @@ def test_device_donation_after_async_take(tmp_path):
     np.testing.assert_array_equal(np.asarray(dst["m"]["w"]), expected)
 
 
+def test_async_take_not_blocked_by_slow_storage(tmp_path):
+    """The early-return contract: async_take returns after staging even when
+    storage is slow (reference SlowFSStoragePlugin, tests/test_async_take.py:
+    27-66).  Training stall must be decoupled from storage bandwidth."""
+    import time
+    from unittest import mock
+
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    class SlowFS(fs_mod.FSStoragePlugin):
+        async def write(self, write_io):
+            import asyncio
+
+            await asyncio.sleep(0.5)
+            await super().write(write_io)
+
+    app_state = {"m": StateDict({"w": np.arange(256, dtype=np.float32)})}
+    with mock.patch.object(fs_mod, "FSStoragePlugin", SlowFS):
+        begin = time.monotonic()
+        pending = Snapshot.async_take(str(tmp_path / "snap"), app_state)
+        stall = time.monotonic() - begin
+        snapshot = pending.wait()
+        total = time.monotonic() - begin
+    assert stall < total, (stall, total)
+    assert total >= 0.5  # the slow write really happened
+    assert stall < 0.4, f"async_take blocked {stall:.2f}s on slow storage"
+    dst = {"m": StateDict({})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], np.arange(256, dtype=np.float32))
+
+
+def test_event_handlers_fire():
+    from torchsnapshot_tpu.event_handlers import (
+        register_event_handler,
+        unregister_event_handler,
+    )
+
+    events = []
+    handler = events.append
+    register_event_handler(handler)
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            app = {"m": StateDict({"x": 1})}
+            snapshot = Snapshot.take(f"{tmp}/snap", app)
+            snapshot.restore({"m": StateDict({"x": 0})})
+    finally:
+        unregister_event_handler(handler)
+    names = [e.name for e in events]
+    assert "take.start" in names and "take.end" in names
+    assert "restore.start" in names and "restore.end" in names
+    end = next(e for e in events if e.name == "take.end")
+    assert end.metadata["is_success"] is True
+
+
 def test_two_async_takes_back_to_back(tmp_path):
     a1 = {"m": StateDict({"w": np.full(64, 1.0, np.float32)})}
     a2 = {"m": StateDict({"w": np.full(64, 2.0, np.float32)})}
