@@ -182,7 +182,7 @@ class ArrayBufferStager(BufferStager):
             handle = staging.begin_d2h(obj)
             dtype = serialization.string_to_dtype(self._entry.dtype)
             shape = self._entry.shape
-            loop = asyncio.get_event_loop()
+            loop = asyncio.get_running_loop()
             if executor is not None:
                 host = await loop.run_in_executor(
                     executor, staging.finish_d2h, handle, dtype, shape
@@ -306,7 +306,7 @@ class ArrayBufferConsumer(BufferConsumer):
             view[self._flat_offset : self._flat_offset + self._nbytes] = src
 
         if executor is not None and self._nbytes > 1 << 20:
-            await asyncio.get_event_loop().run_in_executor(executor, _copy)
+            await asyncio.get_running_loop().run_in_executor(executor, _copy)
         else:
             _copy()
         self._assembly.piece_done()

@@ -337,7 +337,7 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
 
         nbytes = serialization.array_nbytes(self._piece_sizes, self._piece_entry.dtype)
         if executor is not None and nbytes > 1 << 20:
-            await asyncio.get_event_loop().run_in_executor(executor, _work)
+            await asyncio.get_running_loop().run_in_executor(executor, _work)
         else:
             _work()
         self._restore.piece_done()

@@ -166,7 +166,7 @@ async def execute_write_reqs(
     """Stage all buffers under the memory budget, overlapping staging with
     storage I/O; return once staging has fully drained (reference
     scheduler.py:222-339)."""
-    loop = asyncio.get_event_loop()
+    loop = asyncio.get_running_loop()
     own_executor = executor is None
     if executor is None:
         executor = ThreadPoolExecutor(max_workers=_NUM_EXECUTOR_THREADS)

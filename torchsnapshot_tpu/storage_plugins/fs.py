@@ -65,14 +65,14 @@ class FSStoragePlugin(StoragePlugin):
 
     async def write(self, write_io: WriteIO) -> None:
         path = os.path.join(self.root, write_io.path)
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         await loop.run_in_executor(
             self._get_executor(), self._blocking_write, path, write_io.buf
         )
 
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         read_io.buf = await loop.run_in_executor(
             self._get_executor(), self._blocking_read, path, read_io.byte_range
         )

@@ -194,13 +194,13 @@ class GCSStoragePlugin(StoragePlugin):
                 out.truncate()
 
     async def write(self, write_io: WriteIO) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         await loop.run_in_executor(
             self._get_executor(), self._blocking_write, write_io.path, write_io.buf
         )
 
     async def read(self, read_io: ReadIO) -> None:
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         read_io.buf = await loop.run_in_executor(
             self._get_executor(),
             self._blocking_read,
@@ -219,7 +219,7 @@ class GCSStoragePlugin(StoragePlugin):
             if resp.status_code not in (200, 204, 404):
                 resp.raise_for_status()
 
-        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _delete)
+        await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
     async def delete_dir(self, path: str) -> None:
         def _list_and_delete() -> None:
@@ -244,7 +244,7 @@ class GCSStoragePlugin(StoragePlugin):
                 if not page_token:
                     return
 
-        await asyncio.get_event_loop().run_in_executor(
+        await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _list_and_delete
         )
 

@@ -51,7 +51,7 @@ class S3StoragePlugin(StoragePlugin):
                 Bucket=self.bucket, Key=self._key(write_io.path), Body=body
             )
 
-        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _put)
+        await asyncio.get_running_loop().run_in_executor(self._get_executor(), _put)
 
     async def read(self, read_io: ReadIO) -> None:
         def _get() -> bytearray:
@@ -65,7 +65,7 @@ class S3StoragePlugin(StoragePlugin):
             )
             return bytearray(resp["Body"].read())
 
-        read_io.buf = await asyncio.get_event_loop().run_in_executor(
+        read_io.buf = await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _get
         )
 
@@ -73,7 +73,7 @@ class S3StoragePlugin(StoragePlugin):
         def _delete() -> None:
             self._client.delete_object(Bucket=self.bucket, Key=self._key(path))
 
-        await asyncio.get_event_loop().run_in_executor(self._get_executor(), _delete)
+        await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
     async def delete_dir(self, path: str) -> None:
         def _delete_dir() -> None:
@@ -86,7 +86,7 @@ class S3StoragePlugin(StoragePlugin):
                         Bucket=self.bucket, Delete={"Objects": keys}
                     )
 
-        await asyncio.get_event_loop().run_in_executor(
+        await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _delete_dir
         )
 
