@@ -144,7 +144,8 @@ def test_resharding_property_random(seed):
     """Randomized shapes + shardings + shard-size knob: save under one
     layout, restore under another, values must match exactly."""
     rng = np.random.RandomState(seed)
-    shape = (int(rng.randint(3, 40)), int(rng.randint(3, 30)))
+    # dims divisible by 8: jax.device_put requires even sharding
+    shape = (8 * int(rng.randint(1, 6)), 8 * int(rng.randint(1, 5)))
     value = rng.rand(*shape).astype(np.float32)
 
     def random_sharding():
