@@ -8,6 +8,7 @@ API mirrors the reference's tiny surface:
 """
 
 from .rng_state import RNGState
+from .manager import SnapshotManager
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
@@ -19,6 +20,7 @@ __all__ = [
     "AppState",
     "StateDict",
     "RNGState",
+    "SnapshotManager",
 ]
 
 from .version import __version__

@@ -1,0 +1,154 @@
+"""SnapshotManager: step-numbered snapshots with retention.
+
+Beyond reference parity (the reference leaves naming/retention to the user):
+the training-loop convenience layer JAX users expect from orbax's
+CheckpointManager, built on the Snapshot primitives — step-numbered
+directories under one root, retention of the last N *committed* snapshots,
+latest-step discovery, async saves.
+
+Layout: ``<root>/step_<N>`` per snapshot.  A snapshot counts as committed iff
+its ``.snapshot_metadata`` exists (the commit protocol's invariant), so
+pruning and latest-step discovery never consider torn snapshots.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import List, Optional, Union
+
+from .pg_wrapper import PGWrapper
+from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
+from .stateful import AppState
+from .storage_plugin import url_to_storage_plugin
+
+logger = logging.getLogger(__name__)
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+class SnapshotManager:
+    def __init__(
+        self,
+        root: str,
+        max_to_keep: Optional[int] = None,
+        pg: Optional[PGWrapper] = None,
+    ) -> None:
+        if max_to_keep is not None and max_to_keep < 1:
+            raise ValueError("max_to_keep must be >= 1")
+        self.root = root.rstrip("/")
+        self.max_to_keep = max_to_keep
+        self._pg = pg or PGWrapper.from_jax()
+
+    # ----------------------------------------------------------------- paths
+
+    def path_for_step(self, step: int) -> str:
+        return f"{self.root}/step_{step}"
+
+    def _is_committed(self, step: int) -> bool:
+        """Metadata-file existence is the commit signal.  Only runs on fs
+        roots (all_steps gates); a FileNotFoundError means torn/absent, any
+        other error (permissions, transport) propagates rather than silently
+        classifying a committed snapshot as torn."""
+        import os
+
+        root = self.root.split("://", 1)[-1]
+        try:
+            os.stat(os.path.join(root, f"step_{step}", SNAPSHOT_METADATA_FNAME))
+            return True
+        except FileNotFoundError:
+            return False
+
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending.  Requires a listable backend (fs); for
+        object stores, track steps externally or use latest_step files."""
+        import os
+
+        if "://" in self.root and not self.root.startswith("fs://"):
+            raise NotImplementedError(
+                "all_steps() requires a filesystem root; object-store layouts "
+                "should track steps externally"
+            )
+        root = self.root.split("://", 1)[-1]
+        steps = []
+        try:
+            names = os.listdir(root)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and self._is_committed(int(m.group(1))):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # ----------------------------------------------------------------- save
+
+    def save(
+        self,
+        step: int,
+        app_state: AppState,
+        replicated: Optional[List[str]] = None,
+        async_: bool = False,
+    ) -> Union[Snapshot, PendingSnapshot]:
+        path = self.path_for_step(step)
+        if async_:
+            pending = Snapshot.async_take(
+                path, app_state, pg=self._pg, replicated=replicated
+            )
+            # The in-flight snapshot must not count toward retention: if it
+            # never commits, the previously committed ones are still the
+            # only restore points — deleting them now could leave zero.
+            self._maybe_prune(exclude_step=step, include_current=False)
+            return pending
+        snapshot = Snapshot.take(path, app_state, pg=self._pg, replicated=replicated)
+        self._maybe_prune(exclude_step=step, include_current=True)
+        return snapshot
+
+    # -------------------------------------------------------------- restore
+
+    def restore_latest(self, app_state: AppState) -> Optional[int]:
+        """Restore the newest committed snapshot; returns its step or None
+        (the standard resume-if-possible idiom)."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        Snapshot(self.path_for_step(step), pg=self._pg).restore(app_state)
+        return step
+
+    def snapshot(self, step: int) -> Snapshot:
+        return Snapshot(self.path_for_step(step), pg=self._pg)
+
+    # ---------------------------------------------------------------- prune
+
+    def _maybe_prune(self, exclude_step: int, include_current: bool) -> None:
+        if self.max_to_keep is None:
+            return
+        # Single deleter: rank 0 prunes between barriers so no rank is still
+        # reading a pruned snapshot mid-restore; prune failures are logged,
+        # never propagated past the closing barrier (peers are blocked in it).
+        self._pg.barrier()
+        try:
+            if self._pg.get_rank() == 0:
+                committed = [s for s in self.all_steps() if s != exclude_step]
+                budget = self.max_to_keep - (1 if include_current else 0)
+                excess = len(committed) - budget
+                if excess > 0:
+                    import asyncio
+
+                    storage = url_to_storage_plugin(self.root)
+                    try:
+                        for step in committed[:excess]:
+                            logger.info("Pruning snapshot step_%d", step)
+                            asyncio.run(storage.delete_dir(f"step_{step}"))
+                    finally:
+                        storage.sync_close()
+        except NotImplementedError:
+            logger.warning("Retention skipped: backend is not listable")
+        except Exception:
+            logger.exception("Retention pruning failed; continuing")
+        finally:
+            self._pg.barrier()
