@@ -75,6 +75,18 @@ def test_bfloat16_zero_copy():
     assert float(out[0]) == 3.0
 
 
+@pytest.mark.parametrize(
+    "dtype", [ml_dtypes.bfloat16, np.float32, np.float16], ids=str
+)
+def test_zero_dim_roundtrip(dtype):
+    # 0-d arrays (scalar leaves) must serialize; found by fuzzing — numpy
+    # rejects view() dtype changes on 0-d arrays
+    arr = np.array(2.5, dtype=dtype)
+    mv = array_as_memoryview(arr)
+    out = array_from_memoryview(mv, dtype_to_string(dtype), [])
+    assert float(out) == 2.5
+
+
 def test_dtype_registry_roundtrip():
     for dtype in ALL_DTYPES:
         s = dtype_to_string(dtype)

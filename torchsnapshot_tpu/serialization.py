@@ -112,6 +112,8 @@ def array_as_memoryview(arr: np.ndarray) -> memoryview:
     if not arr.flags["C_CONTIGUOUS"]:
         arr = np.ascontiguousarray(arr)
     if arr.dtype in _EXTENSION_DTYPES:
+        if arr.ndim == 0:
+            arr = arr.reshape(1)  # numpy rejects view() dtype changes on 0-d
         arr = arr.view(np.uint8)
     return memoryview(arr).cast("B")
 
