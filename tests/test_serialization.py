@@ -76,15 +76,29 @@ def test_bfloat16_zero_copy():
 
 
 @pytest.mark.parametrize(
-    "dtype", [ml_dtypes.bfloat16, np.float32, np.float16], ids=str
+    "dtype",
+    [
+        ml_dtypes.bfloat16,
+        ml_dtypes.float8_e4m3fn,
+        ml_dtypes.float8_e5m2,
+        ml_dtypes.float8_e4m3b11fnuz,
+        ml_dtypes.int4,
+        ml_dtypes.uint4,
+        np.float32,
+        np.float16,
+    ],
+    ids=lambda d: np.dtype(d).name,
 )
 def test_zero_dim_roundtrip(dtype):
     # 0-d arrays (scalar leaves) must serialize; found by fuzzing — numpy
     # rejects view() dtype changes on 0-d arrays
-    arr = np.array(2.5, dtype=dtype)
+    value = 2.5 if np.dtype(dtype).kind not in "iu" else 3
+    arr = np.array(value, dtype=dtype)
     mv = array_as_memoryview(arr)
     out = array_from_memoryview(mv, dtype_to_string(dtype), [])
-    assert float(out) == 2.5
+    assert out.shape == ()
+    assert out.dtype == np.dtype(dtype)
+    assert float(out) == float(value)
 
 
 def test_dtype_registry_roundtrip():
