@@ -1,0 +1,133 @@
+"""Incremental snapshots: unchanged payloads hard-linked, pruning-safe."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+from torchsnapshot_tpu.manager import SnapshotManager
+
+
+def _native_available():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    return get_native_lib_path() is not None
+
+
+# Only the inode-assertion tests require checksums (native lib); fallback
+# tests must run everywhere — they cover the no-native production path.
+needs_native = pytest.mark.skipif(
+    not _native_available(), reason="checksums require the native library"
+)
+
+
+def _inode(path):
+    return os.stat(path).st_ino
+
+
+@needs_native
+def test_unchanged_payloads_hard_linked(tmp_path):
+    frozen = np.random.RandomState(0).rand(256).astype(np.float32)
+    hot = np.zeros(128, np.float32)
+    with knobs.override_batching_disabled(True):
+        s1 = Snapshot.take(
+            str(tmp_path / "s1"),
+            {"m": StateDict({"frozen": frozen.copy(), "hot": hot.copy()})},
+        )
+        hot2 = hot + 1.0
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"m": StateDict({"frozen": frozen.copy(), "hot": hot2})},
+            incremental_from=str(tmp_path / "s1"),
+        )
+    frozen_loc = s2.get_manifest()["0/m/frozen"].location
+    hot_loc = s2.get_manifest()["0/m/hot"].location
+    # unchanged payload shares the inode with the base; changed one doesn't
+    assert _inode(tmp_path / "s2" / frozen_loc) == _inode(tmp_path / "s1" / frozen_loc)
+    assert _inode(tmp_path / "s2" / hot_loc) != _inode(tmp_path / "s1" / hot_loc)
+
+    dst = {"m": StateDict({})}
+    s2.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["frozen"], frozen)
+    np.testing.assert_array_equal(dst["m"]["hot"], hot2)
+
+
+@needs_native
+def test_incremental_survives_base_pruning(tmp_path):
+    import shutil
+
+    value = np.random.RandomState(1).rand(512).astype(np.float32)
+    with knobs.override_batching_disabled(True):
+        Snapshot.take(str(tmp_path / "s1"), {"m": StateDict({"w": value.copy()})})
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"m": StateDict({"w": value.copy()})},
+            incremental_from=str(tmp_path / "s1"),
+        )
+    shutil.rmtree(tmp_path / "s1")  # prune the base
+    dst = {"m": StateDict({})}
+    Snapshot(str(tmp_path / "s2")).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], value)
+
+
+def test_incremental_missing_base_falls_back(tmp_path):
+    value = np.ones(64, np.float32)
+    snap = Snapshot.take(
+        str(tmp_path / "snap"),
+        {"m": StateDict({"w": value})},
+        incremental_from=str(tmp_path / "nonexistent"),
+    )
+    dst = {"m": StateDict({})}
+    snap.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], value)
+
+
+@needs_native
+def test_rewrite_over_link_does_not_corrupt_base(tmp_path):
+    """Rewriting a path that is hard-linked to a committed base must break
+    the link (temp+rename), never truncate the shared inode."""
+    value = np.random.RandomState(4).rand(256).astype(np.float32)
+    with knobs.override_batching_disabled(True):
+        s1 = Snapshot.take(str(tmp_path / "s1"), {"m": StateDict({"w": value.copy()})})
+        Snapshot.take(
+            str(tmp_path / "s2"),
+            {"m": StateDict({"w": value.copy()})},
+            incremental_from=str(tmp_path / "s1"),
+        )
+        # overwrite s2 in place with different content (crash-retake scenario)
+        changed = value * -1.0
+        Snapshot.take(str(tmp_path / "s2"), {"m": StateDict({"w": changed})})
+    # the base snapshot must be intact
+    dst = {"m": StateDict({})}
+    Snapshot(str(tmp_path / "s1")).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], value)
+    dst2 = {"m": StateDict({})}
+    Snapshot(str(tmp_path / "s2")).restore(dst2)
+    np.testing.assert_array_equal(dst2["m"]["w"], changed)
+
+
+@needs_native
+def test_manager_incremental_chain(tmp_path):
+    frozen = np.random.RandomState(2).rand(256).astype(np.float32)
+    mgr = SnapshotManager(str(tmp_path / "ckpts"), max_to_keep=2)
+    with knobs.override_batching_disabled(True):
+        for step in (1, 2, 3):
+            state = {
+                "m": StateDict(
+                    {
+                        "frozen": frozen.copy(),
+                        "hot": np.full(64, float(step), np.float32),
+                    }
+                )
+            }
+            mgr.save(step, state, incremental=(step > 1))
+    assert mgr.all_steps() == [2, 3]
+    # step 1 (the original link source) was pruned; both survivors restore
+    for step in (2, 3):
+        dst = {"m": StateDict({})}
+        mgr.snapshot(step).restore(dst)
+        np.testing.assert_array_equal(dst["m"]["frozen"], frozen)
+        np.testing.assert_array_equal(
+            dst["m"]["hot"], np.full(64, float(step), np.float32)
+        )

@@ -1,0 +1,160 @@
+"""Incremental snapshots: skip rewriting payloads whose content is unchanged.
+
+Beyond reference parity.  Fine-tuning and staged-training jobs carry large
+frozen subtrees (backbones, embeddings) whose bytes are identical between
+checkpoints; rewriting them every save wastes the storage-bandwidth budget
+that BASELINE.md's north star is measured on.
+
+Mechanism: ``Snapshot.take(..., incremental_from=prev_path)`` wraps the fs
+storage plugin.  For every payload write the wrapper hashes the staged bytes
+(xxHash64 — already computed for the manifest checksum) and, when the digest
+matches the base snapshot's entry for the SAME relative path, hard-links the
+base file into the new snapshot instead of writing.  Properties:
+
+- restore needs no knowledge of incrementality: every snapshot directory is
+  self-contained (hard links are real directory entries)
+- pruning the base snapshot is safe: the linked payloads survive via their
+  remaining link (fs semantics), so retention + incremental compose
+- batched slabs never dedup (uuid paths), so the knob to maximize dedup is
+  ``TPUSNAP_DISABLE_BATCHER=1`` or large params (unbatched anyway)
+- non-fs backends and any hash mismatch/missing base file fall back to a
+  normal write — correctness never depends on the optimization
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+from .io_types import ReadIO, StoragePlugin, WriteIO
+from .manifest import (
+    ChunkedTensorEntry,
+    ObjectEntry,
+    ShardedArrayEntry,
+    SnapshotMetadata,
+    TensorEntry,
+)
+from .storage_plugins.fs import FSStoragePlugin
+
+logger = logging.getLogger(__name__)
+
+
+def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, str]:
+    """location → checksum for every payload in a snapshot manifest."""
+    out: Dict[str, str] = {}
+
+    def _add(entry: TensorEntry) -> None:
+        # Batched payloads share a location with other entries; the whole
+        # slab's bytes won't match a single entry's digest — skip them.
+        if entry.checksum is not None and entry.byte_range is None:
+            out[entry.location] = entry.checksum
+
+    for entry in metadata.manifest.values():
+        if isinstance(entry, TensorEntry):
+            _add(entry)
+        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+            shards = (
+                entry.shards if isinstance(entry, ShardedArrayEntry) else entry.chunks
+            )
+            for shard in shards:
+                _add(shard.tensor)
+        elif isinstance(entry, ObjectEntry) and entry.checksum is not None:
+            out[entry.location] = entry.checksum
+    return out
+
+
+class IncrementalFSStoragePlugin(StoragePlugin):
+    """Wraps an FSStoragePlugin; hard-links unchanged payloads from a base
+    snapshot directory."""
+
+    def __init__(
+        self,
+        inner: FSStoragePlugin,
+        base_root: str,
+        base_checksums: Dict[str, str],
+    ) -> None:
+        self._inner = inner
+        self._base_root = base_root
+        self._base_checksums = base_checksums
+        self.links = 0  # observability: payloads deduplicated this take
+
+    async def write(self, write_io: WriteIO) -> None:
+        expected = self._base_checksums.get(write_io.path)
+        if expected is not None:
+            import asyncio
+
+            def _hash_and_link() -> bool:
+                from . import integrity
+
+                if integrity.compute(write_io.buf) != expected:
+                    return False
+                src = os.path.join(self._base_root, write_io.path)
+                dst = os.path.join(self._inner.root, write_io.path)
+                try:
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    if os.path.exists(dst):
+                        os.unlink(dst)
+                    os.link(src, dst)
+                    return True
+                except OSError as e:
+                    logger.debug(
+                        "Incremental link failed for %s (%s); writing normally",
+                        write_io.path,
+                        e,
+                    )
+                    return False
+
+            # hash (GB/s-scale work) + link off the event loop, on the same
+            # pool the inner plugin uses for its blocking I/O
+            linked = await asyncio.get_running_loop().run_in_executor(
+                self._inner._get_executor(), _hash_and_link
+            )
+            if linked:
+                self.links += 1
+                return
+        await self._inner.write(write_io)
+
+    async def read(self, read_io: ReadIO) -> None:
+        await self._inner.read(read_io)
+
+    async def delete(self, path: str) -> None:
+        await self._inner.delete(path)
+
+    async def delete_dir(self, path: str) -> None:
+        await self._inner.delete_dir(path)
+
+    async def close(self) -> None:
+        if self.links:
+            logger.info("Incremental snapshot: %d payloads hard-linked", self.links)
+        await self._inner.close()
+
+
+def maybe_wrap_incremental(
+    storage: StoragePlugin, base_path: Optional[str]
+) -> StoragePlugin:
+    """Wrap ``storage`` for incremental writes when both the target and the
+    base are local filesystems and the base is a committed snapshot;
+    otherwise return ``storage`` unchanged."""
+    if base_path is None or not isinstance(storage, FSStoragePlugin):
+        return storage
+    if "://" in base_path and not base_path.startswith("fs://"):
+        logger.warning("incremental_from ignored: base is not a filesystem path")
+        return storage
+    base_root = base_path.split("://", 1)[-1]
+    # One canonical metadata reader: Snapshot's own.
+    from .snapshot import Snapshot
+
+    try:
+        base_metadata = Snapshot(base_path).metadata
+    except Exception as e:  # noqa: BLE001
+        logger.warning(
+            "incremental_from ignored: base metadata unreadable (%s)", e
+        )
+        return storage
+    base_checksums = checksums_by_location(base_metadata)
+    if not base_checksums:
+        return storage
+    return IncrementalFSStoragePlugin(
+        inner=storage, base_root=base_root, base_checksums=base_checksums
+    )

@@ -93,18 +93,42 @@ class SnapshotManager:
         app_state: AppState,
         replicated: Optional[List[str]] = None,
         async_: bool = False,
+        incremental: bool = False,
     ) -> Union[Snapshot, PendingSnapshot]:
+        """``incremental=True`` hard-links payloads unchanged since the
+        latest committed snapshot instead of rewriting them (fs roots)."""
         path = self.path_for_step(step)
+        base: Optional[str] = None
+        if incremental:
+            try:
+                latest = self.latest_step()
+            except NotImplementedError:
+                logger.warning(
+                    "incremental save ignored: backend is not listable"
+                )
+                latest = None
+            if latest is not None and latest != step:
+                base = self.path_for_step(latest)
         if async_:
             pending = Snapshot.async_take(
-                path, app_state, pg=self._pg, replicated=replicated
+                path,
+                app_state,
+                pg=self._pg,
+                replicated=replicated,
+                incremental_from=base,
             )
             # The in-flight snapshot must not count toward retention: if it
             # never commits, the previously committed ones are still the
             # only restore points — deleting them now could leave zero.
             self._maybe_prune(exclude_step=step, include_current=False)
             return pending
-        snapshot = Snapshot.take(path, app_state, pg=self._pg, replicated=replicated)
+        snapshot = Snapshot.take(
+            path,
+            app_state,
+            pg=self._pg,
+            replicated=replicated,
+            incremental_from=base,
+        )
         self._maybe_prune(exclude_step=step, include_current=True)
         return snapshot
 

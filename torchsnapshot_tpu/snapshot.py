@@ -89,7 +89,11 @@ class Snapshot:
         app_state: AppState,
         pg: Optional[PGWrapper] = None,
         replicated: Optional[List[str]] = None,
+        incremental_from: Optional[str] = None,
     ) -> "Snapshot":
+        """``incremental_from``: path of a committed base snapshot — payloads
+        whose bytes are unchanged are hard-linked instead of rewritten
+        (fs backends; see incremental.py)."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
@@ -101,6 +105,10 @@ class Snapshot:
                 path, pg, replicated or []
             )
             storage = url_to_storage_plugin(path)
+            if incremental_from is not None:
+                from .incremental import maybe_wrap_incremental
+
+                storage = maybe_wrap_incremental(storage, incremental_from)
             try:
                 pending_io_work, metadata = cls._take_impl(
                     path=path,
@@ -137,6 +145,7 @@ class Snapshot:
         app_state: AppState,
         pg: Optional[PGWrapper] = None,
         replicated: Optional[List[str]] = None,
+        incremental_from: Optional[str] = None,
     ) -> "PendingSnapshot":
         """Returns once all state is staged to host memory; storage I/O and
         the metadata commit continue on a background thread
@@ -155,6 +164,10 @@ class Snapshot:
             path, pg, replicated or []
         )
         storage = url_to_storage_plugin(path)
+        if incremental_from is not None:
+            from .incremental import maybe_wrap_incremental
+
+            storage = maybe_wrap_incremental(storage, incremental_from)
         try:
             pending_io_work, metadata = cls._take_impl(
                 path=path,

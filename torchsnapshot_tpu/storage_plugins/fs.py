@@ -46,12 +46,25 @@ class FSStoragePlugin(StoragePlugin):
             self._dir_cache.add(parent)
 
     def _blocking_write(self, path: str, buf) -> None:
+        # Write to a temp file and rename: atomic (readers never see partial
+        # payloads) and breaks hard links instead of truncating a shared
+        # inode (incremental snapshots hard-link unchanged payloads into new
+        # snapshot dirs — an in-place rewrite would corrupt the base).
         self._prepare_parent(path)
-        if self._native is not None:
-            self._native.write_file(path, buf)
-            return
-        with open(path, "wb") as f:
-            f.write(buf)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            if self._native is not None:
+                self._native.write_file(tmp, buf)
+            else:
+                with open(tmp, "wb") as f:
+                    f.write(buf)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def _blocking_read(self, path: str, byte_range) -> bytearray:
         if self._native is not None:
