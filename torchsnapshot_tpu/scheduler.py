@@ -238,6 +238,19 @@ async def execute_write_reqs(
         dispatch_staging()
         reporter.maybe_report(budget)
 
+    elapsed = time.monotonic() - reporter._begin
+    if staged_bytes and elapsed > 0:
+        # End-of-phase throughput line (reference _WriteReporter,
+        # scheduler.py:166-173)
+        logger.info(
+            "[rank %d] staged %.1f MB in %.2fs (%.1f MB/s), %d/%d writes landed",
+            rank,
+            staged_bytes / 1e6,
+            elapsed,
+            staged_bytes / 1e6 / elapsed,
+            reporter.io_done,
+            len(write_reqs),
+        )
     return PendingIOWork(
         loop=loop,
         executor=executor if own_executor else None,
