@@ -46,13 +46,22 @@ def get_manifest_for_rank(
 
 
 def _get_rank_to_manifest(metadata: SnapshotMetadata) -> List[Dict[str, Entry]]:
+    """Per-rank views of the global manifest.
+
+    Only container entries are copied: they are the only objects the restore
+    path mutates (elasticity appends/removes container keys), and a blanket
+    deepcopy of multi-MB manifests costs ~0.25 s per stateful at 8B-param
+    scale.  Leaf entries are shared read-only with ``metadata.manifest``.
+    """
     rank_to_manifest: List[Dict[str, Entry]] = [
         {} for _ in range(metadata.world_size)
     ]
     for path, entry in metadata.manifest.items():
         rank_str, _, logical_path = path.partition("/")
+        if is_container_entry(entry):
+            entry = copy.deepcopy(entry)
         rank_to_manifest[int(rank_str)][logical_path] = entry
-    return copy.deepcopy(rank_to_manifest)
+    return rank_to_manifest
 
 
 def _get_merged_sharded_entries(
