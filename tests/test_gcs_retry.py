@@ -1,0 +1,54 @@
+"""GCS shared-deadline retry strategy + transient classification (no
+network; reference gcs.py:91-126, 221-277 semantics)."""
+
+import time
+
+import pytest
+
+from torchsnapshot_tpu.storage_plugins.gcs import (
+    _SharedDeadlineRetryStrategy,
+    _is_transient,
+)
+
+
+class _FakeHTTPError(Exception):
+    def __init__(self, status):
+        class R:
+            status_code = status
+
+        self.response = R()
+
+
+def test_transient_classification():
+    for status in (408, 429, 500, 502, 503, 504):
+        assert _is_transient(_FakeHTTPError(status))
+    for status in (400, 401, 403, 404, 412):
+        assert not _is_transient(_FakeHTTPError(status))
+    assert _is_transient(ConnectionError("reset"))
+    assert _is_transient(TimeoutError())
+    assert not _is_transient(ValueError("bad request body"))
+
+
+def test_shared_deadline_expires_without_progress():
+    strategy = _SharedDeadlineRetryStrategy(deadline_s=0.2)
+    time.sleep(0.25)
+    with pytest.raises(TimeoutError, match="no collective progress"):
+        strategy.check_and_backoff(ConnectionError("x"))
+
+
+def test_progress_refreshes_deadline():
+    strategy = _SharedDeadlineRetryStrategy(deadline_s=0.3)
+    for _ in range(3):
+        time.sleep(0.2)
+        strategy.report_progress()  # any transfer's progress refreshes
+    # 0.6s elapsed > initial deadline, but refreshed: no timeout
+    strategy.check_and_backoff(ConnectionError("transient"))
+
+
+def test_backoff_resets_after_progress():
+    strategy = _SharedDeadlineRetryStrategy(deadline_s=10.0)
+    strategy.check_and_backoff(ConnectionError("1"))
+    strategy.check_and_backoff(ConnectionError("2"))
+    assert strategy._attempts == 2
+    strategy.report_progress()
+    assert strategy._attempts == 0

@@ -1,0 +1,69 @@
+"""Staging helper unit tests: D2H paths, sharding predicates, spec capture."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchsnapshot_tpu import staging
+
+
+def _mesh8():
+    return Mesh(np.array(jax.devices()), ("x",))
+
+
+def test_predicates():
+    host = np.zeros(4)
+    single = jnp.zeros(4)
+    sharded = jax.device_put(
+        jnp.zeros((8, 4)), NamedSharding(_mesh8(), P("x", None))
+    )
+    replicated = jax.device_put(jnp.zeros(4), NamedSharding(_mesh8(), P()))
+
+    assert not staging.is_jax_array(host)
+    assert staging.is_jax_array(single)
+    assert staging.is_array_like(host) and staging.is_array_like(single)
+    assert staging.is_sharded(sharded)
+    assert not staging.is_sharded(single)
+    assert not staging.is_sharded(replicated)
+    assert staging.is_fully_replicated(replicated)
+    assert not staging.is_fully_replicated(single)  # single device: trivial
+
+
+def test_begin_finish_d2h_roundtrip():
+    x = jnp.arange(64, dtype=jnp.bfloat16).reshape(8, 8)
+    handle = staging.begin_d2h(x)
+    host = staging.finish_d2h(handle, x.dtype, x.shape)
+    assert host.shape == (8, 8)
+    np.testing.assert_array_equal(host, np.asarray(x))
+
+
+def test_local_shards_dedup():
+    # replicated over x: 8 devices hold the same box -> one distinct shard
+    arr = jax.device_put(jnp.arange(16), NamedSharding(_mesh8(), P()))
+    shards = staging.local_shards(arr)
+    assert len(shards) == 1
+    offsets, data = shards[0]
+    assert offsets == (0,)
+    np.testing.assert_array_equal(np.asarray(data), np.arange(16))
+
+
+def test_partition_spec_capture():
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("a", "b"))
+    arr = jax.device_put(jnp.zeros((8, 4)), NamedSharding(mesh, P(("a", "b"), None)))
+    mesh_shape, axis_names, per_dim = staging.partition_spec_of(arr)
+    assert mesh_shape == [4, 2]
+    assert axis_names == ["a", "b"]
+    assert per_dim == [["a", "b"], []]
+
+
+def test_prng_key_envelope_roundtrip():
+    key = jax.random.key(7)
+    env = staging.prng_key_envelope(key)
+    out = staging.maybe_unwrap_prng_key(env)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(out)), np.asarray(jax.random.key_data(key))
+    )
+    # non-envelope values pass through untouched
+    assert staging.maybe_unwrap_prng_key({"a": 1}) == {"a": 1}
