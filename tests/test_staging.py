@@ -58,6 +58,20 @@ def test_partition_spec_capture():
     assert per_dim == [["a", "b"], []]
 
 
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16, jnp.int8, jnp.float32])
+def test_device_put_fast_bitcast(monkeypatch, dtype):
+    """Forced bitcast H2D path must be value-identical to plain device_put."""
+    monkeypatch.setenv("TPUSNAP_D2H_BITCAST", "1")
+    host = np.asarray(jnp.arange(48, dtype=dtype).reshape(6, 8))
+    dev = staging.device_put_fast(host, jax.devices()[0])
+    assert dev.dtype == dtype
+    assert dev.shape == (6, 8)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+    # 0-d falls back safely
+    scalar = staging.device_put_fast(np.asarray(np.float16(2.0)), jax.devices()[0])
+    assert float(scalar) == 2.0
+
+
 def test_prng_key_envelope_roundtrip():
     key = jax.random.key(7)
     env = staging.prng_key_envelope(key)

@@ -90,6 +90,35 @@ def test_host_offload_helpers():
     assert not is_host_resident(d)
 
 
+def test_host_offloaded_sharded_restore_preserves_memory_kind(tmp_path):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchsnapshot_tpu import StateDict
+    from torchsnapshot_tpu.utils.host_offload import (
+        supports_host_memory,
+        to_host_memory,
+    )
+
+    if not supports_host_memory():
+        pytest.skip("backend has no pinned_host memory space")
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x", None))
+    table = to_host_memory(
+        jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8), sharding)
+    )
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict({"t": table})})
+    dst_t = to_host_memory(
+        jax.device_put(jnp.zeros((8, 8), jnp.float32), sharding)
+    )
+    dst = {"m": StateDict({"t": dst_t})}
+    snapshot.restore(dst)
+    out = dst["m"]["t"]
+    assert out.sharding.memory_kind == "pinned_host"
+    np.testing.assert_array_equal(
+        np.asarray(out), np.arange(64, dtype=np.float32).reshape(8, 8)
+    )
+
+
 def test_host_offloaded_array_snapshot(tmp_path):
     from torchsnapshot_tpu import StateDict
     from torchsnapshot_tpu.utils.host_offload import (

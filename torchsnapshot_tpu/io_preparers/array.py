@@ -271,11 +271,21 @@ class ArrayAssembly:
 def _device_put_like(host: np.ndarray, like: Any) -> Any:
     """Place a host array like an existing jax.Array (device + sharding +
     dtype).  The H2D analogue of the reference's consume-into-GPU-target copy
-    (tensor.py:331-340)."""
+    (tensor.py:331-340).  Single-device targets take the u8-bitcast upload
+    fast path for sub-word dtypes (staging.device_put_fast)."""
     import jax
 
     if host.dtype != np.dtype(like.dtype):
         host = host.astype(np.dtype(like.dtype))
+    try:
+        devices = like.sharding.device_set
+        memory_kind = getattr(like.sharding, "memory_kind", None)
+        # Fast path only for plain single-device HBM targets: a non-default
+        # memory kind (pinned_host offload) must be preserved exactly.
+        if len(devices) == 1 and memory_kind in (None, "device"):
+            return staging.device_put_fast(host, next(iter(devices)))
+    except Exception:
+        pass
     return jax.device_put(host, like.sharding)
 
 

@@ -277,6 +277,7 @@ class _ShardedRestore:
 
             if staging.is_sharded(obj_out):
                 target_dtype = np.dtype(obj_out.dtype)
+                memory_kind = getattr(obj_out.sharding, "memory_kind", None)
                 per_device = []
                 for shard in obj_out.addressable_shards:
                     offsets = tuple(
@@ -288,7 +289,21 @@ class _ShardedRestore:
                     buf = self._buffers[offsets]
                     if buf.dtype != target_dtype:
                         buf = buf.astype(target_dtype)
-                    per_device.append(jax.device_put(buf, shard.device))
+                    if memory_kind in (None, "device"):
+                        per_device.append(
+                            staging.device_put_fast(buf, shard.device)
+                        )
+                    else:
+                        # Preserve non-default memory kinds (pinned_host
+                        # offloaded embeddings/optimizer state) exactly.
+                        per_device.append(
+                            jax.device_put(
+                                buf,
+                                jax.sharding.SingleDeviceSharding(
+                                    shard.device, memory_kind=memory_kind
+                                ),
+                            )
+                        )
                 self.fut.obj = jax.make_array_from_single_device_arrays(
                     tuple(self.entry.shape), obj_out.sharding, per_device
                 )

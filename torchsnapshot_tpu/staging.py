@@ -125,9 +125,9 @@ def _use_bitcast_staging(arr: Any) -> bool:
     TPUSNAP_D2H_BITCAST=0/1."""
     import os
 
-    flag = os.environ.get("TPUSNAP_D2H_BITCAST")
+    flag = _bitcast_env_flag("TPUSNAP_D2H_BITCAST")
     if flag is not None:
-        return flag not in ("0", "false", "")
+        return flag
     try:
         if getattr(arr.sharding, "memory_kind", None) == "pinned_host":
             return False  # already host-resident: no transfer to speed up
@@ -170,6 +170,69 @@ def to_host(arr: Any) -> np.ndarray:
     if not is_jax_array(arr):
         return np.asarray(arr)
     return finish_d2h(begin_d2h(arr), arr.dtype, arr.shape)
+
+
+_H2D_BITCAST_CACHE: dict = {}
+
+
+def _bitcast_env_flag(name: str) -> Optional[bool]:
+    import os
+
+    flag = os.environ.get(name)
+    if flag is None:
+        return None
+    return flag not in ("0", "false", "")
+
+
+def _use_bitcast_h2d(device: Any, dtype: Any) -> bool:
+    """Same rationale as _use_bitcast_staging, opposite direction: sub-word
+    dtypes upload host→device markedly slower on some transports.  Own knob
+    (TPUSNAP_H2D_BITCAST) so the two directions tune independently; falls
+    back to the shared TPUSNAP_D2H_BITCAST override for convenience."""
+    flag = _bitcast_env_flag("TPUSNAP_H2D_BITCAST")
+    if flag is None:
+        flag = _bitcast_env_flag("TPUSNAP_D2H_BITCAST")
+    if flag is not None:
+        return flag
+    try:
+        if device.platform == "cpu":
+            return False
+    except Exception:
+        return False
+    return np.dtype(dtype).itemsize < 4
+
+
+def device_put_fast(host: np.ndarray, device: Any) -> Any:
+    """H2D upload to one device, taking the u8-bitcast fast path for
+    sub-word dtypes (the reverse of begin_d2h's staging repack)."""
+    import jax
+
+    dtype = host.dtype
+    if host.ndim == 0 or not _use_bitcast_h2d(device, dtype):
+        return jax.device_put(host, device)
+    itemsize = dtype.itemsize
+    key = (str(dtype), itemsize)
+    fn = _H2D_BITCAST_CACHE.get(key)
+    if fn is None:
+        from jax import lax
+
+        jax_dtype = jax.numpy.dtype(dtype)
+
+        def _unpack(u8):
+            return lax.bitcast_convert_type(
+                u8.reshape(-1, itemsize), jax_dtype
+            )
+
+        fn = jax.jit(_unpack)
+        _H2D_BITCAST_CACHE[key] = fn
+    if not host.flags.c_contiguous:
+        host = np.ascontiguousarray(host)
+    u8 = host.view(np.uint8).reshape(-1)
+    dev_u8 = jax.device_put(u8, device)
+    try:
+        return fn(dev_u8).reshape(host.shape)
+    except Exception:
+        return jax.device_put(host, device)
 
 
 def local_shards(arr: Any) -> List[Tuple[Tuple[int, ...], Any]]:
