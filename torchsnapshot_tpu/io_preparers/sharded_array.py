@@ -278,8 +278,9 @@ class _ShardedRestore:
             if staging.is_sharded(obj_out):
                 target_dtype = np.dtype(obj_out.dtype)
                 memory_kind = getattr(obj_out.sharding, "memory_kind", None)
-                per_device = []
-                for shard in obj_out.addressable_shards:
+                shards = obj_out.addressable_shards
+                bufs, targets = [], []
+                for shard in shards:
                     offsets = tuple(
                         (idx.start or 0) if isinstance(idx, slice) else 0
                         for idx in shard.index
@@ -289,21 +290,18 @@ class _ShardedRestore:
                     buf = self._buffers[offsets]
                     if buf.dtype != target_dtype:
                         buf = buf.astype(target_dtype)
+                    bufs.append(buf)
                     if memory_kind in (None, "device"):
-                        per_device.append(
-                            staging.device_put_fast(buf, shard.device)
-                        )
+                        targets.append(shard.device)
                     else:
                         # Preserve non-default memory kinds (pinned_host
                         # offloaded embeddings/optimizer state) exactly.
-                        per_device.append(
-                            jax.device_put(
-                                buf,
-                                jax.sharding.SingleDeviceSharding(
-                                    shard.device, memory_kind=memory_kind
-                                ),
+                        targets.append(
+                            jax.sharding.SingleDeviceSharding(
+                                shard.device, memory_kind=memory_kind
                             )
                         )
+                per_device = staging.device_put_fast_batch(bufs, targets)
                 self.fut.obj = jax.make_array_from_single_device_arrays(
                     tuple(self.entry.shape), obj_out.sharding, per_device
                 )

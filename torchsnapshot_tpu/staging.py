@@ -202,6 +202,23 @@ def _use_bitcast_h2d(device: Any, dtype: Any) -> bool:
     return np.dtype(dtype).itemsize < 4
 
 
+def device_put_fast_batch(bufs: List[np.ndarray], targets: List[Any]) -> List[Any]:
+    """Upload many host buffers to their targets (devices or single-device
+    shardings).  Owns the fast-path decision: when the u8-bitcast path
+    applies (plain device targets, sub-word dtype, penalizing transport) the
+    buffers upload individually through it; otherwise everything goes in ONE
+    batched pjrt transfer."""
+    import jax
+
+    if not bufs:
+        return []
+    first_target = targets[0]
+    plain_device = not hasattr(first_target, "memory_kind")
+    if plain_device and _use_bitcast_h2d(first_target, bufs[0].dtype):
+        return [device_put_fast(b, t) for b, t in zip(bufs, targets)]
+    return jax.device_put(bufs, targets)
+
+
 def device_put_fast(host: np.ndarray, device: Any) -> Any:
     """H2D upload to one device, taking the u8-bitcast fast path for
     sub-word dtypes (the reverse of begin_d2h's staging repack)."""
