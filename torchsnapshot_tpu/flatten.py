@@ -20,7 +20,6 @@ from typing import Any, Dict, List, Tuple
 
 from .manifest import (
     DictEntry,
-    Entry,
     ListEntry,
     Manifest,
     NamedTupleEntry,

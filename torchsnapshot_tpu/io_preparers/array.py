@@ -21,7 +21,7 @@ from __future__ import annotations
 import asyncio
 import math
 from concurrent.futures import Executor
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 

@@ -21,7 +21,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from .. import serialization, staging
+from .. import serialization
 from ..io_types import Future, ReadReq, WriteReq
 from ..manifest import Chunk, ChunkedTensorEntry, Shard, TensorEntry
 from .array import ArrayAssembly, ArrayBufferConsumer, ArrayIOPreparer

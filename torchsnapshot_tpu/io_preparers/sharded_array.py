@@ -37,7 +37,6 @@ import numpy as np
 from .. import knobs, serialization, staging
 from ..io_types import (
     BufferConsumer,
-    BufferStager,
     BufferType,
     Future,
     ReadReq,

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import abc
 import asyncio
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Generic, List, Optional, TypeVar
 
 BufferType = Any  # bytes | bytearray | memoryview

@@ -16,7 +16,7 @@ from __future__ import annotations
 import io
 import pickle
 from enum import Enum
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List
 
 import ml_dtypes
 import numpy as np

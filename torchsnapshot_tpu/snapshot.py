@@ -31,13 +31,13 @@ import random
 import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
 from . import io_preparer, knobs, staging
 from .batcher import batch_read_requests, batch_write_requests
-from .dist_store import LinearBarrier, StorePeerError, make_barrier_prefix
+from .dist_store import LinearBarrier, StorePeerError
 from .event import Event
 from .event_handlers import log_event
 from .flatten import flatten, inflate

@@ -17,7 +17,7 @@ copied for async snapshots instead (reference tensor.py:283-293).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -123,8 +123,6 @@ def _use_bitcast_staging(arr: Any) -> bool:
     extra HBM pass and buys back the difference.  Off on the CPU backend
     (asarray there is already zero-copy) and overridable via
     TPUSNAP_D2H_BITCAST=0/1."""
-    import os
-
     flag = _bitcast_env_flag("TPUSNAP_D2H_BITCAST")
     if flag is not None:
         return flag
