@@ -128,6 +128,33 @@ def test_resharding_across_device_counts():
     np.testing.assert_array_equal(np.asarray(out), value)
 
 
+def test_multi_axis_per_dim_sharding():
+    """One dim sharded over TWO mesh axes (P(("x","y"), None)) — the layout
+    even the reference defers (SURVEY.md §7 hard parts;
+    gpu_tests/test_snapshot_dtensor.py:62).  Concrete shard boxes make it
+    work without dim-map math."""
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("x", "y"))
+    value = np.random.RandomState(11).rand(32, 16).astype(np.float32)
+    src = jax.device_put(
+        jnp.asarray(value), NamedSharding(mesh, P(("x", "y"), None))
+    )
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="multiaxis")
+    entry, write_reqs = io_preparer.prepare_write(
+        src, logical_path="w", rank=0, replicated=False
+    )
+    assert entry.partition_spec == [["x", "y"], []]
+    assert len(entry.shards) == 8  # 8-way split of dim 0
+    sync_execute_write_reqs(write_reqs, storage, BUDGET, 0).sync_complete()
+
+    dst = jax.device_put(
+        jnp.zeros((32, 16), jnp.float32), NamedSharding(mesh, P("y", "x"))
+    )
+    read_reqs, fut = io_preparer.prepare_read(entry, dst)
+    sync_execute_read_reqs(read_reqs, storage, BUDGET, 0)
+    np.testing.assert_array_equal(np.asarray(fut.obj), value)
+
+
 def test_partition_spec_recorded():
     value = np.zeros(GLOBAL_SHAPE, np.float32)
     src = _make_sharded(value, SHARDINGS[2][1]())
