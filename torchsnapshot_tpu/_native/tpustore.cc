@@ -29,6 +29,7 @@
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <atomic>
 #include <string>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -91,7 +92,7 @@ struct Server {
   std::vector<std::thread> handlers;
   std::mutex handlers_mu;
   Store store;
-  volatile bool stopping = false;
+  std::atomic<bool> stopping{false};
 
   void handle_conn(int fd) {
     int one = 1;
