@@ -121,6 +121,97 @@ def test_tcpstore_collectives_multiprocess():
     _tcpstore_pg_body()
 
 
+def test_concurrent_threads_no_value_clobber():
+    """One TCPStore shared across threads: get/try_get values must never mix.
+
+    Regression for the last_value race: the C client keeps the most recent
+    response in per-connection state read back by two separate Python calls;
+    sharing one connection across threads (async snapshot completion thread +
+    main-thread collectives) could clobber it between the calls.  Thread-local
+    connections make each thread's request/value pair private.
+    """
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        store = TCPStore("127.0.0.1", server.port)
+        errors = []
+
+        def _hammer(tid):
+            try:
+                for i in range(200):
+                    payload = (f"thread{tid}-iter{i}-" * 20).encode()
+                    store.set(f"t{tid}/{i}", payload)
+                    assert store.get(f"t{tid}/{i}", timeout_s=10) == payload
+                    assert store.try_get(f"t{tid}/{i}") == payload
+                    assert store.add(f"ctr{tid}", 1) == i + 1
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=_hammer, args=(t,)) for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        store.close()
+    finally:
+        server.stop()
+
+
+def test_blocking_get_does_not_convoy_other_threads():
+    """A server-side blocking GET from one thread must not serialize other
+    threads' ops on the same TCPStore (each thread has its own connection)."""
+    import time
+
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        store = TCPStore("127.0.0.1", server.port)
+        blocked = threading.Event()
+
+        def _block():
+            blocked.set()
+            with pytest.raises(TimeoutError):
+                store.get("never_set", timeout_s=2.0)
+
+        t = threading.Thread(target=_block)
+        t.start()
+        blocked.wait(timeout=5)
+        time.sleep(0.05)  # let the GET reach the server and park on the CV
+        t0 = time.monotonic()
+        store.set("quick", b"v")
+        assert store.get("quick", timeout_s=5) == b"v"
+        elapsed = time.monotonic() - t0
+        t.join(timeout=10)
+        assert elapsed < 1.0, f"main-thread ops convoyed behind blocking GET: {elapsed:.2f}s"
+        store.close()
+    finally:
+        server.stop()
+
+
+def test_delete_prefix():
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    try:
+        client = TCPStore("127.0.0.1", server.port)
+        client.set("gen/3/a", b"x")
+        client.set("gen/3/b", b"y")
+        client.set("gen/30/a", b"keep")  # "gen/3/" must not match "gen/30/"
+        client.set("other", b"keep")
+        assert client.delete_prefix("gen/3/") == 2
+        assert client.try_get("gen/3/a") is None
+        assert client.try_get("gen/3/b") is None
+        assert client.try_get("gen/30/a") == b"keep"
+        assert client.try_get("other") == b"keep"
+        assert client.delete_prefix("gen/3/") == 0
+        client.close()
+    finally:
+        server.stop()
+
+
 def test_native_file_io(tmp_path):
     from torchsnapshot_tpu.native_io import NativeFileIO
 

@@ -16,6 +16,9 @@
 //   response: status(1) value_len(4) value
 //   ops: 0=SET 1=GET(blocking, timeout_ms in value) 2=TRYGET
 //        3=ADD(int64 delta in value, returns int64) 4=PING
+//        5=DELETE_PREFIX(erases all keys starting with key, returns int64
+//          count) — retired collective generations are swept so a long job
+//          taking thousands of snapshots keeps the coordinator map bounded
 //   status: 0=ok 1=not_found 2=timeout 3=error
 
 #include <arpa/inet.h>
@@ -170,6 +173,22 @@ struct Server {
         }
         case 4: {  // PING
           ok = send_response(fd, 0, "");
+          break;
+        }
+        case 5: {  // DELETE_PREFIX
+          int64_t count = 0;
+          {
+            std::lock_guard<std::mutex> lock(store.mu);
+            auto it = store.data.lower_bound(key);
+            while (it != store.data.end() &&
+                   it->first.compare(0, key.size(), key) == 0) {
+              it = store.data.erase(it);
+              ++count;
+            }
+          }
+          std::string out(8, '\0');
+          memcpy(&out[0], &count, 8);
+          ok = send_response(fd, 0, out);
           break;
         }
         default:
@@ -335,6 +354,17 @@ int tpustore_client_ping(void* handle) {
   auto* c = static_cast<Client*>(handle);
   std::lock_guard<std::mutex> lock(c->mu);
   return client_request(c, 4, "", nullptr, 0);
+}
+
+int tpustore_client_delete_prefix(void* handle, const char* prefix,
+                                  int64_t* count) {
+  auto* c = static_cast<Client*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  int status = client_request(c, 5, prefix, nullptr, 0);
+  if (status == 0 && c->last_value.size() == 8) {
+    memcpy(count, c->last_value.data(), 8);
+  }
+  return status;
 }
 
 uint32_t tpustore_client_value_len(void* handle) {

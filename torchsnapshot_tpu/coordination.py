@@ -53,7 +53,20 @@ class JaxCoordinationStore(KVStore):
         self._client.key_value_set_bytes(key, value)
 
     def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
-        return self._client.blocking_key_value_get_bytes(key, int(timeout_s * 1000))
+        try:
+            return self._client.blocking_key_value_get_bytes(
+                key, int(timeout_s * 1000)
+            )
+        except Exception as e:
+            # Normalize the service's DEADLINE_EXCEEDED XlaRuntimeError to the
+            # KVStore.get contract so barrier/LinearBarrier timeout handling
+            # (and their error-key re-check) works uniformly across backends.
+            msg = str(e).lower()
+            if "deadline" in msg or "timed out" in msg or "timeout" in msg:
+                raise TimeoutError(
+                    f"Timed out waiting for store key: {key}"
+                ) from e
+            raise
 
     def try_get(self, key: str) -> Optional[bytes]:
         try:
@@ -72,6 +85,16 @@ class JaxCoordinationStore(KVStore):
         except Exception:
             return 0
         return len(entries)
+
+    def delete_prefix(self, prefix: str) -> int:
+        # The coordination service's delete has directory semantics: removing
+        # a key recursively removes everything under it.  Count is not
+        # reported; return 1 as "attempted" so callers can tell it ran.
+        try:
+            self._client.key_value_delete(prefix.rstrip("/"))
+            return 1
+        except Exception:
+            return 0
 
 
 def maybe_jax_coordination_store() -> Optional[KVStore]:

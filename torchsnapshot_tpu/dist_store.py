@@ -54,6 +54,15 @@ class KVStore(abc.ABC):
         """Atomically add to an integer counter; returns the new value."""
         ...
 
+    def delete_prefix(self, prefix: str) -> int:
+        """Best-effort sweep of every key under ``prefix`` (which callers
+        terminate with ``/`` so generation ``3`` never matches ``30``).
+        Returns the number of keys removed; 0 when the backend can't sweep.
+        Keeps coordinator memory bounded across thousands of snapshots —
+        the reference tears its TCPStore down per run, a job-scoped store
+        cannot."""
+        return 0
+
     def wait_hint(self, iteration: int) -> None:
         """Polling back-off helper for spin-wait loops."""
         time.sleep(min(0.001 * (2 ** min(iteration, 7)), 0.2))
@@ -124,6 +133,22 @@ class FileStore(KVStore):
         finally:
             os.unlink(lock)
 
+    def delete_prefix(self, prefix: str) -> int:
+        encoded = os.path.basename(self._key_path(prefix))
+        count = 0
+        try:
+            names = os.listdir(self._root)
+        except OSError:
+            return 0
+        for name in names:
+            if name.startswith(encoded):
+                try:
+                    os.unlink(os.path.join(self._root, name))
+                    count += 1
+                except OSError:
+                    pass
+        return count
+
 
 class PrefixStore(KVStore):
     """Namespaced view of another store (torch's PrefixStore equivalent)."""
@@ -146,6 +171,9 @@ class PrefixStore(KVStore):
 
     def add(self, key: str, amount: int) -> int:
         return self._store.add(self._k(key), amount)
+
+    def delete_prefix(self, prefix: str) -> int:
+        return self._store.delete_prefix(self._k(prefix))
 
 
 def get_or_create_store(rank: int, world_size: int) -> KVStore:
@@ -183,6 +211,11 @@ class LinearBarrier:
     Safe off the main thread: only store ops, no collectives.  Error
     propagation: any rank may ``report_error``; every peer blocked in
     ``arrive``/``depart`` raises :class:`StorePeerError`.
+
+    Waits are O(1) store ops per rank: the last arriver sets a sentinel key
+    and the leader blocks on it server-side (CV-blocking GET on the C++
+    store), instead of polling a counter.  ``report_error`` also sets both
+    sentinels so blocked peers wake immediately and observe the error.
     """
 
     def __init__(
@@ -193,38 +226,50 @@ class LinearBarrier:
         world_size: int,
         leader_rank: int = 0,
     ) -> None:
-        self._store = PrefixStore(f"linear_barrier/{prefix}", store)
+        self.prefix = f"linear_barrier/{prefix}"
+        self._store = PrefixStore(self.prefix, store)
         self._rank = rank
         self._world_size = world_size
         self._leader_rank = leader_rank
 
-    def _wait_counter(self, key: str, target: int, timeout_s: float) -> None:
-        deadline = time.monotonic() + timeout_s
-        i = 0
-        while True:
-            err = self._store.try_get("error")
-            if err is not None:
-                raise StorePeerError(err.decode("utf-8", errors="replace"))
-            if self._store.add(key, 0) >= target:
-                return
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"LinearBarrier timed out waiting on {key}")
-            self._store.wait_hint(i)
-            i += 1
+    def _check_error(self) -> None:
+        err = self._store.try_get("error")
+        if err is not None:
+            raise StorePeerError(err.decode("utf-8", errors="replace"))
+
+    def _blocking_wait(self, key: str, timeout_s: float) -> None:
+        try:
+            self._store.get(key, timeout_s=timeout_s)
+        except TimeoutError:
+            self._check_error()
+            raise TimeoutError(f"LinearBarrier timed out waiting on {key}")
+        self._check_error()
 
     def arrive(self, timeout_s: float = 1800.0) -> None:
-        self._store.add("arrived", 1)
+        if self._store.add("arrived", 1) >= self._world_size:
+            self._store.set("all_arrived", b"1")
         if self._rank == self._leader_rank:
-            self._wait_counter("arrived", self._world_size, timeout_s)
+            self._blocking_wait("all_arrived", timeout_s)
 
     def depart(self, timeout_s: float = 1800.0) -> None:
         if self._rank == self._leader_rank:
-            self._store.add("departed", 1)
+            self._store.set("departed", b"1")
         else:
-            self._wait_counter("departed", 1, timeout_s)
+            self._blocking_wait("departed", timeout_s)
+        # Per-rank completion mark: the barrier's keys may only be swept once
+        # this counter reaches world_size — a peer's completion thread can
+        # still be parked on `departed` long after the leader moved on.
+        self._store.add("done", 1)
+
+    def done_guard(self) -> tuple:
+        """(key, target) telling a sweeper when this barrier's keys are dead."""
+        return f"{self.prefix}/done", self._world_size
 
     def report_error(self, message: str) -> None:
         self._store.set("error", message.encode())
+        # Wake any peer blocked on a sentinel; they re-check the error key.
+        self._store.set("all_arrived", b"error")
+        self._store.set("departed", b"error")
 
 
 def make_barrier_prefix() -> str:

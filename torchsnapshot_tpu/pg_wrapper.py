@@ -45,8 +45,14 @@ class PGWrapper:
         self._prefix = prefix
         self._timeout_s = timeout_s
         self._generation = 0
+        # Key prefixes issued since the last completed barrier (not yet safe
+        # to sweep) and externally retired prefixes with optional guard
+        # counters (swept by rank 0 at a barrier once the guard is met).
+        # See barrier() for the safety argument.
+        self._staged_keys: List[str] = []
+        self._retired_keys: List[tuple] = []
 
-    _from_jax_cache: Optional["PGWrapper"] = None
+    _from_jax_cache: dict = {}
 
     @classmethod
     def from_jax(cls, prefix: str = "pg") -> "PGWrapper":
@@ -54,15 +60,15 @@ class PGWrapper:
         the runtime, store resolved from the environment (tpustore addr,
         shared-FS path, or the JAX coordination service).
 
-        The instance is cached per process: collective key namespaces are
-        generation-numbered per wrapper, so every default-pg call sharing one
-        wrapper keeps generations monotonic across successive snapshots.  The
-        backing store must be job-scoped (tpustore and the JAX coordination
-        service are by construction; a TPUSNAP_STORE_PATH directory must be
-        unique per job, like torch's FileStore).
+        Instances are cached per (process, prefix): collective key namespaces
+        are generation-numbered per wrapper, so every default-pg call sharing
+        one wrapper keeps generations monotonic across successive snapshots.
+        The backing store must be job-scoped (tpustore and the JAX
+        coordination service are by construction; a TPUSNAP_STORE_PATH
+        directory must be unique per job, like torch's FileStore).
         """
-        if cls._from_jax_cache is not None:
-            return cls._from_jax_cache
+        if prefix in cls._from_jax_cache:
+            return cls._from_jax_cache[prefix]
         from .coordination import jax_process_info
         from .dist_store import get_or_create_store
 
@@ -74,7 +80,7 @@ class PGWrapper:
             return cls()
         store = get_or_create_store(rank, world_size)
         pg = cls(store=store, rank=rank, world_size=world_size, prefix=prefix)
-        cls._from_jax_cache = pg
+        cls._from_jax_cache[prefix] = pg
         return pg
 
     def get_rank(self) -> int:
@@ -85,17 +91,57 @@ class PGWrapper:
 
     def _next_key(self, op: str) -> str:
         self._generation += 1
-        return f"{self._prefix}/{op}/{self._generation}"
+        key = f"{self._prefix}/{op}/{self._generation}"
+        self._staged_keys.append(key)
+        return key
+
+    def retire_prefix(
+        self,
+        prefix: str,
+        guard_key: Optional[str] = None,
+        guard_target: int = 0,
+    ) -> None:
+        """Mark an external key namespace (e.g. a completed async snapshot's
+        LinearBarrier) for deletion at a future barrier.  Our own barrier only
+        proves *main* threads advanced; when the namespace is used by
+        background threads (LinearBarrier), pass a ``(guard_key,
+        guard_target)`` counter that reaches the target only once every rank's
+        background participant is through — the sweep skips the prefix until
+        then."""
+        self._retired_keys.append((prefix, guard_key, guard_target))
 
     def barrier(self) -> None:
+        """O(1) store ops per rank: counter arrive, the last arriver sets a
+        sentinel, everyone issues one blocking GET on it (CV-blocking on the
+        C++ store — no polling traffic).  Raises TimeoutError after
+        ``timeout_s`` if a peer never arrives, instead of hanging forever.
+
+        Doubles as the key-sweep point: observing the sentinel for generation
+        g proves every rank has arrived, hence completed every collective
+        issued before g — so rank 0 deletes those generations' keys.  The
+        current barrier's own keys stay until the next barrier (peers may not
+        have read the sentinel yet).
+        """
         if self._store is None or self._world_size == 1:
             return
         key = self._next_key("barrier")
-        self._store.add(f"{key}/arrived", 1)
-        deadline_counter = 0
-        while self._store.add(f"{key}/arrived", 0) < self._world_size:
-            self._store.wait_hint(deadline_counter)
-            deadline_counter += 1
+        if self._store.add(f"{key}/arrived", 1) >= self._world_size:
+            self._store.set(f"{key}/go", b"1")
+        self._store.get(f"{key}/go", timeout_s=self._timeout_s)
+        if self._rank == 0:
+            kept = []
+            for stale, guard_key, guard_target in self._retired_keys:
+                if guard_key is not None and self._store.add(guard_key, 0) < guard_target:
+                    kept.append((stale, guard_key, guard_target))
+                    continue
+                self._store.delete_prefix(f"{stale}/")
+            self._retired_keys = kept
+            for stale in self._staged_keys:
+                if stale != key:
+                    self._store.delete_prefix(f"{stale}/")
+        else:
+            self._retired_keys = []
+        self._staged_keys = [key]
 
     def all_gather_object(self, obj: Any) -> List[Any]:
         """Gather one pickled object per rank, ordered by rank (reference
@@ -138,10 +184,12 @@ class PGWrapper:
             return
         key = self._next_key("broadcast")
         if self._rank == src:
-            self._store.set(key, pickle.dumps(obj_list))
+            self._store.set(f"{key}/v", pickle.dumps(obj_list))
             received = obj_list
         else:
-            received = pickle.loads(self._store.get(key, timeout_s=self._timeout_s))
+            received = pickle.loads(
+                self._store.get(f"{key}/v", timeout_s=self._timeout_s)
+            )
         obj_list[:] = received
 
     def scatter_object_list(

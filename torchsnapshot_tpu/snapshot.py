@@ -683,6 +683,8 @@ class PendingSnapshot:
         self._storage = storage
         self._unique_id = unique_id
         self.exception: Optional[BaseException] = None
+        self._barrier: Optional[LinearBarrier] = None
+        self._retired = False
         self._done_event = threading.Event()
         self._thread = threading.Thread(
             target=self._complete_snapshot,
@@ -702,6 +704,7 @@ class PendingSnapshot:
                 rank=self.pg.get_rank(),
                 world_size=self.pg.get_world_size(),
             )
+            self._barrier = barrier
         try:
             pending_io_work.sync_complete()
             if barrier is not None:
@@ -751,6 +754,21 @@ class PendingSnapshot:
         self._thread.join()
         if self.exception is not None:
             raise self.exception
+        # Runs on the caller's thread: safe to touch the pg.  The barrier's
+        # keys are swept at a future pg barrier, but only once every rank's
+        # completion *thread* is provably through depart() (its `done`
+        # counter hits world size) — a peer's background thread can still be
+        # parked on `departed` long after our main thread moved on.  Retire
+        # exactly once: a re-retire's guard probe would recreate the swept
+        # counter and pin the entry forever.
+        if self._barrier is not None and not self._retired:
+            self._retired = True
+            guard_key, guard_target = self._barrier.done_guard()
+            self.pg.retire_prefix(
+                self._barrier.prefix,
+                guard_key=guard_key,
+                guard_target=guard_target,
+            )
         snapshot = Snapshot(path=self.path, pg=self.pg)
         snapshot._metadata = self._metadata
         return snapshot

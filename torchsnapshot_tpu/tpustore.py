@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import ctypes
 import socket
-from typing import Optional
+import threading
+from typing import List, Optional
 
 from .dist_store import KVStore
 
@@ -62,6 +63,12 @@ class _NativeLib:
         ]
         lib.tpustore_client_ping.restype = ctypes.c_int
         lib.tpustore_client_ping.argtypes = [ctypes.c_void_p]
+        lib.tpustore_client_delete_prefix.restype = ctypes.c_int
+        lib.tpustore_client_delete_prefix.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.tpustore_client_value_len.restype = ctypes.c_uint32
         lib.tpustore_client_value_len.argtypes = [ctypes.c_void_p]
         lib.tpustore_client_value.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -97,66 +104,170 @@ class TCPStoreServer:
 
 
 class TCPStore(KVStore):
+    """Client over a small pool of TCP connections.
+
+    The C client keeps the last response value in per-connection state
+    (``last_value``) read back via ``value_len``/``value`` — two separate
+    calls.  Sharing one connection across threads (the documented async
+    pattern: a PendingSnapshot completion thread running LinearBarrier ops
+    concurrently with main-thread collectives) would let a second request
+    clobber ``last_value`` between a ``get()`` returning and its value read,
+    and would also convoy every caller behind a server-side blocking GET.
+
+    Every op therefore checks a connection out of a free pool (connecting on
+    demand) and returns it afterwards: the request/value pair is private to
+    the op, a blocking GET only occupies its own socket, connections are
+    bounded by peak op concurrency rather than thread churn (each async
+    snapshot spawns a fresh completion thread), and ``close()`` never frees a
+    connection another thread is mid-request on — in-flight handles are
+    closed at check-in.
+    """
+
     def __init__(self, host: str, port: int, connect_timeout_s: float = 60.0) -> None:
         self._lib = _NativeLib.get().lib
         try:
             ip = socket.gethostbyname(host or "127.0.0.1")
         except socket.gaierror:
             ip = host
-        self._handle = self._lib.tpustore_client_connect(
-            ip.encode(), port, int(connect_timeout_s * 1000)
-        )
-        if not self._handle:
-            raise RuntimeError(f"Failed to connect to tpustore at {host}:{port}")
+        self._ip = ip
+        self._port = port
+        self._connect_timeout_ms = int(connect_timeout_s * 1000)
+        self._free: List[int] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        # Connect eagerly so construction fails fast if the server is absent.
+        self._checkin(self._checkout())
 
-    def _read_value(self) -> bytes:
-        n = self._lib.tpustore_client_value_len(self._handle)
+    def _checkout(self) -> int:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("TCPStore is closed")
+            if self._free:
+                return self._free.pop()
+        handle = self._lib.tpustore_client_connect(
+            self._ip.encode(), self._port, self._connect_timeout_ms
+        )
+        if not handle:
+            raise RuntimeError(
+                f"Failed to connect to tpustore at {self._ip}:{self._port}"
+            )
+        return handle
+
+    def _checkin(self, handle: int) -> None:
+        with self._lock:
+            if not self._closed:
+                self._free.append(handle)
+                return
+        self._lib.tpustore_client_close(handle)
+
+    def _discard(self, handle: int) -> None:
+        # After a failed op the connection's stream state is unknown: drop it.
+        self._lib.tpustore_client_close(handle)
+
+    def _read_value(self, handle: int) -> bytes:
+        n = self._lib.tpustore_client_value_len(handle)
         buf = ctypes.create_string_buffer(n)
         if n:
-            self._lib.tpustore_client_value(self._handle, buf)
+            self._lib.tpustore_client_value(handle, buf)
         return buf.raw[:n]
 
     def set(self, key: str, value: bytes) -> None:
-        status = self._lib.tpustore_client_set(
-            self._handle, key.encode(), value, len(value)
-        )
+        handle = self._checkout()
+        try:
+            status = self._lib.tpustore_client_set(
+                handle, key.encode(), value, len(value)
+            )
+        except BaseException:
+            self._discard(handle)
+            raise
         if status != 0:
+            self._discard(handle)
             raise RuntimeError(f"tpustore set failed for {key}: status {status}")
+        self._checkin(handle)
 
     def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
-        status = self._lib.tpustore_client_get(
-            self._handle, key.encode(), int(timeout_s * 1000)
-        )
+        handle = self._checkout()
+        try:
+            status = self._lib.tpustore_client_get(
+                handle, key.encode(), int(timeout_s * 1000)
+            )
+            if status == 0:
+                value = self._read_value(handle)
+        except BaseException:
+            self._discard(handle)
+            raise
         if status == 2:
+            # A timed-out GET leaves the connection in a clean state (the
+            # server sent a complete response); reuse it.
+            self._checkin(handle)
             raise TimeoutError(f"Timed out waiting for store key: {key}")
         if status != 0:
+            self._discard(handle)
             raise RuntimeError(f"tpustore get failed for {key}: status {status}")
-        return self._read_value()
+        self._checkin(handle)
+        return value
 
     def try_get(self, key: str) -> Optional[bytes]:
-        status = self._lib.tpustore_client_tryget(self._handle, key.encode())
+        handle = self._checkout()
+        try:
+            status = self._lib.tpustore_client_tryget(handle, key.encode())
+            if status == 0:
+                value = self._read_value(handle)
+        except BaseException:
+            self._discard(handle)
+            raise
         if status == 1:
+            self._checkin(handle)
             return None
         if status != 0:
+            self._discard(handle)
             raise RuntimeError(f"tpustore tryget failed for {key}: status {status}")
-        return self._read_value()
+        self._checkin(handle)
+        return value
 
     def add(self, key: str, amount: int) -> int:
+        handle = self._checkout()
         result = ctypes.c_int64(0)
-        status = self._lib.tpustore_client_add(
-            self._handle, key.encode(), amount, ctypes.byref(result)
-        )
+        try:
+            status = self._lib.tpustore_client_add(
+                handle, key.encode(), amount, ctypes.byref(result)
+            )
+        except BaseException:
+            self._discard(handle)
+            raise
         if status != 0:
+            self._discard(handle)
             raise RuntimeError(f"tpustore add failed for {key}: status {status}")
+        self._checkin(handle)
         return result.value
 
+    def delete_prefix(self, prefix: str) -> int:
+        handle = self._checkout()
+        count = ctypes.c_int64(0)
+        try:
+            status = self._lib.tpustore_client_delete_prefix(
+                handle, prefix.encode(), ctypes.byref(count)
+            )
+        except BaseException:
+            self._discard(handle)
+            raise
+        if status != 0:
+            self._discard(handle)
+            raise RuntimeError(
+                f"tpustore delete_prefix failed for {prefix}: status {status}"
+            )
+        self._checkin(handle)
+        return count.value
+
     def wait_hint(self, iteration: int) -> None:
-        # Blocking gets are server-side; only `add`-polling loops spin.
+        # Blocking gets are served server-side; only `add`-polling loops spin.
         import time
 
         time.sleep(min(0.001 * (2 ** min(iteration, 6)), 0.05))
 
     def close(self) -> None:
-        if self._handle:
-            self._lib.tpustore_client_close(self._handle)
-            self._handle = None
+        with self._lock:
+            self._closed = True
+            handles, self._free = self._free, []
+        for handle in handles:
+            self._lib.tpustore_client_close(handle)
