@@ -171,6 +171,141 @@ def test_read_budget_respected():
     assert outstanding["peak"] <= 250
 
 
+def test_overbudget_requests_do_not_pile_up_awaiting_io():
+    """With N over-budget requests and slow storage, the always-admit-one
+    guard must not admit the next request while a staged buffer still awaits
+    its write — otherwise all N buffers accumulate in host memory, the exact
+    condition the budget exists to prevent (reference scheduler.py:266-277
+    requires staging, ready-for-io and io all empty)."""
+    live = {"now": 0, "peak": 0}
+
+    class _LiveStager(BufferStager):
+        def __init__(self, payload: bytes):
+            self.payload = payload
+
+        async def stage_buffer(self, executor=None):
+            live["now"] += 1
+            live["peak"] = max(live["peak"], live["now"])
+            await asyncio.sleep(0.001)
+            return self.payload
+
+        def get_staging_cost_bytes(self) -> int:
+            return 10**9  # far above budget: every admission is via the guard
+
+    class _SlowMemoryStorage(MemoryStoragePlugin):
+        async def write(self, write_io):
+            await asyncio.sleep(0.02)
+            await super().write(write_io)
+            live["now"] -= 1  # buffer lifetime ends when the write lands
+
+    MemoryStoragePlugin.reset()
+    storage = _SlowMemoryStorage(root="test_pileup")
+    write_reqs = [
+        WriteReq(path=f"p{i}", buffer_stager=_LiveStager(b"z" * 64))
+        for i in range(4)
+    ]
+    pending = sync_execute_write_reqs(
+        write_reqs, storage, memory_budget_bytes=10, rank=0
+    )
+    pending.sync_complete()
+    assert live["peak"] == 1, f"{live['peak']} over-budget buffers were live at once"
+    assert len(storage._files) == 4
+
+
+def _install_budget_probe(monkeypatch):
+    """Record every _BudgetTracker the scheduler creates."""
+    from torchsnapshot_tpu import scheduler as sched_mod
+
+    created = []
+    real = sched_mod._BudgetTracker
+
+    class _Probe(real):
+        def __init__(self, budget_bytes):
+            super().__init__(budget_bytes)
+            self.initial = budget_bytes
+            created.append(self)
+
+    monkeypatch.setattr(sched_mod, "_BudgetTracker", _Probe)
+    return created
+
+
+def test_write_failure_drains_and_recredits(monkeypatch, caplog):
+    """A mid-pipeline storage failure must cancel-and-drain outstanding
+    staging/io tasks (no destroyed-pending-task warnings) and fully re-credit
+    the budget (VERDICT round-1 item; reference scheduler fails clean)."""
+    import logging
+
+    import gc
+    import logging
+
+    class _FailingStorage(MemoryStoragePlugin):
+        async def write(self, write_io):
+            # Two concurrent failures: the non-raised sibling's exception
+            # must still be retrieved during teardown (no asyncio GC noise).
+            if write_io.path in ("p3", "p4"):
+                raise RuntimeError("injected io failure")
+            await asyncio.sleep(0.05)  # keep peers in flight at failure time
+            await super().write(write_io)
+
+    MemoryStoragePlugin.reset()
+    _TrackingStager.reset()
+    storage = _FailingStorage(root="test_drain")
+    budgets = _install_budget_probe(monkeypatch)
+    write_reqs = [
+        WriteReq(path=f"p{i}", buffer_stager=_TrackingStager(b"w" * 100, cost=100))
+        for i in range(8)
+    ]
+    with caplog.at_level(logging.ERROR, logger="asyncio"):
+        with pytest.raises(RuntimeError, match="injected io failure"):
+            sync_execute_write_reqs(
+                write_reqs, storage, memory_budget_bytes=250, rank=0
+            )
+        gc.collect()  # surface any never-retrieved task exceptions now
+    assert not any("Task was destroyed" in r.message for r in caplog.records)
+    assert not any("never retrieved" in r.message for r in caplog.records)
+    (budget,) = budgets
+    assert budget.remaining == budget.initial, "budget not fully re-credited"
+    assert budget.inflight == 0
+
+
+def test_read_failure_drains_and_recredits(monkeypatch, caplog):
+    """Same clean-failure contract on the read pipeline."""
+    import logging
+
+    MemoryStoragePlugin.reset()
+    _TrackingStager.reset()
+    storage = MemoryStoragePlugin(root="test_read_drain")
+    payloads = {f"p{i}": bytes([i]) * 100 for i in range(8)}
+    write_reqs = [
+        WriteReq(path=k, buffer_stager=_TrackingStager(v, cost=100))
+        for k, v in payloads.items()
+    ]
+    sync_execute_write_reqs(write_reqs, storage, 1 << 20, 0).sync_complete()
+
+    class _FailingConsumer(_CollectConsumer):
+        async def consume_buffer(self, buf, executor=None):
+            if self.key == "p3":
+                raise RuntimeError("injected consume failure")
+            await asyncio.sleep(0.05)
+            await super().consume_buffer(buf, executor)
+
+    budgets = _install_budget_probe(monkeypatch)
+    sink: dict = {}
+    read_reqs = [
+        ReadReq(path=k, buffer_consumer=_FailingConsumer(sink, k, cost=100))
+        for k in payloads
+    ]
+    with caplog.at_level(logging.ERROR, logger="asyncio"):
+        with pytest.raises(RuntimeError, match="injected consume failure"):
+            sync_execute_read_reqs(
+                read_reqs, storage, memory_budget_bytes=250, rank=0
+            )
+    assert not any("Task was destroyed" in r.message for r in caplog.records)
+    (budget,) = budgets
+    assert budget.remaining == budget.initial, "budget not fully re-credited"
+    assert budget.inflight == 0
+
+
 def test_sync_take_failure_no_metadata(tmp_path):
     """Sync-save failure must not commit .snapshot_metadata (commit
     protocol, sync side — async side covered in test_distributed)."""

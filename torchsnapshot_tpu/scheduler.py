@@ -79,6 +79,19 @@ class _WritePipeline:
         self.staging_cost = write_req.buffer_stager.get_staging_cost_bytes()
         self.buf: Optional[object] = None
         self.buf_sz_bytes = 0
+        self._io_credited = False
+
+    def release_after_io(self, budget: "_BudgetTracker") -> None:
+        """Release the staged buffer and credit its bytes, exactly once.
+
+        Idempotent because it runs from two places that can both fire: the
+        io coroutine's ``finally``, and pipeline teardown — where an io task
+        cancelled before its first event-loop step never executes its
+        coroutine body (so the ``finally`` is skipped entirely)."""
+        if not self._io_credited:
+            self._io_credited = True
+            self.buf = None
+            budget.remaining += self.buf_sz_bytes
 
     async def stage_buffer(self, executor: Optional[Executor]) -> "_WritePipeline":
         self.buf = await self.write_req.buffer_stager.stage_buffer(executor)
@@ -178,33 +191,42 @@ async def execute_write_reqs(
         )
     )
     staging_tasks: set = set()
+    staging_pipelines: dict = {}
     io_tasks: set = set()
+    io_pipelines: dict = {}
     all_io_tasks: List[asyncio.Task] = []
     io_semaphore = asyncio.Semaphore(knobs.get_max_per_rank_io_concurrency())
     staged_bytes = 0
     reporter = _ProgressReporter(rank=rank, total=len(write_reqs), verb="write")
 
     async def _io(pipeline: _WritePipeline) -> None:
-        async with io_semaphore:
-            sz = pipeline.buf_sz_bytes
-            await pipeline.write_buffer()
-        budget.remaining += sz
-        reporter.io_done += 1
+        try:
+            async with io_semaphore:
+                await pipeline.write_buffer()
+            reporter.io_done += 1
+        finally:
+            # Credit (and release the buffer) on every outcome — success,
+            # storage failure, or cancellation during a pipeline teardown —
+            # so the budget is always fully re-credited.
+            pipeline.release_after_io(budget)
 
     def dispatch_staging() -> None:
-        # Admit while cost fits; always admit one if nothing is in flight
-        # (starvation guard for requests larger than the whole budget,
-        # reference scheduler.py:266-277).
+        # Admit while cost fits; always admit one if nothing is in flight at
+        # ANY stage (starvation guard for requests larger than the whole
+        # budget, reference scheduler.py:266-277 — which requires staging,
+        # ready-for-io and io all empty; admitting whenever staging alone is
+        # empty would let N over-budget buffers pile up awaiting slow I/O).
         while ready_for_staging:
             pipeline = ready_for_staging[0]
             if pipeline.staging_cost <= budget.remaining or (
-                budget.inflight == 0 and not staging_tasks
+                budget.inflight == 0 and not staging_tasks and not io_tasks
             ):
                 ready_for_staging.popleft()
                 budget.remaining -= pipeline.staging_cost
                 budget.inflight += 1
                 task = asyncio.ensure_future(pipeline.stage_buffer(executor))
                 staging_tasks.add(task)
+                staging_pipelines[task] = pipeline
             else:
                 break
 
@@ -220,22 +242,54 @@ async def execute_write_reqs(
         io_task = asyncio.ensure_future(_io(pipeline))
         io_tasks.add(io_task)
         all_io_tasks.append(io_task)
+        io_pipelines[io_task] = pipeline
         io_task.add_done_callback(io_tasks.discard)
 
-    dispatch_staging()
-    while staging_tasks:
-        done, _ = await asyncio.wait(
-            staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
-        )
-        for task in done:
-            if task in staging_tasks:
-                staging_tasks.discard(task)
-                pipeline = task.result()  # raises on staging failure
-                on_staged(pipeline)
-            elif task.done() and task.exception() is not None:
-                raise task.exception()  # I/O failure surfaces immediately
+    try:
         dispatch_staging()
-        reporter.maybe_report(budget)
+        # Loop until staging fully drains.  With the io-aware starvation
+        # guard, staging_tasks can be empty while over-budget requests wait
+        # for in-flight writes to free budget — keep waiting on io_tasks.
+        while staging_tasks or ready_for_staging:
+            done, _ = await asyncio.wait(
+                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                if task in staging_pipelines:
+                    staging_tasks.discard(task)
+                    pipeline = task.result()  # raises on staging failure
+                    staging_pipelines.pop(task)
+                    on_staged(pipeline)
+                elif task.exception() is not None:
+                    raise task.exception()  # I/O failure surfaces immediately
+            dispatch_staging()
+            reporter.maybe_report(budget)
+    except BaseException:
+        # Cancel-and-drain every outstanding task before re-raising
+        # (reference scheduler.py:299-331 fails clean): no
+        # destroyed-pending-task warnings, host buffers released, budget
+        # fully re-credited.  I/O tasks self-credit in _io's finally;
+        # staging tasks that never reached on_staged are credited here.
+        for t in staging_tasks | io_tasks:
+            if not t.done():
+                t.cancel()
+        # Gather ALL io tasks ever created, not just the live set: a sibling
+        # failure in the same done-batch was already auto-discarded from
+        # io_tasks by its done-callback, and skipping it would leave its
+        # exception never-retrieved (asyncio GC noise).
+        if staging_tasks or all_io_tasks:
+            await asyncio.gather(
+                *staging_tasks, *all_io_tasks, return_exceptions=True
+            )
+        for pipeline in staging_pipelines.values():
+            pipeline.buf = None
+            budget.remaining += pipeline.staging_cost
+            budget.inflight -= 1
+        for pipeline in io_pipelines.values():
+            # No-op for tasks whose _io finally already ran; credits the ones
+            # cancelled before their coroutine body ever started.
+            pipeline.release_after_io(budget)
+        raise
 
     elapsed = time.monotonic() - reporter._begin
     if staged_bytes and elapsed > 0:
@@ -339,6 +393,8 @@ async def execute_read_reqs(
     io_semaphore = asyncio.Semaphore(knobs.get_max_per_rank_io_concurrency())
     io_tasks: set = set()
     consume_tasks: set = set()
+    # task -> pipeline, for re-crediting un-consumed pipelines on failure
+    pipelines: dict = {}
     reporter = _ProgressReporter(rank=rank, total=len(read_reqs), verb="read")
 
     async def _read(pipeline: _ReadPipeline) -> _ReadPipeline:
@@ -354,12 +410,14 @@ async def execute_read_reqs(
                 ready_for_io.popleft()
                 budget.remaining -= pipeline.consuming_cost
                 budget.inflight += 1
-                io_tasks.add(asyncio.ensure_future(_read(pipeline)))
+                task = asyncio.ensure_future(_read(pipeline))
+                io_tasks.add(task)
+                pipelines[task] = pipeline
             else:
                 break
 
-    dispatch_io()
     try:
+        dispatch_io()
         while io_tasks or consume_tasks:
             done, _ = await asyncio.wait(
                 io_tasks | consume_tasks, return_when=asyncio.FIRST_COMPLETED
@@ -367,18 +425,37 @@ async def execute_read_reqs(
             for task in done:
                 if task in io_tasks:
                     io_tasks.discard(task)
-                    pipeline = task.result()
-                    consume_tasks.add(
-                        asyncio.ensure_future(pipeline.consume_buffer(executor))
+                    pipeline = task.result()  # raises on storage failure
+                    pipelines.pop(task)
+                    consume_task = asyncio.ensure_future(
+                        pipeline.consume_buffer(executor)
                     )
+                    consume_tasks.add(consume_task)
+                    pipelines[consume_task] = pipeline
                 else:
                     consume_tasks.discard(task)
-                    pipeline = task.result()
+                    pipeline = task.result()  # raises on consume failure
+                    pipelines.pop(task)
                     budget.remaining += pipeline.consuming_cost
                     budget.inflight -= 1
                     reporter.io_done += 1
             dispatch_io()
             reporter.maybe_report(budget)
+    except BaseException:
+        # Mirror the write path: cancel-and-drain outstanding reads/consumes
+        # before re-raising, releasing buffers and re-crediting the budget.
+        for t in io_tasks | consume_tasks:
+            if not t.done():
+                t.cancel()
+        if io_tasks or consume_tasks:
+            await asyncio.gather(
+                *io_tasks, *consume_tasks, return_exceptions=True
+            )
+        for pipeline in pipelines.values():
+            pipeline.buf = None
+            budget.remaining += pipeline.consuming_cost
+            budget.inflight -= 1
+        raise
     finally:
         executor.shutdown()
 
