@@ -79,6 +79,101 @@ def test_large_writes_pass_through():
     assert entry.location == "0/big"
 
 
+def test_sparse_slab_restore_reads_roughly_entry_bytes():
+    """Two entries at opposite ends of a slab must NOT become one
+    whole-slab read (the reference merges unconditionally and flags the
+    amplification itself, reference batcher.py:441-445 TODO)."""
+    # 34 x 3 KB entries -> ~100 KB slab; read back only the first and last.
+    arrays = {f"a{i:02d}": np.full((768,), i, np.float32) for i in range(34)}
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries[name] = entry
+        write_reqs += reqs
+    with knobs.override_slab_size_threshold_bytes(1 << 20):
+        entries, batched = batch_write_requests(entries, write_reqs)
+    assert len(batched) == 1  # one ~100 KB slab
+
+    class _ByteCountingStorage(MemoryStoragePlugin):
+        bytes_read = 0
+
+        async def read(self, read_io):
+            await super().read(read_io)
+            _ByteCountingStorage.bytes_read += len(read_io.buf)
+
+    MemoryStoragePlugin.reset()
+    storage = _ByteCountingStorage(root="sparse")
+    sync_execute_write_reqs(batched, storage, BUDGET, 0).sync_complete()
+
+    sparse = {"a00": arrays["a00"], "a33": arrays["a33"]}
+    read_reqs = []
+    futs = {}
+    for name in sparse:
+        rr, fut = prepare_read(entries[name])
+        read_reqs += rr
+        futs[name] = fut
+    with knobs.override_max_read_merge_gap_bytes(8192):
+        merged = batch_read_requests(read_reqs)
+    # gap (~94 KB) exceeds the knob: two separate ranged reads
+    assert len(merged) == 2
+    sync_execute_read_reqs(merged, storage, BUDGET, 0)
+    for name, arr in sparse.items():
+        np.testing.assert_array_equal(futs[name].obj, arr)
+    entry_bytes = sum(a.nbytes for a in sparse.values())
+    assert _ByteCountingStorage.bytes_read == entry_bytes
+
+
+def test_adjacent_reads_still_merge_across_small_gaps():
+    """Ranges whose holes are under the knob merge into one spanning read."""
+    arrays = {f"a{i}": np.full((64,), i, np.float32) for i in range(8)}
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries[name] = entry
+        write_reqs += reqs
+    with knobs.override_slab_size_threshold_bytes(1 << 20):
+        entries, batched = batch_write_requests(entries, write_reqs)
+    assert len(batched) == 1
+
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="adj")
+    sync_execute_write_reqs(batched, storage, BUDGET, 0).sync_complete()
+
+    # Read every other entry: 256-byte holes, well under the default gap.
+    picks = [f"a{i}" for i in range(0, 8, 2)]
+    read_reqs = []
+    futs = {}
+    for name in picks:
+        rr, fut = prepare_read(entries[name])
+        read_reqs += rr
+        futs[name] = fut
+    merged = batch_read_requests(read_reqs)
+    assert len(merged) == 1
+    sync_execute_read_reqs(merged, storage, BUDGET, 0)
+    for name in picks:
+        np.testing.assert_array_equal(futs[name].obj, arrays[name])
+
+
+def test_tiled_reads_never_remerged():
+    """prepare_read with a buffer budget splits one tensor into tiles; the
+    batcher must not weld them back into a whole-payload read (that would
+    silently defeat buffer_size_limit_bytes)."""
+    arr = np.arange(4096, dtype=np.float32)  # 16 KB
+    entry, reqs = prepare_write(arr, "big", rank=0, replicated=False)
+    MemoryStoragePlugin.reset()
+    storage = MemoryStoragePlugin(root="tiled")
+    sync_execute_write_reqs(reqs, storage, BUDGET, 0).sync_complete()
+
+    read_reqs, fut = prepare_read(entry, buffer_size_limit_bytes=4096)
+    assert len(read_reqs) == 4
+    merged = batch_read_requests(read_reqs)
+    assert len(merged) == 4, "tiled reads were re-merged"
+    sync_execute_read_reqs(merged, storage, BUDGET, 0)
+    np.testing.assert_array_equal(fut.obj, arr)
+
+
 def test_object_entries_not_batched():
     entries = {}
     write_reqs = []

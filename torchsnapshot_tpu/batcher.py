@@ -166,32 +166,63 @@ class BatchedBufferStager(BufferStager):
 
 
 def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
+    """Merge ranged reads per file into spanning reads — but only within a
+    bounded gap.
+
+    The reference merges every ranged read on a path unconditionally and
+    flags the resulting read-amplification itself (reference
+    batcher.py:441-445 TODO: two entries at opposite ends of a 128 MB slab
+    become one whole-slab read).  Here reads are sorted by offset and merged
+    greedily only while the hole between a request and the group's end stays
+    under the ``max_read_merge_gap_bytes`` knob (8 MB default) — sparse
+    elastic restores read roughly the bytes they need.
+
+    Tiled reads (``no_merge``) pass through untouched: they were split
+    precisely to bound buffering, and they all target one location.
+    """
+    max_gap = knobs.get_max_read_merge_gap_bytes()
     by_path: Dict[str, List[ReadReq]] = defaultdict(list)
     passthrough: List[ReadReq] = []
     for rr in read_reqs:
-        if rr.byte_range is not None:
+        if rr.byte_range is not None and not rr.no_merge:
             by_path[rr.path].append(rr)
         else:
             passthrough.append(rr)
 
     out = passthrough
-    for path, reqs in by_path.items():
-        if len(reqs) < 2:
-            out += reqs
-            continue
-        start = min(r.byte_range[0] for r in reqs)
-        end = max(r.byte_range[1] for r in reqs)
+
+    def _flush_group(path: str, group: List[ReadReq]) -> None:
+        if len(group) == 1:
+            out.append(group[0])
+            return
+        start = group[0].byte_range[0]
+        end = max(r.byte_range[1] for r in group)
         members = [
             (r.byte_range[0] - start, r.byte_range[1] - start, r.buffer_consumer)
-            for r in reqs
+            for r in group
         ]
         out.append(
             ReadReq(
                 path=path,
                 byte_range=[start, end],
-                buffer_consumer=BatchedBufferConsumer(members=members, total=end - start),
+                buffer_consumer=BatchedBufferConsumer(
+                    members=members, total=end - start
+                ),
             )
         )
+
+    for path, reqs in by_path.items():
+        reqs.sort(key=lambda r: r.byte_range[0])
+        group: List[ReadReq] = []
+        group_end = 0
+        for rr in reqs:
+            if group and rr.byte_range[0] - group_end > max_gap:
+                _flush_group(path, group)
+                group = []
+            group.append(rr)
+            group_end = max(group_end, rr.byte_range[1])
+        if group:
+            _flush_group(path, group)
     return out
 
 

@@ -153,6 +153,9 @@ class ArrayIOPreparer:
                     buffer_consumer=ArrayBufferConsumer(
                         assembly=assembly, flat_offset=offset, nbytes=length
                     ),
+                    # Merging the tiles back together would defeat the
+                    # caller's buffer budget (they all target one location).
+                    no_merge=True,
                 )
             )
             offset += length

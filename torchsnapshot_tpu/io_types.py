@@ -77,6 +77,10 @@ class ReadReq:
     path: str
     buffer_consumer: BufferConsumer
     byte_range: Optional[List[int]] = None
+    # Tiled reads (one tensor split under a buffer budget) must never be
+    # re-merged by the batcher — that would silently defeat the caller's
+    # buffer_size_limit_bytes and buffer the whole payload at once.
+    no_merge: bool = False
 
 
 class StoragePlugin(abc.ABC):

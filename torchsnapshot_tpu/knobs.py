@@ -24,11 +24,13 @@ PER_RANK_MEMORY_BUDGET_ENV_VAR = _ENV_PREFIX + "PER_RANK_MEMORY_BUDGET_BYTES"
 ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR = (
     _ENV_PREFIX + "ENABLE_SHARDED_ARRAY_ELASTICITY_ROOT_ONLY"
 )
+MAX_READ_MERGE_GAP_ENV_VAR = _ENV_PREFIX + "MAX_READ_MERGE_GAP_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
 _DEFAULT_MAX_PER_RANK_IO_CONCURRENCY = 16
+_DEFAULT_MAX_READ_MERGE_GAP_BYTES = 8 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -64,6 +66,19 @@ def get_max_per_rank_io_concurrency() -> int:
 
 def is_batching_disabled() -> bool:
     return _get_bool_env(DISABLE_BATCHING_ENV_VAR)
+
+
+def get_max_read_merge_gap_bytes() -> int:
+    """Largest hole tolerated inside one merged (spanning) read.
+
+    Merging two ranged reads whose gap exceeds this reads-and-discards more
+    bytes than a second request costs; the reference merges unconditionally
+    and flags the read-amplification itself (reference batcher.py:441-445
+    TODO) — sparse elastic restores from 128 MB slabs would read whole slabs
+    for a few entries' bytes."""
+    return _get_int_env(
+        MAX_READ_MERGE_GAP_ENV_VAR, _DEFAULT_MAX_READ_MERGE_GAP_BYTES
+    )
 
 
 def get_per_rank_memory_budget_bytes_override() -> Optional[int]:
@@ -126,4 +141,10 @@ def override_batching_disabled(disabled: bool) -> Generator[None, None, None]:
 @contextmanager
 def override_per_rank_memory_budget_bytes(value: int) -> Generator[None, None, None]:
     with _override_env(PER_RANK_MEMORY_BUDGET_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_max_read_merge_gap_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(MAX_READ_MERGE_GAP_ENV_VAR, str(value)):
         yield

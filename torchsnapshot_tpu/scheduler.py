@@ -289,6 +289,10 @@ async def execute_write_reqs(
             # No-op for tasks whose _io finally already ran; credits the ones
             # cancelled before their coroutine body ever started.
             pipeline.release_after_io(budget)
+        # On success the returned PendingIOWork owns the executor; on this
+        # path it is never constructed, so shut our own executor down too.
+        if own_executor:
+            executor.shutdown(wait=False)
         raise
 
     elapsed = time.monotonic() - reporter._begin
