@@ -28,25 +28,57 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_BACKEND = {"name": "unknown", "fallback_reason": None}
+
+
 def _init_devices():
     """Probe backend health in a subprocess first: if the TPU transport is
     wedged (device init hangs), fall back to CPU in THIS process before any
-    backend is touched, so the benchmark always reports a result."""
+    backend is touched, so the benchmark always reports a result.
+
+    The probe retries with backoff (a flaky tunnel can recover between
+    attempts) and records WHAT failed; the fallback is stamped into the
+    result JSON as a top-level ``backend: cpu_fallback`` — a CPU number must
+    never masquerade as an accelerator number (round-1 verdict item)."""
     import subprocess
 
     import jax
 
     timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", 90))
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            check=True,
-            capture_output=True,
-        )
-    except Exception:
-        log("TPU backend unavailable; falling back to CPU backend")
-        jax.config.update("jax_platforms", "cpu")
+    attempts = int(os.environ.get("BENCH_DEVICE_ATTEMPTS", 3))
+    probe_code = (
+        "import jax, sys;"
+        "d = jax.devices();"
+        "sys.stdout.write(','.join(x.platform for x in d))"
+    )
+    last_error = None
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", probe_code],
+                timeout=timeout_s,
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            platforms = proc.stdout.strip()
+            _BACKEND["name"] = (
+                "cpu" if platforms and set(platforms.split(",")) == {"cpu"} else "tpu"
+            )
+            log(f"device probe ok (attempt {attempt + 1}): platforms={platforms}")
+            return jax.devices()
+        except subprocess.TimeoutExpired:
+            last_error = f"device init timed out after {timeout_s:.0f}s"
+        except subprocess.CalledProcessError as e:
+            tail = (e.stderr or "").strip().splitlines()
+            last_error = f"device init failed: {tail[-1] if tail else 'no stderr'}"
+        log(f"device probe attempt {attempt + 1}/{attempts} failed: {last_error}")
+        if attempt + 1 < attempts:
+            time.sleep(min(15 * (attempt + 1), 45))
+    log("TPU backend unavailable; falling back to CPU backend")
+    _BACKEND["name"] = "cpu_fallback"
+    _BACKEND["fallback_reason"] = last_error
+    jax.config.update("jax_platforms", "cpu")
     return jax.devices()
 
 
@@ -66,7 +98,12 @@ def _install_watchdog() -> None:
             "value": round(_PARTIAL["save_gbps"], 3),
             "unit": "GB/s",
             "vs_baseline": round(_PARTIAL["save_gbps"] / BASELINE_GBPS, 3),
-            "aux": {"incomplete": True, "hung_in_phase": _PARTIAL["phase"]},
+            "backend": _BACKEND["name"],
+            "aux": {
+                "incomplete": True,
+                "hung_in_phase": _PARTIAL["phase"],
+                "fallback_reason": _BACKEND["fallback_reason"],
+            },
         }
         print(json.dumps(result), flush=True)
         os._exit(2)
@@ -93,12 +130,12 @@ def main() -> None:
 
     # ~2 GiB of bf16 params (1B params) on one chip, as stacked layer arrays
     # (mirrors the flagship model's layout: few large arrays, the MXU- and
-    # DMA-friendly shape).
-    # Default sized so sync+async+restore all complete within a few minutes
-    # even over a slow tunneled transport (~20 MB/s observed); the metric is
-    # bandwidth-normalized, so size doesn't bias it.  Override with
-    # BENCH_TARGET_BYTES for big-run numbers on healthy hardware.
-    target_bytes = int(os.environ.get("BENCH_TARGET_BYTES", 512 << 20))
+    # DMA-friendly shape).  2 GiB default so a >1 GB/s pipeline measures
+    # multi-second phases, not noise; a wedged-transport fallback shrinks to
+    # 512 MiB so the run still finishes over a ~20 MB/s tunnel.  Override
+    # with BENCH_TARGET_BYTES either way.
+    default_bytes = 512 << 20 if _BACKEND["name"] == "cpu_fallback" else 2048 << 20
+    target_bytes = int(os.environ.get("BENCH_TARGET_BYTES", default_bytes))
     n_arrays = 8
     per_array = target_bytes // n_arrays // 2  # bf16 = 2 bytes
     dim = 4096
@@ -138,17 +175,34 @@ def main() -> None:
     link_gbps = probe.size * 2 / 1e9 / (time.monotonic() - t0)
     log(f"raw D2H link: {link_gbps:.3f} GB/s")
 
+    from torchsnapshot_tpu import phase_stats
+
+    def _drain_writeback() -> None:
+        # Start every timed phase with page-cache headroom: without this,
+        # the previous phase's dirty pages push the kernel past its dirty
+        # ratio mid-measurement and write() blocks on disk writeback —
+        # run-to-run swings of 10x on this box.  The reference's runs on
+        # fresh dirs amortize the same way.
+        try:
+            os.sync()
+        except OSError:
+            pass
+
     # --- sync save ---
     _PARTIAL["phase"] = "sync_save"
     snap_path = os.path.join(workdir, "snap")
     shutil.rmtree(snap_path, ignore_errors=True)
+    _drain_writeback()
+    phase_stats.reset()
     begin = time.monotonic()
     snapshot = Snapshot.take(snap_path, app_state)
     save_s = time.monotonic() - begin
+    save_phases = phase_stats.snapshot()
     save_gbps = actual_bytes / 1e9 / save_s
     _PARTIAL["save_gbps"] = save_gbps
     _PARTIAL["phase"] = "async_save"
     log(f"sync save: {save_s:.2f}s -> {save_gbps:.2f} GB/s")
+    log(f"  save phases: {phase_stats.format_line(save_phases)}")
 
     # --- async save: training-blocked time ---
     # Fresh arrays: jax caches host copies after the sync save, which would
@@ -157,6 +211,7 @@ def main() -> None:
     app_state2 = {"model": StateDict({f"w{i}": a for i, a in enumerate(arrays2)})}
     async_path = os.path.join(workdir, "snap_async")
     shutil.rmtree(async_path, ignore_errors=True)
+    _drain_writeback()
     begin = time.monotonic()
     pending = Snapshot.async_take(async_path, app_state2)
     stall_s = time.monotonic() - begin
@@ -173,10 +228,14 @@ def main() -> None:
             {f"w{i}": jnp.zeros((rows, dim), jnp.bfloat16) for i in range(n_arrays)}
         )
     }
+    _drain_writeback()
+    phase_stats.reset()
     begin = time.monotonic()
     snapshot.restore(dst)
     restore_s = time.monotonic() - begin
+    restore_phases = phase_stats.snapshot()
     log(f"restore: {restore_s:.2f}s -> {actual_bytes / 1e9 / restore_s:.2f} GB/s")
+    log(f"  restore phases: {phase_stats.format_line(restore_phases)}")
 
     # verify a sample
     np.testing.assert_array_equal(
@@ -186,22 +245,37 @@ def main() -> None:
     if not os.environ.get("BENCH_DIR"):
         shutil.rmtree(workdir, ignore_errors=True)
 
+    def _phases_brief(stats):
+        return {
+            phase: {
+                "s": round(v["s"], 3),
+                "gb": round(v["bytes"] / 1e9, 3),
+                "gbps": round(v["bytes"] / 1e9 / v["s"], 2) if v["s"] > 0 else None,
+            }
+            for phase, v in sorted(stats.items(), key=lambda kv: -kv[1]["s"])
+        }
+
     result = {
         "metric": "checkpoint_save_throughput_per_chip",
         "value": round(save_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(save_gbps / BASELINE_GBPS, 3),
+        "backend": _BACKEND["name"],
         "aux": {
             "state_gib": round(gib, 2),
             "sync_save_s": round(save_s, 2),
             "async_stall_s": round(stall_s, 2),
             "async_total_s": round(async_total_s, 2),
             "restore_s": round(restore_s, 2),
+            "restore_gbps": round(actual_bytes / 1e9 / restore_s, 3),
             "raw_d2h_link_gbps": round(link_gbps, 3),
             "pipeline_efficiency_vs_link": round(save_gbps / link_gbps, 3)
             if link_gbps > 0
             else None,
             "device": str(devices[0]),
+            "fallback_reason": _BACKEND["fallback_reason"],
+            "save_phases": _phases_brief(save_phases),
+            "restore_phases": _phases_brief(restore_phases),
         },
     }
     print(json.dumps(result), flush=True)

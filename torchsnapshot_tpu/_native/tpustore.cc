@@ -406,6 +406,32 @@ int tpusnap_write_file(const char* path, const void* buf, int64_t nbytes) {
   return 0;
 }
 
+// Scatter-gather file write: the member buffers of a slab are written
+// sequentially from their own memory, skipping the pack memcpy a contiguous
+// slab would cost (host memory bandwidth is the scarce resource on both the
+// 1-vCPU dev box and a TPU host busy with HBM D2H staging).
+int tpusnap_write_file_parts(const char* path, const void** bufs,
+                             const int64_t* sizes, int n) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  for (int i = 0; i < n; ++i) {
+    const char* p = static_cast<const char*>(bufs[i]);
+    int64_t put = 0;
+    while (put < sizes[i]) {
+      ssize_t r = ::write(fd, p + put, static_cast<size_t>(sizes[i] - put));
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        int err = errno;
+        ::close(fd);
+        return -err;
+      }
+      put += r;
+    }
+  }
+  if (::close(fd) < 0) return -errno;
+  return 0;
+}
+
 int tpusnap_read_range(const char* path, void* buf, int64_t offset,
                        int64_t nbytes) {
   int fd = ::open(path, O_RDONLY);

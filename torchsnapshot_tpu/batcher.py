@@ -37,6 +37,7 @@ from .io_types import (
     BufferStager,
     BufferType,
     ReadReq,
+    ScatterBuffer,
     WriteReq,
 )
 from .manifest import (
@@ -76,8 +77,14 @@ def is_batchable(write_req: WriteReq, entry_index: Dict[str, TensorEntry]) -> bo
 
 
 def batch_write_requests(
-    entries: Manifest, write_reqs: List[WriteReq]
+    entries: Manifest,
+    write_reqs: List[WriteReq],
+    scatter_ok: bool = False,
 ) -> Tuple[Manifest, List[WriteReq]]:
+    """``scatter_ok``: the destination storage writes ScatterBuffer parts
+    without joining (fs native data plane) — slabs then cost no side
+    allocation.  Backends that join at write time (cloud/memory) keep the
+    slab total in the staging cost so the memory budget stays honest."""
     entry_index = _index_tensor_entries(entries)
     slab_threshold = knobs.get_slab_size_threshold_bytes()
 
@@ -118,7 +125,9 @@ def batch_write_requests(
             out_reqs.append(
                 WriteReq(
                     path=location,
-                    buffer_stager=BatchedBufferStager(members=members, total=offset),
+                    buffer_stager=BatchedBufferStager(
+                        members=members, total=offset, scatter_ok=scatter_ok
+                    ),
                 )
             )
         slab = []
@@ -140,29 +149,43 @@ def batch_write_requests(
 
 
 class BatchedBufferStager(BufferStager):
-    def __init__(self, members: List[Tuple[BufferStager, int, int]], total: int) -> None:
+    """Stages all slab members concurrently (their D2H DMAs overlap) and
+    hands storage a :class:`ScatterBuffer` of the member views in offset
+    order — no pack memcpy; backends without scatter-gather join lazily.
+    """
+
+    def __init__(
+        self,
+        members: List[Tuple[BufferStager, int, int]],
+        total: int,
+        scatter_ok: bool = False,
+    ) -> None:
         self._members = members
         self._total = total
+        self._scatter_ok = scatter_ok
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        slab = bytearray(self._total)
-
-        async def _stage_one(stager: BufferStager, offset: int, nbytes: int) -> None:
+        async def _stage_one(stager: BufferStager, nbytes: int) -> memoryview:
             buf = await stager.stage_buffer(executor)
             view = memoryview(buf).cast("B")
             if view.nbytes != nbytes:
                 raise RuntimeError(
                     f"Batched member staged {view.nbytes} bytes, expected {nbytes}"
                 )
-            slab[offset : offset + nbytes] = view
+            return view
 
-        await asyncio.gather(
-            *(_stage_one(s, o, n) for s, o, n in self._members)
+        views = await asyncio.gather(
+            *(_stage_one(s, n) for s, _, n in self._members)
         )
-        return slab
+        return ScatterBuffer(views)
 
     def get_staging_cost_bytes(self) -> int:
-        return self._total + sum(s.get_staging_cost_bytes() for s, _, _ in self._members)
+        cost = sum(s.get_staging_cost_bytes() for s, _, _ in self._members)
+        if not self._scatter_ok:
+            # The destination will join() at write time: budget the
+            # slab-sized allocation that copy makes.
+            cost += self._total
+        return cost
 
 
 def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
@@ -184,7 +207,7 @@ def batch_read_requests(read_reqs: List[ReadReq]) -> List[ReadReq]:
     by_path: Dict[str, List[ReadReq]] = defaultdict(list)
     passthrough: List[ReadReq] = []
     for rr in read_reqs:
-        if rr.byte_range is not None and not rr.no_merge:
+        if rr.byte_range is not None and not rr.no_merge and rr.into is None:
             by_path[rr.path].append(rr)
         else:
             passthrough.append(rr)

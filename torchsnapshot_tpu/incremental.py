@@ -27,7 +27,7 @@ import logging
 import os
 from typing import Dict, Optional
 
-from .io_types import ReadIO, StoragePlugin, WriteIO
+from .io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from .manifest import (
     ChunkedTensorEntry,
     ObjectEntry,
@@ -87,7 +87,7 @@ class IncrementalFSStoragePlugin(StoragePlugin):
             def _hash_and_link() -> bool:
                 from . import integrity
 
-                if integrity.compute(write_io.buf) != expected:
+                if integrity.compute(contiguous(write_io.buf)) != expected:
                     return False
                 src = os.path.join(self._base_root, write_io.path)
                 dst = os.path.join(self._inner.root, write_io.path)

@@ -28,11 +28,13 @@ def compute(buf) -> Optional[str]:
     if not checksums_enabled():
         return None
     from .native_io import NativeFileIO
+    from . import phase_stats
 
     native = NativeFileIO.maybe_create()
     if native is None:
         return None
-    return f"xxh64:{native.xxhash64(buf):016x}"
+    with phase_stats.timed("checksum", memoryview(buf).nbytes):
+        return f"xxh64:{native.xxhash64(buf):016x}"
 
 
 def verify(buf, expected: Optional[str], location: str) -> None:
@@ -46,7 +48,10 @@ def verify(buf, expected: Optional[str], location: str) -> None:
     native = NativeFileIO.maybe_create()
     if native is None:
         return
-    actual = f"{native.xxhash64(buf):016x}"
+    from . import phase_stats
+
+    with phase_stats.timed("checksum", memoryview(buf).nbytes):
+        actual = f"{native.xxhash64(buf):016x}"
     if actual != digest:
         raise ChecksumError(
             f"Checksum mismatch for {location}: stored xxh64:{digest}, "

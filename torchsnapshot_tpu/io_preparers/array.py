@@ -31,6 +31,9 @@ from ..manifest import TensorEntry
 from ..serialization import Serializer
 
 
+_INTO_PLACE_MIN_BYTES = 1 << 20
+
+
 class ArrayIOPreparer:
     @staticmethod
     def _choose_serializer(dtype: Any) -> Serializer:
@@ -116,11 +119,25 @@ class ArrayIOPreparer:
 
         assembly = ArrayAssembly(entry=entry, obj_out=obj_out)
         total_bytes = serialization.array_nbytes(entry.shape, entry.dtype)
+
+        def _into_view(offset: int, nbytes: int) -> Optional[memoryview]:
+            # Read-into-place: hand storage the assembly's own memory so fs
+            # preads land the bytes directly (no allocation, no consume
+            # memcpy).  Only worth a syscall-per-request for sizable reads;
+            # small entries keep the merge-and-copy slab path.
+            if nbytes < _INTO_PLACE_MIN_BYTES:
+                return None
+            try:
+                return memoryview(assembly.flat_u8())[offset : offset + nbytes]
+            except Exception:
+                return None
+
         if (
             buffer_size_limit_bytes is None
             or buffer_size_limit_bytes <= 0
             or total_bytes <= buffer_size_limit_bytes
         ):
+            into = _into_view(0, total_bytes)
             read_reqs = [
                 ReadReq(
                     path=entry.location,
@@ -131,7 +148,9 @@ class ArrayIOPreparer:
                         nbytes=total_bytes,
                         checksum=entry.checksum,
                         location=entry.location,
+                        into=into,
                     ),
+                    into=into,
                 )
             ]
             assembly.expect(1)
@@ -146,16 +165,21 @@ class ArrayIOPreparer:
         offset = 0
         while offset < total_bytes:
             length = min(tile, total_bytes - offset)
+            tile_into = _into_view(offset, length)
             read_reqs.append(
                 ReadReq(
                     path=entry.location,
                     byte_range=[base + offset, base + offset + length],
                     buffer_consumer=ArrayBufferConsumer(
-                        assembly=assembly, flat_offset=offset, nbytes=length
+                        assembly=assembly,
+                        flat_offset=offset,
+                        nbytes=length,
+                        into=tile_into,
                     ),
                     # Merging the tiles back together would defeat the
                     # caller's buffer budget (they all target one location).
                     no_merge=True,
+                    into=tile_into,
                 )
             )
             offset += length
@@ -278,18 +302,22 @@ def _device_put_like(host: np.ndarray, like: Any) -> Any:
     fast path for sub-word dtypes (staging.device_put_fast)."""
     import jax
 
+    from .. import phase_stats
+
     if host.dtype != np.dtype(like.dtype):
         host = host.astype(np.dtype(like.dtype))
-    try:
-        devices = like.sharding.device_set
-        memory_kind = getattr(like.sharding, "memory_kind", None)
-        # Fast path only for plain single-device HBM targets: a non-default
-        # memory kind (pinned_host offload) must be preserved exactly.
-        if len(devices) == 1 and memory_kind in (None, "device"):
-            return staging.device_put_fast(host, next(iter(devices)))
-    except Exception:
-        pass
-    return jax.device_put(host, like.sharding)
+    with phase_stats.timed("h2d", host.nbytes):
+        try:
+            devices = like.sharding.device_set
+            memory_kind = getattr(like.sharding, "memory_kind", None)
+            # Fast path only for plain single-device HBM targets: a
+            # non-default memory kind (pinned_host offload) must be
+            # preserved exactly.
+            if len(devices) == 1 and memory_kind in (None, "device"):
+                return staging.device_put_fast(host, next(iter(devices)))
+        except Exception:
+            pass
+        return jax.device_put(host, like.sharding)
 
 
 class ArrayBufferConsumer(BufferConsumer):
@@ -300,23 +328,30 @@ class ArrayBufferConsumer(BufferConsumer):
         nbytes: int,
         checksum: Optional[str] = None,
         location: str = "",
+        into: Optional[memoryview] = None,
     ) -> None:
         self._assembly = assembly
         self._flat_offset = flat_offset
         self._nbytes = nbytes
         self._checksum = checksum
         self._location = location
+        self._into = into
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        in_place = self._into is not None and buf is self._into
+
         def _copy() -> None:
-            from .. import integrity
+            from .. import integrity, phase_stats
 
             integrity.verify(buf, self._checksum, self._location)
-            view = self._assembly.flat_u8()
-            src = np.frombuffer(buf, dtype=np.uint8, count=self._nbytes)
-            view[self._flat_offset : self._flat_offset + self._nbytes] = src
+            if in_place:
+                return  # storage already read the bytes into the assembly
+            with phase_stats.timed("consume_copy", self._nbytes):
+                view = self._assembly.flat_u8()
+                src = np.frombuffer(buf, dtype=np.uint8, count=self._nbytes)
+                view[self._flat_offset : self._flat_offset + self._nbytes] = src
 
         if executor is not None and self._nbytes > 1 << 20:
             await asyncio.get_running_loop().run_in_executor(executor, _copy)

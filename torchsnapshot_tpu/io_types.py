@@ -15,9 +15,45 @@ import asyncio
 from dataclasses import dataclass
 from typing import Any, Generic, List, Optional, TypeVar
 
-BufferType = Any  # bytes | bytearray | memoryview
+BufferType = Any  # bytes | bytearray | memoryview | ScatterBuffer
 
 T = TypeVar("T")
+
+
+class ScatterBuffer:
+    """Ordered host buffers forming one logical payload (a slab).
+
+    Lets batched writes skip the pack memcpy: storage backends with
+    scatter-gather support (the native fs data plane) write the parts
+    directly from their own memory; others call :meth:`join` — one memcpy,
+    the contiguous-slab behavior.  On a host whose memory bandwidth is the
+    bottleneck (every TPU host mid-D2H), the skipped pack is a full extra
+    pass over the checkpoint bytes.
+    """
+
+    __slots__ = ("parts", "nbytes")
+
+    def __init__(self, parts) -> None:
+        self.parts = [memoryview(p).cast("B") for p in parts]
+        self.nbytes = sum(p.nbytes for p in self.parts)
+
+    def join(self) -> memoryview:
+        from . import phase_stats
+
+        if len(self.parts) == 1:
+            return self.parts[0]
+        out = bytearray(self.nbytes)
+        offset = 0
+        with phase_stats.timed("slab_pack", self.nbytes):
+            for part in self.parts:
+                out[offset : offset + part.nbytes] = part
+                offset += part.nbytes
+        return memoryview(out)
+
+
+def contiguous(buf: BufferType) -> BufferType:
+    """The payload as one contiguous buffer (joins a ScatterBuffer)."""
+    return buf.join() if isinstance(buf, ScatterBuffer) else buf
 
 
 class Future(Generic[T]):
@@ -39,6 +75,10 @@ class ReadIO:
     path: str
     byte_range: Optional[List[int]] = None
     buf: Optional[bytearray] = None
+    # Optional preallocated destination: plugins that can read directly into
+    # it (fs readinto/native pread) do so and set buf = into — the consumer
+    # then skips its copy.  Plugins that can't simply ignore it.
+    into: Optional[memoryview] = None
 
 
 class BufferStager(abc.ABC):
@@ -81,10 +121,20 @@ class ReadReq:
     # re-merged by the batcher — that would silently defeat the caller's
     # buffer_size_limit_bytes and buffer the whole payload at once.
     no_merge: bool = False
+    # Read-into-place: the consumer's destination view, forwarded to the
+    # storage plugin via ReadIO.into.  Requests carrying one are never
+    # merged (their destinations are not contiguous in host memory).
+    into: Optional[memoryview] = None
 
 
 class StoragePlugin(abc.ABC):
     """Async storage backend contract (reference io_types.py:80-120)."""
+
+    # True when write() consumes a ScatterBuffer part-by-part with no join
+    # memcpy/allocation (the native fs data plane).  Backends that join at
+    # write time leave this False so the batcher keeps the slab-sized side
+    # allocation in the staging cost the scheduler budgets for.
+    supports_scatter: bool = False
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None:

@@ -30,6 +30,13 @@ class NativeFileIO:
             ctypes.c_void_p,
             ctypes.c_int64,
         ]
+        lib.tpusnap_write_file_parts.restype = ctypes.c_int
+        lib.tpusnap_write_file_parts.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+        ]
         lib.tpusnap_read_range.restype = ctypes.c_int
         lib.tpusnap_read_range.argtypes = [
             ctypes.c_char_p,
@@ -98,6 +105,31 @@ class NativeFileIO:
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)
 
+    def write_file_parts(self, path: str, parts: List[Any]) -> None:
+        """Scatter-gather write: parts land sequentially in one file with no
+        pack memcpy.  The GIL is released for the whole C write loop."""
+        import numpy as np
+
+        views = []
+        for part in parts:
+            view = memoryview(part)
+            if not view.c_contiguous:
+                view = memoryview(bytes(view))
+            views.append(view.cast("B"))
+        views = [v for v in views if v.nbytes]
+        n = len(views)
+        if n == 0:
+            with open(path, "wb"):
+                return
+        # np.frombuffer aliases each buffer (read-only ok) without copying;
+        # keep the arrays alive for the duration of the native call.
+        arrs = [np.frombuffer(v, np.uint8) for v in views]
+        bufs = (ctypes.c_void_p * n)(*(a.ctypes.data for a in arrs))
+        sizes = (ctypes.c_int64 * n)(*(v.nbytes for v in views))
+        rc = self._lib.tpusnap_write_file_parts(path.encode(), bufs, sizes, n)
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)
+
     def read_file(self, path: str, byte_range: Optional[List[int]]) -> bytearray:
         if byte_range is None:
             size = self._lib.tpusnap_file_size(path.encode())
@@ -114,3 +146,27 @@ class NativeFileIO:
             if rc != 0:
                 raise OSError(-rc, os.strerror(-rc), path)
         return out
+
+    def read_file_into(
+        self, path: str, byte_range: Optional[List[int]], view: Any
+    ) -> None:
+        """Ranged pread straight into a caller-owned writable buffer — the
+        zero-copy restore path (no bytearray allocation, no consume memcpy)."""
+        import numpy as np
+
+        mv = memoryview(view)
+        if byte_range is None:
+            offset, nbytes = 0, mv.nbytes
+        else:
+            offset = byte_range[0]
+            nbytes = byte_range[1] - byte_range[0]
+        if nbytes == 0:
+            return
+        if mv.nbytes != nbytes:
+            raise ValueError(f"into-view is {mv.nbytes} bytes, range is {nbytes}")
+        arr = np.frombuffer(mv, np.uint8)
+        rc = self._lib.tpusnap_read_range(
+            path.encode(), ctypes.c_void_p(arr.ctypes.data), offset, nbytes
+        )
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)

@@ -36,8 +36,15 @@ from typing import Awaitable, Callable, List, Optional
 
 import psutil
 
-from . import knobs
-from .io_types import ReadIO, ReadReq, StoragePlugin, WriteIO, WriteReq
+from . import knobs, phase_stats
+from .io_types import (
+    ReadIO,
+    ReadReq,
+    ScatterBuffer,
+    StoragePlugin,
+    WriteIO,
+    WriteReq,
+)
 from .pg_wrapper import PGWrapper
 
 logger = logging.getLogger(__name__)
@@ -106,6 +113,8 @@ class _WritePipeline:
 
 
 def _buf_nbytes(buf: object) -> int:
+    if isinstance(buf, ScatterBuffer):
+        return buf.nbytes
     if isinstance(buf, memoryview):
         return buf.nbytes
     if isinstance(buf, (bytes, bytearray)):
@@ -184,6 +193,7 @@ async def execute_write_reqs(
         executor = ThreadPoolExecutor(max_workers=_NUM_EXECUTOR_THREADS)
 
     budget = _BudgetTracker(memory_budget_bytes)
+    phases_before = phase_stats.snapshot()
     ready_for_staging: deque[_WritePipeline] = deque(
         sorted(
             (_WritePipeline(wr, storage) for wr in write_reqs),
@@ -298,15 +308,19 @@ async def execute_write_reqs(
     elapsed = time.monotonic() - reporter._begin
     if staged_bytes and elapsed > 0:
         # End-of-phase throughput line (reference _WriteReporter,
-        # scheduler.py:166-173)
+        # scheduler.py:166-173) + per-phase attribution so a slow save
+        # points at its dominant phase (d2h / checksum / slab_pack /
+        # fs_write) instead of a bare total.
         logger.info(
-            "[rank %d] staged %.1f MB in %.2fs (%.1f MB/s), %d/%d writes landed",
+            "[rank %d] staged %.1f MB in %.2fs (%.1f MB/s), %d/%d writes "
+            "landed; phases: %s",
             rank,
             staged_bytes / 1e6,
             elapsed,
             staged_bytes / 1e6 / elapsed,
             reporter.io_done,
             len(write_reqs),
+            phase_stats.format_line(phase_stats.delta(phases_before)),
         )
     return PendingIOWork(
         loop=loop,
@@ -367,6 +381,7 @@ class _ReadPipeline:
                 if self.read_req.byte_range is not None
                 else None
             ),
+            into=self.read_req.into,
         )
         await self.storage.read(read_io)
         self.buf = read_io.buf

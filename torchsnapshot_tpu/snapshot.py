@@ -255,7 +255,11 @@ class Snapshot:
         entries, write_reqs = partition_write_reqs(entries, write_reqs, pg)
 
         if not knobs.is_batching_disabled():
-            entries, write_reqs = batch_write_requests(entries, write_reqs)
+            entries, write_reqs = batch_write_requests(
+                entries,
+                write_reqs,
+                scatter_ok=getattr(storage, "supports_scatter", False),
+            )
 
         memory_budget_bytes = get_process_memory_budget_bytes(pg)
         pending_io_work = sync_execute_write_reqs(

@@ -17,6 +17,7 @@ copied for async snapshots instead (reference tensor.py:283-293).
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -157,7 +158,11 @@ def begin_d2h(arr: Any) -> Any:
 
 def finish_d2h(handle: Any, dtype: Any, shape: Any) -> np.ndarray:
     """Materialize the transfer started by :func:`begin_d2h` on host."""
+    from . import phase_stats
+
+    begin = time.monotonic()
     host = np.asarray(handle)
+    phase_stats.add("d2h", time.monotonic() - begin, host.nbytes)
     if host.dtype == np.uint8 and np.dtype(dtype) != np.uint8:
         return host.view(np.dtype(dtype)).reshape(shape)
     return host.reshape(shape)

@@ -25,7 +25,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from ..memoryview_stream import MemoryviewStream
 
 logger = logging.getLogger(__name__)
@@ -134,7 +134,9 @@ class GCSStoragePlugin(StoragePlugin):
             "https://www.googleapis.com/upload/storage/v1/b/"
             f"{self.bucket_name}/o?uploadType=resumable"
         )
-        view = memoryview(buf).cast("B")
+        # Runs on the executor: a ScatterBuffer join (slab-sized memcpy)
+        # must not stall the event loop driving every other transfer.
+        view = memoryview(contiguous(buf)).cast("B")
         stream = MemoryviewStream(view)
         metadata = {"name": self._blob_url(path)}
         while True:

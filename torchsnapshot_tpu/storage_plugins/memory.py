@@ -12,7 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 
 _REGISTRY: Dict[str, Dict[str, bytes]] = {}
 _LOCK = threading.Lock()
@@ -25,8 +25,9 @@ class MemoryStoragePlugin(StoragePlugin):
             self._files = _REGISTRY.setdefault(root, {})
 
     async def write(self, write_io: WriteIO) -> None:
+        data = bytes(contiguous(write_io.buf))
         with _LOCK:
-            self._files[write_io.path] = bytes(write_io.buf)
+            self._files[write_io.path] = data
 
     async def read(self, read_io: ReadIO) -> None:
         with _LOCK:

@@ -13,7 +13,7 @@ import asyncio
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
-from ..io_types import ReadIO, StoragePlugin, WriteIO
+from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from ..memoryview_stream import MemoryviewStream
 
 _IO_THREADS = 16
@@ -46,7 +46,7 @@ class S3StoragePlugin(StoragePlugin):
 
     async def write(self, write_io: WriteIO) -> None:
         def _put() -> None:
-            body = MemoryviewStream(memoryview(write_io.buf))
+            body = MemoryviewStream(memoryview(contiguous(write_io.buf)))
             self._client.put_object(
                 Bucket=self.bucket, Key=self._key(write_io.path), Body=body
             )
