@@ -1,0 +1,212 @@
+"""Minimal in-process fake GCS speaking the subset the GCS plugin uses.
+
+Protocol surface (what google-resumable-media actually sends):
+- ``POST /upload/storage/v1/b/{bucket}/o?uploadType=resumable`` with JSON
+  metadata → 200 + ``Location`` header (the upload-session URI)
+- ``PUT {session}`` with ``Content-Range: bytes a-b/total`` → 308 with a
+  ``Range: bytes=0-b`` header while incomplete, 200 + JSON when complete;
+  the recovery probe ``Content-Range: bytes */total`` → 308 + persisted range
+- ``GET /download/storage/v1/b/{bucket}/o/{name}?alt=media`` with a ``Range``
+  header → 206 + ``Content-Range: bytes a-b/total``
+- object JSON API list/delete for delete_dir
+
+Fault injection: ``fail_put_chunks`` makes the next N chunk PUTs return 503
+*after discarding their body* — the client must recover() the upload, learn
+how many bytes actually persisted, rewind its stream, and resend
+(the reference's recovery-rewind path, gcs.py:113-126, which round 1 never
+executed).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+
+class FakeGCSServer:
+    def __init__(self) -> None:
+        self.objects: Dict[str, bytes] = {}  # "bucket/name" -> data
+        self.sessions: Dict[str, dict] = {}
+        self.fail_put_chunks = 0  # fail the next N chunk PUTs
+        self.fail_at_chunks = set()  # fail specific 1-based chunk PUT indices
+        self.chunk_puts = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status, body=b"", headers=None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_POST(self):
+                split = urllib.parse.urlsplit(self.path)
+                m = re.match(r"/upload/storage/v1/b/([^/]+)/o", split.path)
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                if not m:
+                    return self._reply(404)
+                bucket = m.group(1)
+                meta = json.loads(body or b"{}")
+                sid = uuid.uuid4().hex
+                with outer._lock:
+                    outer.sessions[sid] = {
+                        "bucket": bucket,
+                        "name": meta.get("name", ""),
+                        "data": bytearray(),
+                    }
+                host = self.headers.get("Host")
+                self._reply(
+                    200, headers={"Location": f"http://{host}/upload-session/{sid}"}
+                )
+
+            def do_PUT(self):
+                split = urllib.parse.urlsplit(self.path)
+                m = re.match(r"/upload-session/([0-9a-f]+)", split.path)
+                length = int(self.headers.get("Content-Length", 0))
+                content_range = self.headers.get("Content-Range", "")
+                if not m:
+                    self.rfile.read(length)
+                    return self._reply(404)
+                sid = m.group(1)
+                with outer._lock:
+                    session = outer.sessions.get(sid)
+                if session is None:
+                    self.rfile.read(length)
+                    return self._reply(404)
+
+                probe = re.match(r"bytes \*/(\d+)", content_range)
+                if probe:
+                    # Recovery probe: report how much actually persisted.
+                    self.rfile.read(length)
+                    received = len(session["data"])
+                    headers = {}
+                    if received:
+                        headers["Range"] = f"bytes=0-{received - 1}"
+                    return self._reply(308, headers=headers)
+
+                spec = re.match(r"bytes (\d+)-(\d+)/(\d+)", content_range)
+                if not spec:
+                    self.rfile.read(length)
+                    return self._reply(400)
+                start, end, total = (int(g) for g in spec.groups())
+
+                with outer._lock:
+                    outer.chunk_puts += 1
+                    fail = outer.fail_put_chunks > 0
+                    if fail:
+                        outer.fail_put_chunks -= 1
+                    elif outer.chunk_puts in outer.fail_at_chunks:
+                        fail = True
+                if fail:
+                    # Discard the chunk: the bytes are NOT persisted, so the
+                    # client's recover() must rewind past-the-wire data.
+                    self.rfile.read(length)
+                    self.close_connection = True
+                    return self._reply(503, headers={"Connection": "close"})
+
+                data = self.rfile.read(length)
+                with outer._lock:
+                    received = len(session["data"])
+                    if start != received:
+                        # Out-of-sync chunk: tell the client where we are.
+                        headers = {}
+                        if received:
+                            headers["Range"] = f"bytes=0-{received - 1}"
+                        return self._reply(308, headers=headers)
+                    session["data"].extend(data)
+                    received = len(session["data"])
+                    if received == total:
+                        key = f"{session['bucket']}/{session['name']}"
+                        outer.objects[key] = bytes(session["data"])
+                        body = json.dumps(
+                            {"name": session["name"], "size": str(total)}
+                        ).encode()
+                        return self._reply(
+                            200, body, {"Content-Type": "application/json"}
+                        )
+                return self._reply(308, headers={"Range": f"bytes=0-{received - 1}"})
+
+            def do_GET(self):
+                split = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(split.query)
+                m = re.match(
+                    r"/download/storage/v1/b/([^/]+)/o/(.+)", split.path
+                )
+                if m and query.get("alt") == ["media"]:
+                    return self._do_download(m)
+                m = re.match(r"/storage/v1/b/([^/]+)/o$", split.path)
+                if m:
+                    return self._do_list(m.group(1), query)
+                self._reply(404)
+
+            def _do_download(self, m):
+                bucket = m.group(1)
+                name = urllib.parse.unquote(m.group(2))
+                with outer._lock:
+                    data = outer.objects.get(f"{bucket}/{name}")
+                if data is None:
+                    return self._reply(404)
+                total = len(data)
+                range_header = self.headers.get("Range")
+                if range_header:
+                    spec = re.match(r"bytes=(\d+)-(\d+)?", range_header)
+                    start = int(spec.group(1))
+                    end = int(spec.group(2)) if spec.group(2) else total - 1
+                    end = min(end, total - 1)
+                    chunk = data[start : end + 1]
+                    return self._reply(
+                        206,
+                        bytes(chunk),
+                        {"Content-Range": f"bytes {start}-{end}/{total}"},
+                    )
+                return self._reply(200, bytes(data))
+
+            def _do_list(self, bucket, query):
+                prefix = query.get("prefix", [""])[0]
+                with outer._lock:
+                    names = sorted(
+                        k[len(bucket) + 1 :]
+                        for k in outer.objects
+                        if k.startswith(f"{bucket}/")
+                        and k[len(bucket) + 1 :].startswith(prefix)
+                    )
+                body = json.dumps({"items": [{"name": n} for n in names]}).encode()
+                self._reply(200, body, {"Content-Type": "application/json"})
+
+            def do_DELETE(self):
+                split = urllib.parse.urlsplit(self.path)
+                m = re.match(r"/storage/v1/b/([^/]+)/o/(.+)", split.path)
+                if not m:
+                    return self._reply(404)
+                bucket = m.group(1)
+                name = urllib.parse.unquote(m.group(2))
+                with outer._lock:
+                    outer.objects.pop(f"{bucket}/{name}", None)
+                self._reply(204)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,149 @@
+"""Minimal in-process S3-compatible HTTP server for the default test suite.
+
+Speaks the subset the S3 plugin uses: PUT/GET (with inclusive-end Range)/
+DELETE on ``/bucket/key`` and ListObjectsV2 on ``/bucket?list-type=2``.
+Fault injection via ``fail_next`` (responds 503 to the next N requests) lets
+tests exercise the retry path.  The reference gates its S3 tests behind a
+real bucket (reference tests/test_s3_storage_plugin.py:24-33); this fake
+makes the semantics testable on every run.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+from xml.sax.saxutils import escape
+
+
+class FakeS3Server:
+    def __init__(self) -> None:
+        self.objects: Dict[str, bytes] = {}  # "bucket/key" -> data
+        self.fail_next = 0
+        self.request_count = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _maybe_fail(self) -> bool:
+                with outer._lock:
+                    outer.request_count += 1
+                    if outer.fail_next > 0:
+                        outer.fail_next -= 1
+                        fail = True
+                    else:
+                        fail = False
+                if fail:
+                    # Drain any request body so the connection stays parseable,
+                    # and close it anyway (clients reconnect on retry).
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                    body = b"<Error><Code>SlowDown</Code></Error>"
+                    self.send_response(503)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    self.close_connection = True
+                return fail
+
+            def _obj_key(self) -> str:
+                path = urllib.parse.urlsplit(self.path).path
+                return urllib.parse.unquote(path.lstrip("/"))
+
+            def do_PUT(self):
+                if self._maybe_fail():
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                data = self.rfile.read(length)
+                with outer._lock:
+                    outer.objects[self._obj_key()] = data
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if self._maybe_fail():
+                    return
+                split = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qs(split.query)
+                if "list-type" in query:
+                    return self._do_list(split, query)
+                key = self._obj_key()
+                with outer._lock:
+                    data = outer.objects.get(key)
+                if data is None:
+                    body = b"<Error><Code>NoSuchKey</Code></Error>"
+                    self.send_response(404)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                range_header = self.headers.get("Range")
+                status = 200
+                if range_header:
+                    # "bytes=a-b", inclusive both ends (the S3/HTTP contract
+                    # the plugin's end-1 correction targets)
+                    spec = range_header.split("=", 1)[1]
+                    start_s, _, end_s = spec.partition("-")
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
+                    data = data[start : end + 1]
+                    status = 206
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _do_list(self, split, query):
+                bucket = split.path.strip("/")
+                prefix = query.get("prefix", [""])[0]
+                with outer._lock:
+                    keys = sorted(
+                        k[len(bucket) + 1 :]
+                        for k in outer.objects
+                        if k.startswith(f"{bucket}/")
+                        and k[len(bucket) + 1 :].startswith(prefix)
+                    )
+                items = "".join(
+                    f"<Contents><Key>{escape(k)}</Key></Contents>" for k in keys
+                )
+                body = (
+                    '<?xml version="1.0" encoding="UTF-8"?>'
+                    '<ListBucketResult xmlns='
+                    '"http://s3.amazonaws.com/doc/2006-03-01/">'
+                    f"{items}<IsTruncated>false</IsTruncated></ListBucketResult>"
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                if self._maybe_fail():
+                    return
+                with outer._lock:
+                    outer.objects.pop(self._obj_key(), None)
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,109 @@
+"""GCS plugin end-to-end against an in-suite fake GCS server.
+
+Executes the ResumableUpload/ChunkedDownload code paths
+(torchsnapshot_tpu/storage_plugins/gcs.py:130-215) that the env-gated real
+bucket integration test (test_gcs_storage_plugin.py) leaves dormant in CI —
+including the mid-chunk failure → recover() → stream-rewind path
+(reference gcs.py:113-126)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+
+from fake_gcs import FakeGCSServer
+
+
+@pytest.fixture()
+def gcs_env(monkeypatch):
+    server = FakeGCSServer()
+    monkeypatch.setenv("TPUSNAP_GCS_ENDPOINT", server.endpoint)
+    # Multi-chunk transfers with small payloads (resumable-media requires
+    # 256 KiB multiples).
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+
+    monkeypatch.setattr(gcs_mod, "_CHUNK_SIZE_BYTES", 256 * 1024)
+    yield server
+    server.stop()
+
+
+def _plugin(root="bkt/pre"):
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    return GCSStoragePlugin(root=root)
+
+
+def test_resumable_upload_and_chunked_download(gcs_env):
+    plugin = _plugin()
+    payload = os.urandom(1024 * 1024)  # 4 chunks of 256 KiB
+
+    async def go():
+        await plugin.write(WriteIO(path="x/y.bin", buf=payload))
+        read_io = ReadIO(path="x/y.bin")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == payload
+        ranged = ReadIO(path="x/y.bin", byte_range=[1000, 300000])
+        await plugin.read(ranged)
+        assert bytes(ranged.buf) == payload[1000:300000]
+        await plugin.close()
+
+    asyncio.run(go())
+    assert gcs_env.objects["bkt/pre/x/y.bin"] == payload
+    assert gcs_env.chunk_puts >= 4
+
+
+def test_upload_killed_mid_chunk_recovers_and_rewinds(gcs_env):
+    """Kill the 3rd chunk PUT mid-upload (two chunks persisted, one
+    discarded in-flight): the client must probe how many bytes actually
+    landed, rewind its stream to that offset, and complete with intact
+    data — the reference's recovery-rewind path (gcs.py:113-126)."""
+    plugin = _plugin(root="bkt")
+    payload = bytes([i % 251 for i in range(1024 * 1024)])  # 4 chunks
+    gcs_env.chunk_puts = 0
+    gcs_env.fail_at_chunks = {3}
+
+    async def upload():
+        await plugin.write(WriteIO(path="killed.bin", buf=payload))
+        read_io = ReadIO(path="killed.bin")
+        await plugin.read(read_io)
+        assert bytes(read_io.buf) == payload
+        await plugin.close()
+
+    asyncio.run(upload())
+    assert gcs_env.objects["bkt/killed.bin"] == payload
+    # 4 good chunks + the killed one (the recovery probe is not a chunk PUT)
+    assert gcs_env.chunk_puts >= 5
+
+
+def test_snapshot_roundtrip_via_gs_url(gcs_env):
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+    app = {
+        "m": StateDict(
+            {"w": np.arange(2048, dtype=np.float32), "step": 3}
+        )
+    }
+    snapshot = Snapshot.take("gs://ckpt/run/s3", app)
+    dst = {"m": StateDict({"w": np.zeros(2048, np.float32), "step": -1})}
+    snapshot.restore(dst)
+    assert_state_dict_eq(dst["m"].state_dict(), app["m"].state_dict())
+
+
+def test_delete_dir(gcs_env):
+    plugin = _plugin(root="bkt")
+
+    async def go():
+        await plugin.write(WriteIO(path="d/a.bin", buf=b"aaa"))
+        await plugin.write(WriteIO(path="d/b.bin", buf=b"bbb"))
+        await plugin.write(WriteIO(path="keep/c.bin", buf=b"ccc"))
+        await plugin.delete_dir("d")
+        await plugin.close()
+
+    asyncio.run(go())
+    assert "bkt/d/a.bin" not in gcs_env.objects
+    assert "bkt/d/b.bin" not in gcs_env.objects
+    assert gcs_env.objects["bkt/keep/c.bin"] == b"ccc"

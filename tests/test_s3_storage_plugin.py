@@ -1,0 +1,122 @@
+"""S3 plugin tests against an in-suite fake server.
+
+Ports the semantics the reference gates behind a real bucket
+(reference tests/test_s3_storage_plugin.py:24-33 writes/reads ranged
+payloads): ranged reads with the inclusive-end correction, full snapshot
+round trip through the ``s3://`` resolver, delete_dir, and transient-error
+retries — all runnable in the default suite.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+
+from fake_s3 import FakeS3Server
+
+
+@pytest.fixture()
+def s3_env(monkeypatch):
+    server = FakeS3Server()
+    monkeypatch.setenv("TPUSNAP_S3_ENDPOINT", server.endpoint)
+    # Exercise the SigV4 signing path too — the fake ignores auth headers.
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-access-key")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret-key")
+    yield server
+    server.stop()
+
+
+def _plugin(root="bkt/pre"):
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    return S3StoragePlugin(root=root)
+
+
+def test_put_get_roundtrip(s3_env):
+    plugin = _plugin()
+    payload = os.urandom(1 << 16)
+    plugin.sync_write(WriteIO(path="a/b.bin", buf=payload))
+    read_io = ReadIO(path="a/b.bin")
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == payload
+    assert "bkt/pre/a/b.bin" in s3_env.objects
+    plugin.sync_close()
+
+
+def test_ranged_reads_inclusive_end_correction(s3_env):
+    """A [start, end) byte_range must fetch exactly end-start bytes —
+    the HTTP Range header is inclusive on both ends (reference s3.py:60-66)."""
+    plugin = _plugin()
+    payload = bytes(range(256)) * 4
+    plugin.sync_write(WriteIO(path="r.bin", buf=payload))
+    for start, end in [(0, 1), (0, 256), (100, 612), (1000, 1024)]:
+        read_io = ReadIO(path="r.bin", byte_range=[start, end])
+        plugin.sync_read(read_io)
+        assert bytes(read_io.buf) == payload[start:end], (start, end)
+    plugin.sync_close()
+
+
+def test_snapshot_roundtrip_via_s3_url(s3_env):
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+    app = {
+        "m": StateDict(
+            {
+                "w": np.arange(4096, dtype=np.float32),
+                "b": np.ones(16, np.float32),
+                "step": 7,
+            }
+        )
+    }
+    snapshot = Snapshot.take("s3://ckpt-bucket/run1/step7", app)
+    dst = {
+        "m": StateDict(
+            {
+                "w": np.zeros(4096, np.float32),
+                "b": np.zeros(16, np.float32),
+                "step": -1,
+            }
+        )
+    }
+    snapshot.restore(dst)
+    assert_state_dict_eq(dst["m"].state_dict(), app["m"].state_dict())
+    assert any(
+        k.startswith("ckpt-bucket/run1/step7/") for k in s3_env.objects
+    )
+
+
+def test_delete_and_delete_dir(s3_env):
+    plugin = _plugin(root="bkt")
+    for name in ("d/x", "d/y", "keep/z"):
+        plugin.sync_write(WriteIO(path=name, buf=b"data"))
+    import asyncio
+
+    asyncio.run(plugin.delete("d/x"))
+    assert "bkt/d/x" not in s3_env.objects
+    asyncio.run(plugin.delete_dir("d"))
+    assert "bkt/d/y" not in s3_env.objects
+    assert "bkt/keep/z" in s3_env.objects
+    plugin.sync_close()
+
+
+def test_transient_errors_retried(s3_env):
+    plugin = _plugin(root="bkt")
+    s3_env.fail_next = 2  # two 503s, then success
+    plugin.sync_write(WriteIO(path="retry.bin", buf=b"persisted"))
+    assert s3_env.objects["bkt/retry.bin"] == b"persisted"
+    s3_env.fail_next = 2
+    read_io = ReadIO(path="retry.bin")
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == b"persisted"
+    plugin.sync_close()
+
+
+def test_missing_key_raises(s3_env):
+    plugin = _plugin(root="bkt")
+    read_io = ReadIO(path="nope.bin")
+    with pytest.raises(RuntimeError, match="404"):
+        plugin.sync_read(read_io)
+    plugin.sync_close()

@@ -88,6 +88,8 @@ def _is_transient(exc: BaseException) -> bool:
 
 class GCSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
+        import os
+
         # root: "bucket/optional/prefix"
         bucket, _, prefix = root.partition("/")
         self.bucket_name = bucket
@@ -95,6 +97,18 @@ class GCSStoragePlugin(StoragePlugin):
         self._executor: Optional[ThreadPoolExecutor] = None
         self._retry = _SharedDeadlineRetryStrategy()
         self._local = threading.local()
+        # Endpoint override (local fake GCS / emulator): anonymous sessions,
+        # both the resumable-upload and download bases point at it.
+        endpoint = os.environ.get("TPUSNAP_GCS_ENDPOINT")
+        if endpoint:
+            endpoint = endpoint.rstrip("/")
+            self._upload_base = endpoint
+            self._download_base = endpoint
+            self._credentials = None
+            self._tr_requests = None
+            return
+        self._upload_base = "https://www.googleapis.com"
+        self._download_base = "https://storage.googleapis.com"
         try:
             import google.auth
             import google.auth.transport.requests as tr_requests
@@ -111,9 +125,14 @@ class GCSStoragePlugin(StoragePlugin):
     # gcs.py:80-88).
     def _session(self):
         if not hasattr(self._local, "session"):
-            self._local.session = self._tr_requests.AuthorizedSession(
-                self._credentials
-            )
+            if self._credentials is None:
+                import requests
+
+                self._local.session = requests.Session()
+            else:
+                self._local.session = self._tr_requests.AuthorizedSession(
+                    self._credentials
+                )
         return self._local.session
 
     def _get_executor(self) -> ThreadPoolExecutor:
@@ -131,7 +150,7 @@ class GCSStoragePlugin(StoragePlugin):
         from google.resumable_media.requests import ResumableUpload
 
         url = (
-            "https://www.googleapis.com/upload/storage/v1/b/"
+            f"{self._upload_base}/upload/storage/v1/b/"
             f"{self.bucket_name}/o?uploadType=resumable"
         )
         # Runs on the executor: a ScatterBuffer join (slab-sized memcpy)
@@ -171,7 +190,7 @@ class GCSStoragePlugin(StoragePlugin):
         from google.resumable_media.requests import ChunkedDownload
 
         url = (
-            "https://storage.googleapis.com/download/storage/v1/b/"
+            f"{self._download_base}/download/storage/v1/b/"
             f"{self.bucket_name}/o/"
             + self._blob_url(path).replace("/", "%2F")
             + "?alt=media"
@@ -212,7 +231,7 @@ class GCSStoragePlugin(StoragePlugin):
     async def delete(self, path: str) -> None:
         def _delete() -> None:
             url = (
-                f"https://storage.googleapis.com/storage/v1/b/"
+                f"{self._download_base}/storage/v1/b/"
                 f"{self.bucket_name}/o/"
                 + self._blob_url(path).replace("/", "%2F")
             )
@@ -226,7 +245,7 @@ class GCSStoragePlugin(StoragePlugin):
         def _list_and_delete() -> None:
             prefix = self._blob_url(path).rstrip("/") + "/"
             url = (
-                f"https://storage.googleapis.com/storage/v1/b/"
+                f"{self._download_base}/storage/v1/b/"
                 f"{self.bucket_name}/o"
             )
             session = self._session()

@@ -1,38 +1,166 @@
-"""S3 storage plugin (reference torchsnapshot/storage_plugins/s3.py:18-80).
+"""S3 storage plugin — self-contained REST client, no botocore required.
 
-Gated: this environment ships no aiobotocore/botocore.  When boto3/botocore
-is present the plugin works (thread-pooled puts/gets, HTTP Range reads with
-the inclusive-end correction the reference applies at s3.py:60-66, zero-copy
-streaming via MemoryviewStream); otherwise construction raises with a clear
-message.
+Reference analogue: ``torchsnapshot/storage_plugins/s3.py:18-80`` (aiobotocore
+put/get with HTTP Range reads, inclusive-end correction at s3.py:60-66).
+This environment ships no boto3/aiobotocore, so the plugin speaks the S3 REST
+API directly over ``requests`` with SigV4 request signing:
+
+- ``PUT /key`` uploads (unsigned payload hash, so no extra pass over bytes)
+- ``GET /key`` with ``Range: bytes=a-b`` (inclusive end, corrected here the
+  same way the reference does)
+- ``DELETE /key`` and ListObjectsV2 for delete_dir
+- modest retries on 5xx/connection errors
+
+Endpoint resolution: ``TPUSNAP_S3_ENDPOINT`` (e.g. ``http://127.0.0.1:9000``
+for the in-suite fake server or any S3-compatible store; path-style
+``/bucket/key`` addressing), else virtual-host style
+``https://{bucket}.s3.{region}.amazonaws.com``.  Credentials come from the
+standard ``AWS_ACCESS_KEY_ID``/``AWS_SECRET_ACCESS_KEY``/``AWS_SESSION_TOKEN``
+env vars; requests go unsigned when none are set (local fakes don't check).
 """
 
 from __future__ import annotations
 
 import asyncio
+import datetime
+import hashlib
+import hmac
+import os
+import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Dict, Optional
+from xml.etree import ElementTree
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
-from ..memoryview_stream import MemoryviewStream
 
 _IO_THREADS = 16
+_TRANSIENT_STATUS = {429, 500, 502, 503, 504}
+_MAX_ATTEMPTS = 5
+_UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+
+
+def _hmac_sha256(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class _SigV4:
+    """Minimal AWS Signature Version 4 signer for S3 (UNSIGNED-PAYLOAD)."""
+
+    def __init__(
+        self,
+        access_key: str,
+        secret_key: str,
+        session_token: Optional[str],
+        region: str,
+    ) -> None:
+        self._access_key = access_key
+        self._secret_key = secret_key
+        self._session_token = session_token
+        self._region = region
+
+    def sign(self, method: str, url: str, headers: Dict[str, str]) -> None:
+        parsed = urllib.parse.urlsplit(url)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date_stamp = now.strftime("%Y%m%d")
+
+        headers["host"] = parsed.netloc
+        headers["x-amz-date"] = amz_date
+        headers["x-amz-content-sha256"] = _UNSIGNED_PAYLOAD
+        if self._session_token:
+            headers["x-amz-security-token"] = self._session_token
+
+        signed_names = sorted(k.lower() for k in headers)
+        canonical_headers = "".join(
+            f"{name}:{str(headers[_orig(headers, name)]).strip()}\n"
+            for name in signed_names
+        )
+        canonical_query = "&".join(
+            sorted(
+                f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+                for k, v in urllib.parse.parse_qsl(
+                    parsed.query, keep_blank_values=True
+                )
+            )
+        )
+        canonical_request = "\n".join(
+            [
+                method,
+                # The request path is already percent-encoded; S3 is the one
+                # AWS service that forbids double-encoding in the canonical
+                # path, so use it verbatim.
+                parsed.path or "/",
+                canonical_query,
+                canonical_headers,
+                ";".join(signed_names),
+                _UNSIGNED_PAYLOAD,
+            ]
+        )
+        scope = f"{date_stamp}/{self._region}/s3/aws4_request"
+        string_to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical_request.encode()).hexdigest(),
+            ]
+        )
+        key = _hmac_sha256(f"AWS4{self._secret_key}".encode(), date_stamp)
+        key = _hmac_sha256(key, self._region)
+        key = _hmac_sha256(key, "s3")
+        key = _hmac_sha256(key, "aws4_request")
+        signature = hmac.new(key, string_to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self._access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed_names)}, Signature={signature}"
+        )
+
+
+def _orig(headers: Dict[str, str], lower_name: str) -> str:
+    for k in headers:
+        if k.lower() == lower_name:
+            return k
+    raise KeyError(lower_name)
 
 
 class S3StoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
-        try:
-            import boto3  # type: ignore[import-not-found]
-        except ImportError as e:
-            raise RuntimeError(
-                "S3 storage requires boto3/botocore, which is not installed "
-                "in this environment"
-            ) from e
+        import requests
+
+        self._requests = requests
         bucket, _, prefix = root.partition("/")
         self.bucket = bucket
         self.prefix = prefix.strip("/")
-        self._client = boto3.client("s3")
         self._executor: Optional[ThreadPoolExecutor] = None
+        region = os.environ.get(
+            "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
+        )
+        endpoint = os.environ.get("TPUSNAP_S3_ENDPOINT")
+        if endpoint:
+            # Path-style addressing for custom endpoints (fakes, minio).
+            self._base = f"{endpoint.rstrip('/')}/{bucket}"
+        else:
+            self._base = f"https://{bucket}.s3.{region}.amazonaws.com"
+        access_key = os.environ.get("AWS_ACCESS_KEY_ID")
+        secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        self._signer: Optional[_SigV4] = None
+        if access_key and secret_key:
+            self._signer = _SigV4(
+                access_key,
+                secret_key,
+                os.environ.get("AWS_SESSION_TOKEN"),
+                region,
+            )
+        # One session per executor thread: requests.Session is not
+        # thread-safe under concurrent use (same pattern as gcs.py).
+        import threading
+
+        self._local = threading.local()
+
+    def _session(self):
+        if not hasattr(self._local, "session"):
+            self._local.session = self._requests.Session()
+        return self._local.session
 
     def _get_executor(self) -> ThreadPoolExecutor:
         if self._executor is None:
@@ -44,26 +172,72 @@ class S3StoragePlugin(StoragePlugin):
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
 
+    def _url(self, key: str, query: str = "") -> str:
+        url = f"{self._base}/{urllib.parse.quote(key, safe='/')}"
+        return f"{url}?{query}" if query else url
+
+    def _request(self, method: str, url: str, *, data=None, headers=None):
+        import time as _time
+
+        headers = dict(headers or {})
+        last_exc: Optional[BaseException] = None
+        for attempt in range(_MAX_ATTEMPTS):
+            if attempt:
+                _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
+            req_headers = dict(headers)
+            if self._signer is not None:
+                self._signer.sign(method, url, req_headers)
+            try:
+                resp = self._session().request(
+                    method, url, data=data, headers=req_headers, timeout=300
+                )
+            except self._requests.exceptions.ConnectionError as e:
+                last_exc = e
+                continue
+            if resp.status_code in _TRANSIENT_STATUS:
+                last_exc = RuntimeError(
+                    f"S3 transient {resp.status_code}: {resp.text[:200]}"
+                )
+                continue
+            return resp
+        raise RuntimeError(f"S3 request failed after {_MAX_ATTEMPTS} attempts") from (
+            last_exc
+        )
+
+    # ------------------------------------------------------------- plugin API
+
     async def write(self, write_io: WriteIO) -> None:
         def _put() -> None:
-            body = MemoryviewStream(memoryview(contiguous(write_io.buf)))
-            self._client.put_object(
-                Bucket=self.bucket, Key=self._key(write_io.path), Body=body
+            # memoryview body: requests uploads it without copying (the old
+            # MemoryviewStream behavior), and retries re-send the same view.
+            body = memoryview(contiguous(write_io.buf))
+            resp = self._request(
+                "PUT", self._url(self._key(write_io.path)), data=body
             )
+            if resp.status_code not in (200, 201):
+                raise RuntimeError(
+                    f"S3 PUT {write_io.path} failed: {resp.status_code} "
+                    f"{resp.text[:200]}"
+                )
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _put)
 
     async def read(self, read_io: ReadIO) -> None:
         def _get() -> bytearray:
-            kwargs = {}
+            headers = {}
             if read_io.byte_range is not None:
                 start, end = read_io.byte_range
                 # HTTP Range is inclusive on both ends (reference s3.py:60-66)
-                kwargs["Range"] = f"bytes={start}-{end - 1}"
-            resp = self._client.get_object(
-                Bucket=self.bucket, Key=self._key(read_io.path), **kwargs
+                headers["Range"] = f"bytes={start}-{end - 1}"
+            resp = self._request(
+                "GET", self._url(self._key(read_io.path)), headers=headers
             )
-            return bytearray(resp["Body"].read())
+            if resp.status_code not in (200, 206):
+                raise RuntimeError(
+                    f"S3 GET {read_io.path} failed: {resp.status_code} "
+                    f"{resp.text[:200]}"
+                )
+            return bytearray(resp.content)
 
         read_io.buf = await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _get
@@ -71,20 +245,46 @@ class S3StoragePlugin(StoragePlugin):
 
     async def delete(self, path: str) -> None:
         def _delete() -> None:
-            self._client.delete_object(Bucket=self.bucket, Key=self._key(path))
+            resp = self._request("DELETE", self._url(self._key(path)))
+            if resp.status_code not in (200, 204, 404):
+                raise RuntimeError(
+                    f"S3 DELETE {path} failed: {resp.status_code} "
+                    f"{resp.text[:200]}"
+                )
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
     async def delete_dir(self, path: str) -> None:
         def _delete_dir() -> None:
             prefix = self._key(path).rstrip("/") + "/"
-            paginator = self._client.get_paginator("list_objects_v2")
-            for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
-                keys = [{"Key": o["Key"]} for o in page.get("Contents", [])]
-                if keys:
-                    self._client.delete_objects(
-                        Bucket=self.bucket, Delete={"Objects": keys}
+            token: Optional[str] = None
+            while True:
+                query = "list-type=2&prefix=" + urllib.parse.quote(prefix, safe="")
+                if token:
+                    query += "&continuation-token=" + urllib.parse.quote(
+                        token, safe=""
                     )
+                resp = self._request("GET", f"{self._base}?{query}")
+                if resp.status_code != 200:
+                    raise RuntimeError(
+                        f"S3 LIST failed: {resp.status_code} {resp.text[:200]}"
+                    )
+                ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+                tree = ElementTree.fromstring(resp.content)
+                for contents in tree.iter(f"{ns}Contents"):
+                    key = contents.find(f"{ns}Key").text
+                    del_resp = self._request("DELETE", self._url(key))
+                    if del_resp.status_code not in (200, 204, 404):
+                        raise RuntimeError(
+                            f"S3 DELETE {key} failed: {del_resp.status_code}"
+                        )
+                truncated = tree.find(f"{ns}IsTruncated")
+                if truncated is None or truncated.text != "true":
+                    return
+                token_el = tree.find(f"{ns}NextContinuationToken")
+                token = token_el.text if token_el is not None else None
+                if token is None:
+                    return
 
         await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _delete_dir
