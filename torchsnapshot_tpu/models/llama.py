@@ -111,20 +111,36 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
     }
 
 
-def param_partition_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+def param_partition_specs(
+    cfg: LlamaConfig, model_axis_size: Optional[int] = None
+) -> Dict[str, Any]:
     """FSDP+TP sharding rules over axes (data, fsdp, model).
 
     TP shards attention heads / ff; FSDP shards the complementary dim so the
     two compose; norms replicate.  The same pytree-of-specs drives both
     train-state placement and checkpoint metadata.
+
+    Grouped-query exception: when ``n_kv_heads`` does not divide the tensor
+    axis (pass ``model_axis_size`` to enable the check), the KV projections
+    keep their output dim replicated — head-sharding an axis-indivisible KV
+    output forces XLA into involuntary full rematerialization inside
+    attention, and replicating narrow KV heads across tensor ranks is the
+    standard GQA-TP layout.  Callers on a TP mesh must pass the same
+    ``model_axis_size`` everywhere (placement AND any spec-derived
+    metadata): with the default ``None`` the KV output dim stays
+    model-sharded, which disagrees with what ``shard_train_state`` applied
+    on an indivisible mesh.
     """
+    kv_out = "model"
+    if model_axis_size and cfg.n_kv_heads % model_axis_size != 0:
+        kv_out = None
     return {
         "embed": {"tokens": P("model", "fsdp")},
         "layers": {
             "attn": {
                 "wq": P(None, "fsdp", "model"),
-                "wk": P(None, "fsdp", "model"),
-                "wv": P(None, "fsdp", "model"),
+                "wk": P(None, "fsdp", kv_out),
+                "wv": P(None, "fsdp", kv_out),
                 "wo": P(None, "model", "fsdp"),
             },
             "mlp": {
@@ -184,21 +200,44 @@ def _layer_body(
     x: jax.Array,
     layer: Dict[str, Any],
     positions: jax.Array,
+    constrainers=None,
 ) -> jax.Array:
     d = cfg.d_model
+    head_constrain = gather_constrain = None
+    if constrainers is not None:
+        head_constrain, gather_constrain = constrainers
     h = _rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = (h @ layer["attn"]["wq"].astype(cfg.dtype)).reshape(
         *h.shape[:2], cfg.n_heads, cfg.head_dim
     )
-    k = (h @ layer["attn"]["wk"].astype(cfg.dtype)).reshape(
-        *h.shape[:2], cfg.n_kv_heads, cfg.head_dim
-    )
-    v = (h @ layer["attn"]["wv"].astype(cfg.dtype)).reshape(
-        *h.shape[:2], cfg.n_kv_heads, cfg.head_dim
-    )
+    kp = h @ layer["attn"]["wk"].astype(cfg.dtype)
+    vp = h @ layer["attn"]["wv"].astype(cfg.dtype)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    if gather_constrain is not None and n_rep > 1:
+        # Grouped-query KV under sequence+tensor parallelism: n_kv_heads may
+        # not divide the tensor axis, and XLA has no efficient lowering for
+        # an axis-indivisible seq-shard -> head-shard transition across the
+        # 4-D reshape/repeat (involuntary full rematerialization).  Instead,
+        # make the transition on the narrow 3-D projection output as a plain
+        # seq all-gather (the Megatron sequence-parallel recipe); the
+        # reshape, GQA expansion and head slice are then all local.
+        kp = gather_constrain(kp)
+        vp = gather_constrain(vp)
+    k = kp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
+    v = vp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
+    if head_constrain is not None and n_rep > 1:
+        # rope is per-head, so it commutes with the GQA repeat.
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+        n_rep = 1
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v, cfg.n_heads // cfg.n_kv_heads)
+    if head_constrain is not None:
+        # Single constraint point per tensor: all three enter attention
+        # head-sharded (a seq-sharded v against head-sharded q/k would
+        # reintroduce the indivisible transition inside the einsum).
+        q, k, v = head_constrain(q), head_constrain(k), head_constrain(v)
+    attn = _attention(q, k, v, n_rep)
     attn = attn.reshape(*h.shape[:2], d)
     x = x + attn @ layer["attn"]["wo"].astype(cfg.dtype)
 
@@ -226,6 +265,23 @@ def forward(
             )
         return x
 
+    # Sequence parallelism reuses the tensor axis for the seq dim between
+    # blocks; inside attention the same axis must shard heads instead.  Make
+    # that transition explicit on the [B, S, H, Dh] tensors so XLA routes it
+    # as a collective rather than an involuntary full rematerialization.
+    constrainers = None
+    if activation_spec is not None and len(activation_spec) >= 2:
+        head_spec = P(activation_spec[0], None, activation_spec[1], None)
+        gather_spec = P(activation_spec[0], None, None)
+
+        def _to_heads(t: jax.Array) -> jax.Array:
+            return jax.lax.with_sharding_constraint(t, head_spec)
+
+        def _gather_seq(t: jax.Array) -> jax.Array:
+            return jax.lax.with_sharding_constraint(t, gather_spec)
+
+        constrainers = (_to_heads, _gather_seq)
+
     x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
     x = constrain(x)
     positions = jnp.broadcast_to(
@@ -233,7 +289,7 @@ def forward(
     )
 
     def scan_body(carry: jax.Array, layer: Dict[str, Any]):
-        y = _layer_body(cfg, carry, layer, positions)
+        y = _layer_body(cfg, carry, layer, positions, constrainers)
         return constrain(y), None
 
     x, _ = jax.lax.scan(
@@ -289,7 +345,9 @@ def shard_train_state(
 ) -> Dict[str, Any]:
     """Place an (unsharded) train state onto the mesh per the partition
     rules; optimizer moments inherit their param's spec."""
-    specs = state_partition_specs(train_state, cfg)
+    specs = state_partition_specs(
+        train_state, cfg, model_axis_size=mesh.shape.get("model")
+    )
     shardings = jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec), specs,
         is_leaf=lambda x: isinstance(x, P),
@@ -297,7 +355,11 @@ def shard_train_state(
     return jax.device_put(train_state, shardings)
 
 
-def state_partition_specs(train_state: Dict[str, Any], cfg: LlamaConfig):
+def state_partition_specs(
+    train_state: Dict[str, Any],
+    cfg: LlamaConfig,
+    model_axis_size: Optional[int] = None,
+):
     """PartitionSpec pytree matching a {params, opt_state, step} train state.
 
     Optimizer moments structurally embed the param tree (optax's Adam state
@@ -305,7 +367,7 @@ def state_partition_specs(train_state: Dict[str, Any], cfg: LlamaConfig):
     of the param whose tree path is a suffix of its own path; everything else
     (counts, scalars) replicates.
     """
-    param_specs = param_partition_specs(cfg)
+    param_specs = param_partition_specs(cfg, model_axis_size=model_axis_size)
 
     spec_by_path = {
         _path_str(path): spec

@@ -191,7 +191,11 @@ class S3StoragePlugin(StoragePlugin):
                 resp = self._session().request(
                     method, url, data=data, headers=req_headers, timeout=300
                 )
-            except self._requests.exceptions.ConnectionError as e:
+            except (
+                self._requests.exceptions.ConnectionError,
+                self._requests.exceptions.Timeout,
+                self._requests.exceptions.ChunkedEncodingError,
+            ) as e:
                 last_exc = e
                 continue
             if resp.status_code in _TRANSIENT_STATUS:
@@ -237,6 +241,17 @@ class S3StoragePlugin(StoragePlugin):
                     f"S3 GET {read_io.path} failed: {resp.status_code} "
                     f"{resp.text[:200]}"
                 )
+            if read_io.byte_range is not None:
+                expected = read_io.byte_range[1] - read_io.byte_range[0]
+                if len(resp.content) != expected:
+                    # A server legally may ignore Range and return 200 with
+                    # the full object — that must not masquerade as the
+                    # requested slice.
+                    raise RuntimeError(
+                        f"S3 ranged GET {read_io.path} returned "
+                        f"{len(resp.content)} bytes, expected {expected} "
+                        f"(status {resp.status_code})"
+                    )
             return bytearray(resp.content)
 
         read_io.buf = await asyncio.get_running_loop().run_in_executor(
@@ -271,13 +286,23 @@ class S3StoragePlugin(StoragePlugin):
                     )
                 ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
                 tree = ElementTree.fromstring(resp.content)
-                for contents in tree.iter(f"{ns}Contents"):
-                    key = contents.find(f"{ns}Key").text
+                keys = [c.find(f"{ns}Key").text for c in tree.iter(f"{ns}Contents")]
+
+                def _del_one(key: str) -> None:
                     del_resp = self._request("DELETE", self._url(key))
                     if del_resp.status_code not in (200, 204, 404):
                         raise RuntimeError(
                             f"S3 DELETE {key} failed: {del_resp.status_code}"
                         )
+
+                # Fan the per-key DELETEs across the I/O pool: one serial
+                # signed round-trip per object would scale delete_dir
+                # linearly with snapshot size.
+                futures = [
+                    self._get_executor().submit(_del_one, key) for key in keys
+                ]
+                for fut in futures:
+                    fut.result()
                 truncated = tree.find(f"{ns}IsTruncated")
                 if truncated is None or truncated.text != "true":
                     return
