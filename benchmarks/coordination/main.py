@@ -1,0 +1,187 @@
+"""Coordination-layer scale stress: manifest gather at simulated large world.
+
+SURVEY.md §7 flags the reference's all_gather_object of full manifests as
+O(world^2) bytes at 4k ranks (reference snapshot.py:948-959); this repo's
+answer is gather-to-root over the KV store + one broadcast (O(world)).
+This driver pushes 256-1024 simulated ranks' ~0.3 MB pickled manifests
+(hundreds of MB aggregate) through that path against the real C++ TCP store
+and records wall time, store op counts, and coordinator memory.
+
+Ranks are simulated on a worker pool (a laptop cannot host 1024 live
+processes); the phases are ordered so no worker ever blocks on a peer that
+has not run yet:
+  1. every rank's gather-side set() (root's blocking gets overlap)
+  2. root unpickles all manifests, consolidates, broadcasts
+  3. every rank reads the broadcast
+  4. barrier: all arrives, then all sentinel gets; rank 0 sweeps
+
+Usage: python benchmarks/coordination/main.py [--worlds 256,1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+
+def make_manifest(rank: int, n_entries: int = 1500) -> dict:
+    """A realistic per-rank manifest: ~1500 entries with shard metadata
+    (~0.3 MB pickled)."""
+    return {
+        f"{rank}/model/layers/{i}/weight": {
+            "type": "sharded_array",
+            "dtype": "bfloat16",
+            "shape": [8192, 1024],
+            "location": f"sharded/model.layers.{i}.weight_{rank}",
+            "byte_range": [0, 16777216],
+            "offsets": [rank * 64, 0],
+            "sizes": [64, 1024],
+            "checksum": f"xxh64:{rank:016x}",
+            "mesh": [[0, 1, 2, 3], [4, 5, 6, 7]],
+            "spec": [["data"], ["model"]],
+        }
+        for i in range(n_entries)
+    }
+
+
+def run_world(world_size: int, workers: int = 64) -> dict:
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+
+    # One shared client: it pools one connection per concurrent op, so this
+    # stays at O(workers) sockets — the same socket count N real ranks with
+    # one connection each would put on the coordinator.
+    store = TCPStore("127.0.0.1", server.port)
+    pgs = [
+        PGWrapper(store=store, rank=r, world_size=world_size, timeout_s=600)
+        for r in range(world_size)
+    ]
+    # Manifests built before the clock: rank-side dict construction is not
+    # coordination cost.  (Pickling stays inside — it is part of the
+    # collective's API cost on a real rank.)
+    manifests = [make_manifest(r) for r in range(world_size)]
+    manifest_bytes = len(pickle.dumps(manifests[0]))
+
+    pool = ThreadPoolExecutor(max_workers=workers)
+
+    # Store-only baseline: pre-pickled blobs, raw set + sequential root gets
+    # — the wire/store ceiling with zero Python serialization in the loop.
+    blobs = [pickle.dumps(m) for m in manifests]
+    t0 = time.monotonic()
+    for f in [
+        pool.submit(store.set, f"raw/{r}", blobs[r]) for r in range(world_size)
+    ]:
+        f.result()
+    for r in range(world_size):
+        store.get(f"raw/{r}", timeout_s=60)
+    store_only_s = time.monotonic() - t0
+    store.delete_prefix("raw/")
+    del blobs
+
+    # Coordinator memory, measured from AFTER the simulated rank-side data
+    # exists (real ranks hold their own manifests on their own hosts) with
+    # the repo's background RSS sampler so transients during root's
+    # unpickling are captured.
+    from torchsnapshot_tpu.rss_profiler import measure_rss_deltas
+
+    rss_deltas: list = []
+    with measure_rss_deltas(rss_deltas):
+        begin = time.monotonic()
+
+        # Phase 1+2: gather to root. Root's blocking gets run concurrently
+        # with the other ranks' sets.
+        root_fut = pool.submit(pgs[0].gather_object_root, manifests[0])
+        futs = [
+            pool.submit(pgs[r].gather_object_root, manifests[r])
+            for r in range(1, world_size)
+        ]
+        for f in futs:
+            f.result()
+        gathered = root_fut.result()
+        gather_s = time.monotonic() - begin
+    assert gathered is not None and len(gathered) == world_size
+    rss_peak_delta = max(rss_deltas, default=0)
+
+    # Phase 3: broadcast a consolidated result (per-rank write plan sizes).
+    # Root publishes synchronously first so no pooled reader can starve it.
+    t0 = time.monotonic()
+    plan = {r: len(gathered[r]) for r in range(world_size)}
+    pgs[0].broadcast_object_list([plan], 0)
+    futs = [
+        pool.submit(pgs[r].broadcast_object_list, [None], 0)
+        for r in range(1, world_size)
+    ]
+    for f in futs:
+        f.result()
+    broadcast_s = time.monotonic() - t0
+
+    # Phase 4: barrier traffic, phased so a worker pool smaller than the
+    # world cannot deadlock (a real deployment has one live process per
+    # rank; here 64 workers simulate 1024 ranks, so all arrivals must land
+    # before any sentinel wait is scheduled).  Op sequence per rank is
+    # identical to PGWrapper.barrier: one add + one blocking get.
+    t0 = time.monotonic()
+
+    def _arrive(r: int) -> None:
+        if store.add("bb/arrived", 1) >= world_size:
+            store.set("bb/go", b"1")
+
+    for f in [pool.submit(_arrive, r) for r in range(world_size)]:
+        f.result()
+    for f in [
+        pool.submit(store.get, "bb/go", 60.0) for _ in range(world_size)
+    ]:
+        f.result()
+    barrier_s = time.monotonic() - t0
+    total_s = time.monotonic() - begin
+
+    # Sweep: what rank 0 deletes once a barrier proves the generation dead.
+    t0 = time.monotonic()
+    swept = (
+        store.delete_prefix("pg/gather/1/")
+        + store.delete_prefix("pg/broadcast/2/")
+        + store.delete_prefix("bb/")
+    )
+    sweep_s = time.monotonic() - t0
+    leftover = store.delete_prefix("pg/")
+    pool.shutdown()
+    store.close()
+    server.stop()
+
+    return {
+        "world_size": world_size,
+        "manifest_mb_per_rank": round(manifest_bytes / 1e6, 2),
+        "total_gathered_mb": round(manifest_bytes * world_size / 1e6, 1),
+        "gather_s": round(gather_s, 2),
+        "broadcast_s": round(broadcast_s, 2),
+        "barrier_s": round(barrier_s, 2),
+        "total_s": round(total_s, 2),
+        "gather_mb_per_s": round(manifest_bytes * world_size / 1e6 / gather_s, 1),
+        "store_only_s": round(store_only_s, 2),
+        "store_only_mb_per_s": round(
+            2 * manifest_bytes * world_size / 1e6 / store_only_s, 1
+        ),
+        "coordinator_rss_peak_delta_mb": round(rss_peak_delta / 1e6, 1),
+        "swept_keys": swept,
+        "sweep_s": round(sweep_s, 3),
+        "store_keys_after_sweep": leftover,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--worlds", default="256,1024")
+    args = parser.parse_args()
+    for world in (int(w) for w in args.worlds.split(",")):
+        result = run_world(world)
+        print(result, flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
