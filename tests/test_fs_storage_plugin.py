@@ -51,3 +51,68 @@ def test_fs_sync_wrappers(tmp_path):
     plugin.sync_read(read_io)
     assert bytes(read_io.buf) == b"sync"
     plugin.sync_close()
+
+
+def test_parallel_into_reads_saturating_io_pool(tmp_path, monkeypatch):
+    """Pool-width concurrent into-place reads, each large enough to split
+    into parallel chunks, must complete (regression: chunk reads submitted
+    to the pool their parents occupy deadlocked once every fs_io thread
+    held a parent read)."""
+    import asyncio
+
+    import numpy as np
+
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    monkeypatch.setattr(fs_mod, "_PARALLEL_READ_MIN_BYTES", 1024)
+    monkeypatch.setattr(fs_mod, "_PARALLEL_READ_CHUNK", 512)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    n = fs_mod._DEFAULT_IO_THREADS + 4
+    payloads = {
+        f"p{i}.bin": np.random.randint(0, 255, 8192, dtype=np.uint8).tobytes()
+        for i in range(n)
+    }
+    targets = {name: bytearray(8192) for name in payloads}
+
+    async def go():
+        await asyncio.gather(
+            *(
+                plugin.write(WriteIO(path=name, buf=data))
+                for name, data in payloads.items()
+            )
+        )
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(
+                    plugin.read(
+                        ReadIO(path=name, into=memoryview(targets[name]))
+                    )
+                    for name in payloads
+                )
+            ),
+            timeout=60,
+        )
+        await plugin.close()
+
+    asyncio.run(go())
+    for name, data in payloads.items():
+        assert bytes(targets[name]) == data
+
+
+def test_parallel_into_read_range_mismatch_raises(tmp_path, monkeypatch):
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    monkeypatch.setattr(fs_mod, "_PARALLEL_READ_MIN_BYTES", 1024)
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    plugin.sync_write(WriteIO(path="m.bin", buf=b"x" * 8192))
+    import pytest
+
+    with pytest.raises(ValueError, match="into-view"):
+        plugin.sync_read(
+            ReadIO(
+                path="m.bin",
+                byte_range=[0, 4096],
+                into=memoryview(bytearray(8192)),
+            )
+        )
+    plugin.sync_close()
