@@ -306,7 +306,9 @@ def _device_put_like(host: np.ndarray, like: Any) -> Any:
 
     if host.dtype != np.dtype(like.dtype):
         host = host.astype(np.dtype(like.dtype))
-    with phase_stats.timed("h2d", host.nbytes):
+    # Dispatch time only — the transfer itself is async (see
+    # staging.device_put_fast_batch for the rationale).
+    with phase_stats.timed("h2d_dispatch"):
         try:
             devices = like.sharding.device_set
             memory_kind = getattr(like.sharding, "memory_kind", None)

@@ -43,7 +43,8 @@ from ..io_types import (
     WriteReq,
 )
 from ..manifest import Shard, ShardedArrayEntry, TensorEntry
-from .array import ArrayBufferStager, ArrayIOPreparer
+from ..serialization import Serializer
+from .array import ArrayBufferStager, ArrayIOPreparer, _INTO_PLACE_MIN_BYTES
 
 
 def _subdivide(
@@ -191,6 +192,7 @@ class ShardedArrayIOPreparer:
             if not scatter:
                 continue
             n_pieces += 1
+            into = cls._into_view(restore, shard, scatter)
             read_reqs.append(
                 ReadReq(
                     path=shard.tensor.location,
@@ -201,11 +203,47 @@ class ShardedArrayIOPreparer:
                         piece_offsets=list(shard.offsets),
                         piece_sizes=list(shard.sizes),
                         scatter=scatter,
+                        into=into,
                     ),
+                    into=into,
                 )
             )
         restore.expect(n_pieces)
         return read_reqs, restore.fut
+
+    @staticmethod
+    def _into_view(
+        restore: "_ShardedRestore", shard: Shard, scatter
+    ) -> Optional[memoryview]:
+        """Read-into-place for the common resume-same-topology case: a saved
+        piece that lands whole into one contiguous region of one target
+        buffer (exact shard match, or a dim-0 subdivision of it) is read by
+        storage directly into that memory — no deserialize, no scatter copy.
+        Resharding restores (partial overlaps, multiple targets) keep the
+        general scatter path."""
+        if len(scatter) != 1:
+            return None
+        if shard.tensor.serializer != Serializer.BUFFER_PROTOCOL.value:
+            return None
+        nbytes = serialization.array_nbytes(
+            list(shard.sizes), shard.tensor.dtype
+        )
+        if nbytes < _INTO_PLACE_MIN_BYTES:
+            return None
+        t_off, src_view, dst_view = scatter[0]
+        if any(
+            s.start != 0 or s.stop != sz
+            for s, sz in zip(src_view, shard.sizes)
+        ):
+            return None  # piece only partially consumed
+        target = restore.buffer(t_off)
+        dst = target[dst_view]
+        if not dst.flags.c_contiguous or dst.nbytes != nbytes:
+            return None
+        try:
+            return memoryview(dst).cast("B")
+        except (TypeError, ValueError):
+            return None
 
 
 class _ShardedRestore:
@@ -326,26 +364,38 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         piece_offsets: List[int],
         piece_sizes: List[int],
         scatter: List[Tuple[Tuple[int, ...], Tuple[slice, ...], Tuple[slice, ...]]],
+        into: Optional[memoryview] = None,
     ) -> None:
         self._restore = restore
         self._piece_entry = piece_entry
         self._piece_offsets = piece_offsets
         self._piece_sizes = piece_sizes
         self._scatter = scatter
+        self._into = into
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
+        in_place = self._into is not None and buf is self._into
+
         def _work() -> None:
-            from .. import integrity
+            from .. import integrity, phase_stats
 
             integrity.verify(buf, self._piece_entry.checksum, self._piece_entry.location)
+            if in_place:
+                return  # storage already read the bytes into the target
             piece = serialization.array_from_memoryview(
                 memoryview(buf), self._piece_entry.dtype, self._piece_sizes
             )
-            for t_off, src_view, dst_view in self._scatter:
-                target = self._restore.buffer(t_off)
-                target[dst_view] = piece[src_view]
+            with phase_stats.timed(
+                "scatter_copy",
+                serialization.array_nbytes(
+                    self._piece_sizes, self._piece_entry.dtype
+                ),
+            ):
+                for t_off, src_view, dst_view in self._scatter:
+                    target = self._restore.buffer(t_off)
+                    target[dst_view] = piece[src_view]
 
         nbytes = serialization.array_nbytes(self._piece_sizes, self._piece_entry.dtype)
         if executor is not None and nbytes > 1 << 20:

@@ -30,7 +30,12 @@ class FSStoragePlugin(StoragePlugin):
         self.root = root
         self._dir_cache: Set[str] = set()
         self._executor: Optional[ThreadPoolExecutor] = None
-        self._chunk_executor: Optional[ThreadPoolExecutor] = None
+        # Built eagerly: the getter runs concurrently on fs_io worker
+        # threads, where lazy init would race and leak a pool.  Construction
+        # is cheap — ThreadPoolExecutor spawns threads on first submit.
+        self._chunk_executor: ThreadPoolExecutor = ThreadPoolExecutor(
+            max_workers=_PARALLEL_READ_MAX_WAYS, thread_name_prefix="fs_chunk"
+        )
         try:
             from ..native_io import NativeFileIO
 
@@ -50,11 +55,6 @@ class FSStoragePlugin(StoragePlugin):
         # an fs_io thread and blocks on its chunks, so submitting chunks to
         # the same pool deadlocks once every fs_io thread holds a parent
         # read (16 concurrent reads is exactly the scheduler's default cap).
-        if self._chunk_executor is None:
-            self._chunk_executor = ThreadPoolExecutor(
-                max_workers=_PARALLEL_READ_MAX_WAYS,
-                thread_name_prefix="fs_chunk",
-            )
         return self._chunk_executor
 
     def _prepare_parent(self, path: str) -> None:
@@ -210,6 +210,4 @@ class FSStoragePlugin(StoragePlugin):
         if self._executor is not None:
             self._executor.shutdown()
             self._executor = None
-        if self._chunk_executor is not None:
-            self._chunk_executor.shutdown()
-            self._chunk_executor = None
+        self._chunk_executor.shutdown()
