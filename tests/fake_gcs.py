@@ -152,6 +152,21 @@ class FakeGCSServer:
                 m = re.match(r"/storage/v1/b/([^/]+)/o$", split.path)
                 if m:
                     return self._do_list(m.group(1), query)
+                m = re.match(r"/storage/v1/b/([^/]+)/o/(.+)", split.path)
+                if m:
+                    # Object metadata GET (no alt=media): existence probe.
+                    bucket = m.group(1)
+                    name = urllib.parse.unquote(m.group(2))
+                    with outer._lock:
+                        data = outer.objects.get(f"{bucket}/{name}")
+                    if data is None:
+                        return self._reply(404)
+                    body = json.dumps(
+                        {"name": name, "size": str(len(data))}
+                    ).encode()
+                    return self._reply(
+                        200, body, {"Content-Type": "application/json"}
+                    )
                 self._reply(404)
 
             def _do_download(self, m):
@@ -178,6 +193,7 @@ class FakeGCSServer:
 
             def _do_list(self, bucket, query):
                 prefix = query.get("prefix", [""])[0]
+                delimiter = query.get("delimiter", [None])[0]
                 with outer._lock:
                     names = sorted(
                         k[len(bucket) + 1 :]
@@ -185,7 +201,22 @@ class FakeGCSServer:
                         if k.startswith(f"{bucket}/")
                         and k[len(bucket) + 1 :].startswith(prefix)
                     )
-                body = json.dumps({"items": [{"name": n} for n in names]}).encode()
+                prefixes = set()
+                if delimiter:
+                    rolled = []
+                    for n in names:
+                        rest = n[len(prefix):]
+                        if delimiter in rest:
+                            prefixes.add(
+                                prefix + rest.split(delimiter, 1)[0] + delimiter
+                            )
+                        else:
+                            rolled.append(n)
+                    names = rolled
+                payload = {"items": [{"name": n} for n in names]}
+                if prefixes:
+                    payload["prefixes"] = sorted(prefixes)
+                body = json.dumps(payload).encode()
                 self._reply(200, body, {"Content-Type": "application/json"})
 
             def do_DELETE(self):

@@ -105,6 +105,7 @@ class FakeS3Server:
             def _do_list(self, split, query):
                 bucket = split.path.strip("/")
                 prefix = query.get("prefix", [""])[0]
+                delimiter = query.get("delimiter", [None])[0]
                 with outer._lock:
                     keys = sorted(
                         k[len(bucket) + 1 :]
@@ -112,20 +113,47 @@ class FakeS3Server:
                         if k.startswith(f"{bucket}/")
                         and k[len(bucket) + 1 :].startswith(prefix)
                     )
+                common = set()
+                if delimiter:
+                    rolled = []
+                    for k in keys:
+                        rest = k[len(prefix):]
+                        if delimiter in rest:
+                            common.add(
+                                prefix + rest.split(delimiter, 1)[0] + delimiter
+                            )
+                        else:
+                            rolled.append(k)
+                    keys = rolled
                 items = "".join(
                     f"<Contents><Key>{escape(k)}</Key></Contents>" for k in keys
+                )
+                prefixes = "".join(
+                    f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
+                    "</CommonPrefixes>"
+                    for p in sorted(common)
                 )
                 body = (
                     '<?xml version="1.0" encoding="UTF-8"?>'
                     '<ListBucketResult xmlns='
                     '"http://s3.amazonaws.com/doc/2006-03-01/">'
-                    f"{items}<IsTruncated>false</IsTruncated></ListBucketResult>"
+                    f"{items}{prefixes}"
+                    "<IsTruncated>false</IsTruncated></ListBucketResult>"
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/xml")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_HEAD(self):
+                if self._maybe_fail():
+                    return
+                with outer._lock:
+                    found = self._obj_key() in outer.objects
+                self.send_response(200 if found else 404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def do_DELETE(self):
                 if self._maybe_fail():

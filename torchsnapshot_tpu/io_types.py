@@ -156,6 +156,29 @@ class StoragePlugin(abc.ABC):
     async def close(self) -> None:
         ...
 
+    async def list_dir(self, path: str) -> List[str]:
+        """Immediate child names under ``path`` (files and directory-like
+        prefixes, no trailing slash).  Lets SnapshotManager enumerate
+        committed steps on any backend; raises NotImplementedError where the
+        backend genuinely cannot list."""
+        raise NotImplementedError(f"{type(self).__name__} cannot list")
+
+    async def exists(self, path: str) -> bool:
+        """Whether ``path`` holds a readable object.  Default probes with a
+        read (commit-marker files are small); backends override with a
+        cheaper stat/HEAD where available."""
+        read_io = ReadIO(path=path)
+        try:
+            await self.read(read_io)
+            return True
+        except (FileNotFoundError, KeyError):
+            return False
+        except Exception as e:  # noqa: BLE001 - backend-specific not-found
+            msg = str(e)
+            if "404" in msg or "NoSuchKey" in msg or "Not Found" in msg:
+                return False
+            raise
+
     # Sync conveniences (reference io_types.py:101-120); run a private loop so
     # they are safe to call from any thread.
     def sync_write(self, write_io: WriteIO) -> None:
@@ -163,6 +186,12 @@ class StoragePlugin(abc.ABC):
 
     def sync_read(self, read_io: ReadIO) -> None:
         asyncio.run(self.read(read_io))
+
+    def sync_list_dir(self, path: str) -> List[str]:
+        return asyncio.run(self.list_dir(path))
+
+    def sync_exists(self, path: str) -> bool:
+        return asyncio.run(self.exists(path))
 
     def sync_close(self) -> None:
         asyncio.run(self.close())

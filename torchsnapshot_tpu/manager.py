@@ -45,41 +45,30 @@ class SnapshotManager:
     def path_for_step(self, step: int) -> str:
         return f"{self.root}/step_{step}"
 
-    def _is_committed(self, step: int) -> bool:
-        """Metadata-file existence is the commit signal.  Only runs on fs
-        roots (all_steps gates); a FileNotFoundError means torn/absent, any
-        other error (permissions, transport) propagates rather than silently
-        classifying a committed snapshot as torn."""
-        import os
+    def _is_committed(self, storage, step: int) -> bool:
+        """Metadata-file existence is the commit signal.  A missing file
+        means torn/absent; transport/permission errors propagate rather than
+        silently classifying a committed snapshot as torn."""
+        return storage.sync_exists(f"step_{step}/{SNAPSHOT_METADATA_FNAME}")
 
-        root = self.root.split("://", 1)[-1]
+    def all_steps(self, storage=None) -> List[int]:
+        """Committed steps, ascending, on any listable backend (fs, memory,
+        s3, gs — via each plugin's list_dir).  Pass ``storage`` to reuse an
+        open plugin (avoids building a thread pool + sessions per call)."""
+        own = storage is None
+        if own:
+            storage = url_to_storage_plugin(self.root)
         try:
-            os.stat(os.path.join(root, f"step_{step}", SNAPSHOT_METADATA_FNAME))
-            return True
-        except FileNotFoundError:
-            return False
-
-    def all_steps(self) -> List[int]:
-        """Committed steps, ascending.  Requires a listable backend (fs); for
-        object stores, track steps externally or use latest_step files."""
-        import os
-
-        if "://" in self.root and not self.root.startswith("fs://"):
-            raise NotImplementedError(
-                "all_steps() requires a filesystem root; object-store layouts "
-                "should track steps externally"
-            )
-        root = self.root.split("://", 1)[-1]
-        steps = []
-        try:
-            names = os.listdir(root)
-        except FileNotFoundError:
-            return []
-        for name in names:
-            m = _STEP_RE.match(name)
-            if m and self._is_committed(int(m.group(1))):
-                steps.append(int(m.group(1)))
-        return sorted(steps)
+            names = storage.sync_list_dir("")
+            steps = []
+            for name in names:
+                m = _STEP_RE.match(name)
+                if m and self._is_committed(storage, int(m.group(1))):
+                    steps.append(int(m.group(1)))
+            return sorted(steps)
+        finally:
+            if own:
+                storage.sync_close()
 
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
@@ -100,13 +89,15 @@ class SnapshotManager:
         path = self.path_for_step(step)
         base: Optional[str] = None
         if incremental:
-            try:
-                latest = self.latest_step()
-            except NotImplementedError:
+            # Hard-link reuse needs a posix filesystem; other backends save
+            # in full (retention/listing still work everywhere).
+            if "://" in self.root and not self.root.startswith("fs://"):
                 logger.warning(
-                    "incremental save ignored: backend is not listable"
+                    "incremental save ignored: hard links need an fs root"
                 )
                 latest = None
+            else:
+                latest = self.latest_step()
             if latest is not None and latest != step:
                 base = self.path_for_step(latest)
         if async_:
@@ -157,19 +148,22 @@ class SnapshotManager:
         self._pg.barrier()
         try:
             if self._pg.get_rank() == 0:
-                committed = [s for s in self.all_steps() if s != exclude_step]
-                budget = self.max_to_keep - (1 if include_current else 0)
-                excess = len(committed) - budget
-                if excess > 0:
-                    import asyncio
+                import asyncio
 
-                    storage = url_to_storage_plugin(self.root)
-                    try:
-                        for step in committed[:excess]:
-                            logger.info("Pruning snapshot step_%d", step)
-                            asyncio.run(storage.delete_dir(f"step_{step}"))
-                    finally:
-                        storage.sync_close()
+                storage = url_to_storage_plugin(self.root)
+                try:
+                    committed = [
+                        s
+                        for s in self.all_steps(storage=storage)
+                        if s != exclude_step
+                    ]
+                    budget = self.max_to_keep - (1 if include_current else 0)
+                    excess = len(committed) - budget
+                    for step in committed[: max(excess, 0)]:
+                        logger.info("Pruning snapshot step_%d", step)
+                        asyncio.run(storage.delete_dir(f"step_{step}"))
+                finally:
+                    storage.sync_close()
         except NotImplementedError:
             logger.warning("Retention skipped: backend is not listable")
         except Exception:

@@ -198,6 +198,22 @@ class FSStoragePlugin(StoragePlugin):
             read_io.into,
         )
 
+    async def list_dir(self, path: str) -> list:
+        try:
+            return sorted(os.listdir(os.path.join(self.root, path)))
+        except FileNotFoundError:
+            return []
+
+    async def exists(self, path: str) -> bool:
+        # os.stat, not os.path.exists: permission/transport errors must
+        # propagate — classifying an unreadable committed snapshot as torn
+        # would let retention prune valid restore points.
+        try:
+            os.stat(os.path.join(self.root, path))
+            return True
+        except (FileNotFoundError, NotADirectoryError):
+            return False
+
     async def delete(self, path: str) -> None:
         os.unlink(os.path.join(self.root, path))
 

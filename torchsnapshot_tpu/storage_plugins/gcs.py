@@ -241,6 +241,66 @@ class GCSStoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
+    def _get_with_retry(self, url: str, params: dict):
+        """Transient-retried GET, same policy as the data-plane ops (a list
+        that fails a training resume on one 503 would be the only
+        non-retried op in the module)."""
+        session = self._session()
+        while True:
+            try:
+                resp = session.get(url, params=params)
+                if resp.status_code == 404:
+                    return resp
+                resp.raise_for_status()
+                self._retry.report_progress()
+                return resp
+            except Exception as e:  # noqa: BLE001
+                if not _is_transient(e):
+                    raise
+                self._retry.check_and_backoff(e)
+
+    async def list_dir(self, path: str) -> list:
+        def _list() -> list:
+            prefix = self._blob_url(path).rstrip("/")
+            prefix = f"{prefix}/" if prefix else ""
+            url = f"{self._download_base}/storage/v1/b/{self.bucket_name}/o"
+            children = set()
+            page_token = None
+            while True:
+                params = {"prefix": prefix, "delimiter": "/"}
+                if page_token:
+                    params["pageToken"] = page_token
+                resp = self._get_with_retry(url, params)
+                resp.raise_for_status()
+                data = resp.json()
+                for item in data.get("items", []):
+                    children.add(item["name"][len(prefix):])
+                for p in data.get("prefixes", []):
+                    children.add(p[len(prefix):].rstrip("/"))
+                page_token = data.get("nextPageToken")
+                if not page_token:
+                    break
+            return sorted(c for c in children if c)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _list
+        )
+
+    async def exists(self, path: str) -> bool:
+        def _probe() -> bool:
+            # Metadata GET (no alt=media): one cheap round-trip instead of
+            # downloading the object.
+            url = (
+                f"{self._download_base}/storage/v1/b/{self.bucket_name}/o/"
+                + self._blob_url(path).replace("/", "%2F")
+            )
+            resp = self._get_with_retry(url, {})
+            return resp.status_code == 200
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _probe
+        )
+
     async def delete_dir(self, path: str) -> None:
         def _list_and_delete() -> None:
             prefix = self._blob_url(path).rstrip("/") + "/"

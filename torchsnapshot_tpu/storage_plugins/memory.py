@@ -37,15 +37,53 @@ class MemoryStoragePlugin(StoragePlugin):
             data = data[offset:end]
         read_io.buf = bytearray(data)
 
+    # The registry namespaces by plugin root, so a Snapshot taken at
+    # "memory://root/step_1" lives in the sibling registry "root/step_1",
+    # not under this plugin's keys.  list/exists/delete_dir therefore also
+    # look through nested registries — that is what lets SnapshotManager
+    # enumerate and prune steps on this backend.
+
+    async def list_dir(self, path: str) -> list:
+        prefix = path.rstrip("/") + "/" if path else ""
+        base = f"{self.root}/{path}".rstrip("/")
+        children = set()
+        with _LOCK:
+            for key in self._files:
+                if key.startswith(prefix):
+                    children.add(key[len(prefix):].split("/", 1)[0])
+            for reg_root in _REGISTRY:
+                if reg_root.startswith(base + "/"):
+                    children.add(reg_root[len(base) + 1 :].split("/", 1)[0])
+        return sorted(c for c in children if c)
+
+    async def exists(self, path: str) -> bool:
+        full = f"{self.root}/{path}"
+        with _LOCK:
+            if path in self._files:
+                return True
+            for reg_root, files in _REGISTRY.items():
+                if full.startswith(reg_root + "/") and (
+                    full[len(reg_root) + 1 :] in files
+                ):
+                    return True
+        return False
+
     async def delete(self, path: str) -> None:
         with _LOCK:
             self._files.pop(path, None)
 
     async def delete_dir(self, path: str) -> None:
         prefix = path.rstrip("/") + "/"
+        full = f"{self.root}/{path}".rstrip("/")
         with _LOCK:
             for k in [k for k in self._files if k.startswith(prefix)]:
                 del self._files[k]
+            for reg_root in [
+                r
+                for r in _REGISTRY
+                if r == full or r.startswith(full + "/")
+            ]:
+                _REGISTRY.pop(reg_root)
 
     async def close(self) -> None:
         pass

@@ -269,6 +269,65 @@ class S3StoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
+    async def exists(self, path: str) -> bool:
+        def _head() -> bool:
+            # HEAD: one cheap round-trip instead of downloading the object.
+            resp = self._request("HEAD", self._url(self._key(path)))
+            if resp.status_code == 200:
+                return True
+            if resp.status_code == 404:
+                return False
+            raise RuntimeError(
+                f"S3 HEAD {path} failed: {resp.status_code}"
+            )
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _head
+        )
+
+    async def list_dir(self, path: str) -> list:
+        def _list() -> list:
+            prefix = self._key(path).rstrip("/")
+            prefix = f"{prefix}/" if prefix else ""
+            children = set()
+            token = None
+            while True:
+                query = (
+                    "list-type=2&delimiter=%2F&prefix="
+                    + urllib.parse.quote(prefix, safe="")
+                )
+                if token:
+                    query += "&continuation-token=" + urllib.parse.quote(
+                        token, safe=""
+                    )
+                resp = self._request("GET", f"{self._base}?{query}")
+                if resp.status_code != 200:
+                    raise RuntimeError(
+                        f"S3 LIST failed: {resp.status_code} {resp.text[:200]}"
+                    )
+                ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+                tree = ElementTree.fromstring(resp.content)
+                for contents in tree.iter(f"{ns}Contents"):
+                    children.add(
+                        contents.find(f"{ns}Key").text[len(prefix):]
+                    )
+                for cp in tree.iter(f"{ns}CommonPrefixes"):
+                    children.add(
+                        cp.find(f"{ns}Prefix").text[len(prefix):].rstrip("/")
+                    )
+                truncated = tree.find(f"{ns}IsTruncated")
+                if truncated is None or truncated.text != "true":
+                    break
+                token_el = tree.find(f"{ns}NextContinuationToken")
+                token = token_el.text if token_el is not None else None
+                if token is None:
+                    break
+            return sorted(c for c in children if c)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _list
+        )
+
     async def delete_dir(self, path: str) -> None:
         def _delete_dir() -> None:
             prefix = self._key(path).rstrip("/") + "/"
