@@ -35,6 +35,7 @@ class FakeGCSServer:
         self.fail_put_chunks = 0  # fail the next N chunk PUTs
         self.fail_at_chunks = set()  # fail specific 1-based chunk PUT indices
         self.chunk_puts = 0
+        self.copies = 0  # server-side copyTo calls
         self._lock = threading.Lock()
         outer = self
 
@@ -55,9 +56,26 @@ class FakeGCSServer:
 
             def do_POST(self):
                 split = urllib.parse.urlsplit(self.path)
-                m = re.match(r"/upload/storage/v1/b/([^/]+)/o", split.path)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                mc = re.match(
+                    r"/storage/v1/b/([^/]+)/o/(.+)/copyTo/b/([^/]+)/o/(.+)",
+                    split.path,
+                )
+                if mc:
+                    src = f"{mc.group(1)}/{urllib.parse.unquote(mc.group(2))}"
+                    dst = f"{mc.group(3)}/{urllib.parse.unquote(mc.group(4))}"
+                    with outer._lock:
+                        data = outer.objects.get(src)
+                        if data is None:
+                            return self._reply(404)
+                        outer.objects[dst] = data
+                        outer.copies += 1
+                    out = json.dumps({"name": dst}).encode()
+                    return self._reply(
+                        200, out, {"Content-Type": "application/json"}
+                    )
+                m = re.match(r"/upload/storage/v1/b/([^/]+)/o", split.path)
                 if not m:
                     return self._reply(404)
                 bucket = m.group(1)

@@ -22,6 +22,8 @@ class FakeS3Server:
         self.objects: Dict[str, bytes] = {}  # "bucket/key" -> data
         self.fail_next = 0
         self.request_count = 0
+        self.copies = 0  # server-side copies (x-amz-copy-source PUTs)
+        self.put_bytes = 0  # bytes actually uploaded by clients
         self._lock = threading.Lock()
         outer = self
 
@@ -63,8 +65,29 @@ class FakeS3Server:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 data = self.rfile.read(length)
+                copy_source = self.headers.get("x-amz-copy-source")
+                if copy_source:
+                    src_key = urllib.parse.unquote(copy_source.lstrip("/"))
+                    with outer._lock:
+                        src = outer.objects.get(src_key)
+                        if src is None:
+                            body = b"<Error><Code>NoSuchKey</Code></Error>"
+                            self.send_response(404)
+                            self.send_header("Content-Length", str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
+                        outer.objects[self._obj_key()] = src
+                        outer.copies += 1
+                    body = b"<CopyObjectResult></CopyObjectResult>"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 with outer._lock:
                     outer.objects[self._obj_key()] = data
+                    outer.put_bytes += len(data)
                 self.send_response(200)
                 self.send_header("Content-Length", "0")
                 self.end_headers()

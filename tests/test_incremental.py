@@ -131,3 +131,83 @@ def test_manager_incremental_chain(tmp_path):
         np.testing.assert_array_equal(
             dst["m"]["hot"], np.full(64, float(step), np.float32)
         )
+
+
+def test_incremental_on_s3_server_side_copy(monkeypatch):
+    """Unchanged payloads are deduplicated via S3 CopyObject — zero re-upload
+    bytes for the frozen subtree (hard links are fs-only; object stores get
+    server-side copies)."""
+    import numpy as np
+
+    from fake_s3 import FakeS3Server
+    from torchsnapshot_tpu import Snapshot, StateDict, knobs
+    from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+    server = FakeS3Server()
+    try:
+        monkeypatch.setenv("TPUSNAP_S3_ENDPOINT", server.endpoint)
+        backbone = np.random.RandomState(0).rand(400_000).astype(np.float32)
+        head1 = np.ones(128, np.float32)
+        with knobs.override_batching_disabled(True):
+            Snapshot.take(
+                "s3://bkt/run/step_1",
+                {"m": StateDict({"backbone": backbone, "head": head1})},
+            )
+            uploaded_before = server.put_bytes
+            head2 = np.full(128, 2.0, np.float32)
+            snap2 = Snapshot.take(
+                "s3://bkt/run/step_2",
+                {"m": StateDict({"backbone": backbone, "head": head2})},
+                incremental_from="s3://bkt/run/step_1",
+            )
+        assert server.copies >= 1, "backbone was not server-side copied"
+        uploaded_delta = server.put_bytes - uploaded_before
+        # second save re-uploads only the head + metadata, not the 1.6 MB
+        # backbone
+        assert uploaded_delta < backbone.nbytes // 4, uploaded_delta
+        dst = {
+            "m": StateDict(
+                {
+                    "backbone": np.zeros_like(backbone),
+                    "head": np.zeros_like(head2),
+                }
+            )
+        }
+        snap2.restore(dst)
+        assert_state_dict_eq(
+            dst["m"].state_dict(),
+            {"backbone": backbone, "head": head2},
+        )
+    finally:
+        server.stop()
+
+
+def test_incremental_on_gcs_server_side_copy(monkeypatch):
+    import numpy as np
+
+    from fake_gcs import FakeGCSServer
+    from torchsnapshot_tpu import Snapshot, StateDict, knobs
+    from torchsnapshot_tpu.test_utils import assert_state_dict_eq
+
+    server = FakeGCSServer()
+    try:
+        monkeypatch.setenv("TPUSNAP_GCS_ENDPOINT", server.endpoint)
+        backbone = np.random.RandomState(1).rand(400_000).astype(np.float32)
+        with knobs.override_batching_disabled(True):
+            Snapshot.take(
+                "gs://bkt/run/step_1",
+                {"m": StateDict({"backbone": backbone, "step": 1})},
+            )
+            snap2 = Snapshot.take(
+                "gs://bkt/run/step_2",
+                {"m": StateDict({"backbone": backbone, "step": 2})},
+                incremental_from="gs://bkt/run/step_1",
+            )
+        assert server.copies >= 1, "backbone was not server-side copied"
+        dst = {"m": StateDict({"backbone": np.zeros_like(backbone), "step": -1})}
+        snap2.restore(dst)
+        assert_state_dict_eq(
+            dst["m"].state_dict(), {"backbone": backbone, "step": 2}
+        )
+    finally:
+        server.stop()

@@ -5,26 +5,29 @@ frozen subtrees (backbones, embeddings) whose bytes are identical between
 checkpoints; rewriting them every save wastes the storage-bandwidth budget
 that BASELINE.md's north star is measured on.
 
-Mechanism: ``Snapshot.take(..., incremental_from=prev_path)`` wraps the fs
+Mechanism: ``Snapshot.take(..., incremental_from=prev_path)`` wraps the
 storage plugin.  For every payload write the wrapper hashes the staged bytes
 (xxHash64 — already computed for the manifest checksum) and, when the digest
-matches the base snapshot's entry for the SAME relative path, hard-links the
-base file into the new snapshot instead of writing.  Properties:
+matches the base snapshot's entry for the SAME relative path, duplicates the
+base payload server-side instead of writing: a hard link on fs, an S3
+CopyObject / GCS copyTo on object stores (no bytes traverse the host —
+exactly the upload bandwidth the north star is measured on).  Properties:
 
-- restore needs no knowledge of incrementality: every snapshot directory is
-  self-contained (hard links are real directory entries)
-- pruning the base snapshot is safe: the linked payloads survive via their
-  remaining link (fs semantics), so retention + incremental compose
+- restore needs no knowledge of incrementality: every snapshot is
+  self-contained (links are real directory entries; object copies are full
+  independent objects)
+- pruning the base snapshot is safe: linked payloads survive via their
+  remaining link, copied objects are independent
 - batched slabs never dedup (uuid paths), so the knob to maximize dedup is
   ``TPUSNAP_DISABLE_BATCHER=1`` or large params (unbatched anyway)
-- non-fs backends and any hash mismatch/missing base file fall back to a
-  normal write — correctness never depends on the optimization
+- backends without server-side copy and any hash mismatch/missing base file
+  fall back to a normal write — correctness never depends on the
+  optimization
 """
 
 from __future__ import annotations
 
 import logging
-import os
 from typing import Dict, Optional
 
 from .io_types import ReadIO, StoragePlugin, WriteIO, contiguous
@@ -35,7 +38,6 @@ from .manifest import (
     SnapshotMetadata,
     TensorEntry,
 )
-from .storage_plugins.fs import FSStoragePlugin
 
 logger = logging.getLogger(__name__)
 
@@ -64,13 +66,13 @@ def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, str]:
     return out
 
 
-class IncrementalFSStoragePlugin(StoragePlugin):
-    """Wraps an FSStoragePlugin; hard-links unchanged payloads from a base
-    snapshot directory."""
+class IncrementalStoragePlugin(StoragePlugin):
+    """Wraps any plugin with server-side copy support; duplicates unchanged
+    payloads from a base snapshot instead of rewriting them."""
 
     def __init__(
         self,
-        inner: FSStoragePlugin,
+        inner: StoragePlugin,
         base_root: str,
         base_checksums: Dict[str, str],
     ) -> None:
@@ -84,35 +86,32 @@ class IncrementalFSStoragePlugin(StoragePlugin):
         if expected is not None:
             import asyncio
 
-            def _hash_and_link() -> bool:
+            def _matches() -> bool:
                 from . import integrity
 
-                if integrity.compute(contiguous(write_io.buf)) != expected:
-                    return False
-                src = os.path.join(self._base_root, write_io.path)
-                dst = os.path.join(self._inner.root, write_io.path)
+                return integrity.compute(contiguous(write_io.buf)) == expected
+
+            # hash (GB/s-scale work) off the event loop; None = the loop's
+            # default executor for plugins without their own pool
+            executor = getattr(self._inner, "_get_executor", lambda: None)()
+            loop = asyncio.get_running_loop()
+            unchanged = await loop.run_in_executor(executor, _matches)
+            if unchanged:
                 try:
-                    os.makedirs(os.path.dirname(dst), exist_ok=True)
-                    if os.path.exists(dst):
-                        os.unlink(dst)
-                    os.link(src, dst)
-                    return True
-                except OSError as e:
+                    copied = await self._inner.copy_from_sibling(
+                        self._base_root, write_io.path
+                    )
+                except Exception as e:  # noqa: BLE001
                     logger.debug(
-                        "Incremental link failed for %s (%s); writing normally",
+                        "Incremental copy failed for %s (%s); writing "
+                        "normally",
                         write_io.path,
                         e,
                     )
-                    return False
-
-            # hash (GB/s-scale work) + link off the event loop, on the same
-            # pool the inner plugin uses for its blocking I/O
-            linked = await asyncio.get_running_loop().run_in_executor(
-                self._inner._get_executor(), _hash_and_link
-            )
-            if linked:
-                self.links += 1
-                return
+                    copied = False
+                if copied:
+                    self.links += 1
+                    return
         await self._inner.write(write_io)
 
     async def read(self, read_io: ReadIO) -> None:
@@ -126,20 +125,33 @@ class IncrementalFSStoragePlugin(StoragePlugin):
 
     async def close(self) -> None:
         if self.links:
-            logger.info("Incremental snapshot: %d payloads hard-linked", self.links)
+            logger.info(
+                "Incremental snapshot: %d payloads deduplicated "
+                "(hard link / server-side copy)",
+                self.links,
+            )
         await self._inner.close()
 
 
+def _scheme(path: str) -> str:
+    return path.split("://", 1)[0] if "://" in path else "fs"
+
+
 def maybe_wrap_incremental(
-    storage: StoragePlugin, base_path: Optional[str]
+    storage: StoragePlugin,
+    base_path: Optional[str],
+    target_path: Optional[str] = None,
 ) -> StoragePlugin:
-    """Wrap ``storage`` for incremental writes when both the target and the
-    base are local filesystems and the base is a committed snapshot;
-    otherwise return ``storage`` unchanged."""
-    if base_path is None or not isinstance(storage, FSStoragePlugin):
+    """Wrap ``storage`` for incremental writes when the base is a committed
+    snapshot on the same backend; otherwise return ``storage`` unchanged."""
+    if base_path is None:
         return storage
-    if "://" in base_path and not base_path.startswith("fs://"):
-        logger.warning("incremental_from ignored: base is not a filesystem path")
+    if target_path is not None and _scheme(base_path) != _scheme(target_path):
+        logger.warning(
+            "incremental_from ignored: base scheme %s != target scheme %s",
+            _scheme(base_path),
+            _scheme(target_path),
+        )
         return storage
     base_root = base_path.split("://", 1)[-1]
     # One canonical metadata reader: Snapshot's own.
@@ -155,6 +167,6 @@ def maybe_wrap_incremental(
     base_checksums = checksums_by_location(base_metadata)
     if not base_checksums:
         return storage
-    return IncrementalFSStoragePlugin(
+    return IncrementalStoragePlugin(
         inner=storage, base_root=base_root, base_checksums=base_checksums
     )

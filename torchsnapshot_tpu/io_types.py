@@ -163,6 +163,15 @@ class StoragePlugin(abc.ABC):
         backend genuinely cannot list."""
         raise NotImplementedError(f"{type(self).__name__} cannot list")
 
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        """Server-side duplication of ``src_root``'s ``path`` (a sibling
+        location on the same backend, e.g. the previous snapshot directory)
+        into this plugin's ``path``, without moving the bytes through this
+        host.  Returns False when the backend can't (caller falls back to a
+        normal write) — incremental snapshots use this to skip re-uploading
+        unchanged payloads."""
+        return False
+
     async def exists(self, path: str) -> bool:
         """Whether ``path`` holds a readable object.  Default probes with a
         read (commit-marker files are small); backends override with a

@@ -84,20 +84,16 @@ class SnapshotManager:
         async_: bool = False,
         incremental: bool = False,
     ) -> Union[Snapshot, PendingSnapshot]:
-        """``incremental=True`` hard-links payloads unchanged since the
-        latest committed snapshot instead of rewriting them (fs roots)."""
+        """``incremental=True`` deduplicates payloads unchanged since the
+        latest committed snapshot instead of rewriting them (hard links on
+        fs, server-side copies on object stores)."""
         path = self.path_for_step(step)
         base: Optional[str] = None
         if incremental:
-            # Hard-link reuse needs a posix filesystem; other backends save
-            # in full (retention/listing still work everywhere).
-            if "://" in self.root and not self.root.startswith("fs://"):
-                logger.warning(
-                    "incremental save ignored: hard links need an fs root"
-                )
-                latest = None
-            else:
-                latest = self.latest_step()
+            # Dedup is a hard link on fs, a server-side copy on object
+            # stores; backends without either fall back to full writes
+            # inside the wrapper.
+            latest = self.latest_step()
             if latest is not None and latest != step:
                 base = self.path_for_step(latest)
         if async_:

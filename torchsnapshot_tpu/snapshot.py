@@ -108,7 +108,9 @@ class Snapshot:
             if incremental_from is not None:
                 from .incremental import maybe_wrap_incremental
 
-                storage = maybe_wrap_incremental(storage, incremental_from)
+                storage = maybe_wrap_incremental(
+                    storage, incremental_from, target_path=path
+                )
             try:
                 pending_io_work, metadata = cls._take_impl(
                     path=path,
@@ -167,7 +169,9 @@ class Snapshot:
         if incremental_from is not None:
             from .incremental import maybe_wrap_incremental
 
-            storage = maybe_wrap_incremental(storage, incremental_from)
+            storage = maybe_wrap_incremental(
+                storage, incremental_from, target_path=path
+            )
         try:
             pending_io_work, metadata = cls._take_impl(
                 path=path,

@@ -198,6 +198,28 @@ class FSStoragePlugin(StoragePlugin):
             read_io.into,
         )
 
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        # Hard link: zero-copy dedup; the new snapshot dir stays
+        # self-contained (links are real directory entries) and pruning the
+        # base is safe (the payload survives via its remaining link).
+        def _link() -> bool:
+            src = os.path.join(src_root, path)
+            dst = os.path.join(self.root, path)
+            try:
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                if os.path.exists(dst):
+                    os.unlink(dst)
+                os.link(src, dst)
+                return True
+            except OSError:
+                return False
+
+        # Off the event loop: on NFS/Lustre each link is network round-trips,
+        # and an incremental save may issue thousands.
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _link
+        )
+
     async def list_dir(self, path: str) -> list:
         try:
             return sorted(os.listdir(os.path.join(self.root, path)))

@@ -286,6 +286,39 @@ class GCSStoragePlugin(StoragePlugin):
             self._get_executor(), _list
         )
 
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        src_bucket, _, src_prefix = src_root.partition("/")
+        if src_bucket != self.bucket_name:
+            return False
+
+        def _copy() -> bool:
+            src_name = (
+                f"{src_prefix.strip('/')}/{path}" if src_prefix else path
+            )
+            url = (
+                f"{self._download_base}/storage/v1/b/{self.bucket_name}/o/"
+                + src_name.replace("/", "%2F")
+                + f"/copyTo/b/{self.bucket_name}/o/"
+                + self._blob_url(path).replace("/", "%2F")
+            )
+            session = self._session()
+            while True:
+                try:
+                    resp = session.post(url)
+                    if resp.status_code == 404:
+                        return False
+                    resp.raise_for_status()
+                    self._retry.report_progress()
+                    return True
+                except Exception as e:  # noqa: BLE001
+                    if not _is_transient(e):
+                        raise
+                    self._retry.check_and_backoff(e)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _copy
+        )
+
     async def exists(self, path: str) -> bool:
         def _probe() -> bool:
             # Metadata GET (no alt=media): one cheap round-trip instead of

@@ -269,6 +269,33 @@ class S3StoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        src_bucket, _, src_prefix = src_root.partition("/")
+        if src_bucket != self.bucket:
+            return False  # cross-bucket copy: fall back to a normal write
+
+        def _copy() -> bool:
+            src_key = f"{src_prefix.strip('/')}/{path}" if src_prefix else path
+            headers = {
+                "x-amz-copy-source": urllib.parse.quote(
+                    f"/{self.bucket}/{src_key}", safe="/"
+                )
+            }
+            resp = self._request(
+                "PUT", self._url(self._key(path)), headers=headers
+            )
+            if resp.status_code != 200:
+                return False
+            # CopyObject can return 200 OK with an <Error> body when the
+            # copy fails mid-flight (documented AWS behavior): success must
+            # carry a CopyObjectResult, or the skipped write would commit a
+            # manifest entry whose object doesn't exist.
+            return b"CopyObjectResult" in resp.content
+
+        return await asyncio.get_running_loop().run_in_executor(
+            self._get_executor(), _copy
+        )
+
     async def exists(self, path: str) -> bool:
         def _head() -> bool:
             # HEAD: one cheap round-trip instead of downloading the object.
