@@ -154,6 +154,19 @@ def maybe_wrap_incremental(
         )
         return storage
     base_root = base_path.split("://", 1)[-1]
+    if target_path is not None and _scheme(base_path) in ("s3", "gs", "gcs"):
+        # Object-store copies are same-bucket only; catch the mismatch once
+        # here instead of hashing every payload and refusing every copy.
+        base_bucket = base_root.partition("/")[0]
+        target_bucket = target_path.split("://", 1)[-1].partition("/")[0]
+        if base_bucket != target_bucket:
+            logger.warning(
+                "incremental_from ignored: base bucket %s != target "
+                "bucket %s (server-side copy is same-bucket only)",
+                base_bucket,
+                target_bucket,
+            )
+            return storage
     # One canonical metadata reader: Snapshot's own.
     from .snapshot import Snapshot
 

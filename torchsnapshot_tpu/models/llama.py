@@ -210,19 +210,20 @@ def _layer_body(
     q = (h @ layer["attn"]["wq"].astype(cfg.dtype)).reshape(
         *h.shape[:2], cfg.n_heads, cfg.head_dim
     )
-    kp = h @ layer["attn"]["wk"].astype(cfg.dtype)
-    vp = h @ layer["attn"]["wv"].astype(cfg.dtype)
     n_rep = cfg.n_heads // cfg.n_kv_heads
+    hkv = h
     if gather_constrain is not None and n_rep > 1:
         # Grouped-query KV under sequence+tensor parallelism: n_kv_heads may
         # not divide the tensor axis, and XLA has no efficient lowering for
         # an axis-indivisible seq-shard -> head-shard transition across the
         # 4-D reshape/repeat (involuntary full rematerialization).  Instead,
-        # make the transition on the narrow 3-D projection output as a plain
-        # seq all-gather (the Megatron sequence-parallel recipe); the
-        # reshape, GQA expansion and head slice are then all local.
-        kp = gather_constrain(kp)
-        vp = gather_constrain(vp)
+        # all-gather the *input* of the KV projections over seq (the
+        # Megatron sequence-parallel recipe — its transpose is a clean
+        # reduce-scatter, so the backward pass stays efficient too); the
+        # projection, reshape, GQA expansion and head slice are then local.
+        hkv = gather_constrain(h)
+    kp = hkv @ layer["attn"]["wk"].astype(cfg.dtype)
+    vp = hkv @ layer["attn"]["wv"].astype(cfg.dtype)
     k = kp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
     v = vp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
     if head_constrain is not None and n_rep > 1:

@@ -91,9 +91,10 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         incremental_from: Optional[str] = None,
     ) -> "Snapshot":
-        """``incremental_from``: path of a committed base snapshot — payloads
-        whose bytes are unchanged are hard-linked instead of rewritten
-        (fs backends; see incremental.py)."""
+        """``incremental_from``: path of a committed base snapshot on the
+        same backend — payloads whose bytes are unchanged are deduplicated
+        instead of rewritten (hard links on fs, server-side copies on
+        s3/gs; see incremental.py)."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}

@@ -269,6 +269,12 @@ class S3StoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
+    # AWS CopyObject rejects sources over 5 GB (multipart UploadPartCopy
+    # territory).  Our payloads are bounded well below this by the 512 MB
+    # chunk/shard knobs, but an oversized pickled object would hit it — skip
+    # the attempt rather than round-trip a guaranteed 400.
+    _COPY_MAX_BYTES = 5 * 1024 * 1024 * 1024
+
     async def copy_from_sibling(self, src_root: str, path: str) -> bool:
         src_bucket, _, src_prefix = src_root.partition("/")
         if src_bucket != self.bucket:
@@ -276,6 +282,12 @@ class S3StoragePlugin(StoragePlugin):
 
         def _copy() -> bool:
             src_key = f"{src_prefix.strip('/')}/{path}" if src_prefix else path
+            src_url = f"{self._base}/{urllib.parse.quote(src_key, safe='/')}"
+            head = self._request("HEAD", src_url)
+            if head.status_code != 200:
+                return False
+            if int(head.headers.get("Content-Length", 0)) > self._COPY_MAX_BYTES:
+                return False
             headers = {
                 "x-amz-copy-source": urllib.parse.quote(
                     f"/{self.bucket}/{src_key}", safe="/"
