@@ -34,3 +34,52 @@ def test_cli_cat(tmp_path, capsys):
     assert capsys.readouterr().out.strip() == "12"
     assert main(["cat", path, "0/m/w"]) == 0
     assert "0." in capsys.readouterr().out
+
+
+def test_cli_steps(tmp_path, capsys):
+    from torchsnapshot_tpu.manager import SnapshotManager
+
+    mgr = SnapshotManager(str(tmp_path / "run"))
+    for step in (3, 7):
+        mgr.save(step, {"m": StateDict({"w": np.ones(8, np.float32), "s": step})})
+    assert main(["steps", str(tmp_path / "run")]) == 0
+    out = capsys.readouterr().out
+    assert "step_3" in out and "step_7" in out and "latest: 7" in out
+
+
+def test_cli_verify_clean_and_corrupt(tmp_path, capsys):
+    import os
+
+    from torchsnapshot_tpu import Snapshot
+
+    path = str(tmp_path / "snap")
+    snap = Snapshot.take(path, {"m": StateDict({"w": np.arange(256, dtype=np.float32)})})
+    assert main(["verify", path]) == 0
+    out = capsys.readouterr().out
+    assert "0 corrupt" in out and "verified" in out
+
+    # flip a byte in the largest payload
+    manifest = snap.get_manifest()
+    entry = next(
+        e for e in manifest.values() if getattr(e, "location", None)
+    )
+    target = os.path.join(path, entry.location)
+    with open(target, "r+b") as f:
+        f.seek(2)
+        f.write(b"\xaa\xbb")
+    assert main(["verify", path]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out
+
+
+def test_cli_verify_handles_object_entries(tmp_path, capsys):
+    """Pickled objects carry checksums but no byte_range; verify must audit
+    them, not crash."""
+    path = str(tmp_path / "objsnap")
+    Snapshot.take(
+        path,
+        {"m": StateDict({"cfg": {"lr": 0.1, "name": "run"}, "w": np.ones(4)})},
+    )
+    assert main(["verify", path]) == 0
+    out = capsys.readouterr().out
+    assert "0 corrupt" in out

@@ -3,6 +3,8 @@
     python -m torchsnapshot_tpu ls <snapshot-url> [--rank N]
     python -m torchsnapshot_tpu cat <snapshot-url> <rank/logical/path>
     python -m torchsnapshot_tpu info <snapshot-url>
+    python -m torchsnapshot_tpu steps <manager-root-url>
+    python -m torchsnapshot_tpu verify <snapshot-url>
 
 Read-only; works against any storage backend URL.  (Beyond reference parity:
 the reference ships no CLI.)
@@ -116,6 +118,99 @@ def cmd_cat(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_steps(args: argparse.Namespace) -> int:
+    from .manager import SnapshotManager
+    from .pg_wrapper import PGWrapper
+
+    mgr = SnapshotManager(args.path, pg=PGWrapper())
+    steps = mgr.all_steps()
+    if not steps:
+        print("no committed steps")
+        return 0
+    for step in steps:
+        print(f"step_{step}")
+    print(f"latest: {steps[-1]}")
+    return 0
+
+
+def cmd_verify(args: argparse.Namespace) -> int:
+    """Audit every payload checksum without restoring: catches bit rot /
+    truncation before a resume depends on the snapshot."""
+    from . import integrity
+    from .integrity import ChecksumError, verify
+    from .io_types import ReadIO
+    from .manifest import (
+        ChunkedTensorEntry,
+        ObjectEntry,
+        ShardedArrayEntry,
+        TensorEntry,
+    )
+    from .native_io import NativeFileIO
+    from .snapshot import Snapshot
+    from .storage_plugin import url_to_storage_plugin
+
+    # A no-op audit must not masquerade as a clean one: verification needs
+    # checksums enabled AND the native hash.
+    if not integrity.checksums_enabled() or NativeFileIO.maybe_create() is None:
+        print(
+            "cannot verify: checksums disabled (TPUSNAP_CHECKSUM=0) or "
+            "native library unavailable"
+        )
+        return 2
+
+    md = Snapshot(args.path).metadata
+    # (location, byte_range) -> checksum, deduped: replicated references
+    # point at one durable payload.  ObjectEntry has no byte_range (whole
+    # file), hence the getattr.
+    payloads = {}
+
+    def _add(entry) -> None:
+        if entry.checksum is None:
+            return
+        br = getattr(entry, "byte_range", None)
+        payloads[(entry.location, tuple(br) if br else None)] = entry.checksum
+
+    for entry in md.manifest.values():
+        if isinstance(entry, TensorEntry):
+            _add(entry)
+        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+            shards = (
+                entry.shards
+                if isinstance(entry, ShardedArrayEntry)
+                else entry.chunks
+            )
+            for shard in shards:
+                _add(shard.tensor)
+        elif isinstance(entry, ObjectEntry):
+            _add(entry)
+
+    storage = url_to_storage_plugin(args.path)
+    ok = corrupt = unreadable = 0
+    try:
+        for (location, br), checksum in sorted(payloads.items()):
+            read_io = ReadIO(path=location, byte_range=list(br) if br else None)
+            try:
+                storage.sync_read(read_io)
+            except Exception as e:  # noqa: BLE001
+                print(f"UNREADABLE {location}: {e}")
+                unreadable += 1
+                continue
+            try:
+                verify(read_io.buf, checksum, location)
+                ok += 1
+            except ChecksumError as e:
+                print(f"CORRUPT {e}")
+                corrupt += 1
+    finally:
+        storage.sync_close()
+    skipped = "" if payloads else " (no checksums recorded)"
+    print(
+        f"verified {ok} payloads, {corrupt} corrupt, "
+        f"{unreadable} unreadable{skipped}"
+    )
+    return 1 if corrupt or unreadable else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -133,6 +228,16 @@ def main(argv=None) -> int:
     p.add_argument("path")
     p.add_argument("object_path")
     p.set_defaults(fn=cmd_cat)
+
+    p = sub.add_parser("steps", help="list a SnapshotManager root's steps")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_steps)
+
+    p = sub.add_parser(
+        "verify", help="audit all payload checksums without restoring"
+    )
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_verify)
 
     args = parser.parse_args(argv)
     return args.fn(args)
