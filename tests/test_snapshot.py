@@ -226,3 +226,24 @@ def test_chunked_through_snapshot(tmp_path, toggle_chunking):
     dst = {"m": StateDict({"big": np.zeros((64, 8), np.float32)})}
     snapshot.restore(dst)
     np.testing.assert_array_equal(dst["m"]["big"], arr)
+
+
+def test_api_callable_from_running_event_loop(tmp_path):
+    """Jupyter / async trainers call the sync API from inside a running
+    loop; every sync entry point must delegate to a helper thread instead
+    of failing with 'Cannot run the event loop while another loop is
+    running' (the reference vendors nest-asyncio for this; we own fresh
+    loops per pipeline instead — utils/loops.py)."""
+    import asyncio
+
+    async def scenario():
+        app = {"m": StateDict({"w": np.arange(32, dtype=np.float32), "s": 9})}
+        snap = Snapshot.take(str(tmp_path / "snap"), app)
+        dst = {"m": StateDict({"w": np.zeros(32, np.float32), "s": -1})}
+        snap.restore(dst)
+        np.testing.assert_array_equal(dst["m"]["w"], app["m"]["w"])
+        pending = Snapshot.async_take(str(tmp_path / "asnap"), app)
+        pending.wait()
+        assert int(snap.read_object("0/m/s")) == 9
+
+    asyncio.run(scenario())

@@ -11,9 +11,10 @@ pjrt, then a zero-copy view); a ``ReadReq`` pairs a path + byte range with a
 from __future__ import annotations
 
 import abc
-import asyncio
 from dataclasses import dataclass
 from typing import Any, Generic, List, Optional, TypeVar
+
+from .utils.loops import run_coro
 
 BufferType = Any  # bytes | bytearray | memoryview | ScatterBuffer
 
@@ -188,19 +189,23 @@ class StoragePlugin(abc.ABC):
                 return False
             raise
 
-    # Sync conveniences (reference io_types.py:101-120); run a private loop so
-    # they are safe to call from any thread.
+    # Sync conveniences (reference io_types.py:101-120); run a private loop,
+    # delegating to a helper thread when the caller is already inside a
+    # running loop (Jupyter / async trainers — utils/loops.py).
     def sync_write(self, write_io: WriteIO) -> None:
-        asyncio.run(self.write(write_io))
+        run_coro(lambda: self.write(write_io))
 
     def sync_read(self, read_io: ReadIO) -> None:
-        asyncio.run(self.read(read_io))
+        run_coro(lambda: self.read(read_io))
 
     def sync_list_dir(self, path: str) -> List[str]:
-        return asyncio.run(self.list_dir(path))
+        return run_coro(lambda: self.list_dir(path))
 
     def sync_exists(self, path: str) -> bool:
-        return asyncio.run(self.exists(path))
+        return run_coro(lambda: self.exists(path))
+
+    def sync_delete_dir(self, path: str) -> None:
+        run_coro(lambda: self.delete_dir(path))
 
     def sync_close(self) -> None:
-        asyncio.run(self.close())
+        run_coro(lambda: self.close())

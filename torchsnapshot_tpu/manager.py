@@ -144,8 +144,6 @@ class SnapshotManager:
         self._pg.barrier()
         try:
             if self._pg.get_rank() == 0:
-                import asyncio
-
                 storage = url_to_storage_plugin(self.root)
                 try:
                     committed = [
@@ -157,7 +155,7 @@ class SnapshotManager:
                     excess = len(committed) - budget
                     for step in committed[: max(excess, 0)]:
                         logger.info("Pruning snapshot step_%d", step)
-                        asyncio.run(storage.delete_dir(f"step_{step}"))
+                        storage.sync_delete_dir(f"step_{step}")
                 finally:
                     storage.sync_close()
         except NotImplementedError:

@@ -142,6 +142,11 @@ class PendingIOWork:
         self.bytes_total = bytes_total
 
     def sync_complete(self) -> None:
+        from .utils.loops import call_outside_loop
+
+        call_outside_loop(self._sync_complete_impl)
+
+    def _sync_complete_impl(self) -> None:
         begin = time.monotonic()
         try:
             if self._io_tasks:
@@ -339,7 +344,21 @@ def sync_execute_write_reqs(
 ) -> PendingIOWork:
     """Run the write pipeline on a fresh private event loop; the returned
     PendingIOWork owns the loop and may be completed from another thread
-    (reference scheduler.py:342-383)."""
+    (reference scheduler.py:342-383).  Safe to call from inside a running
+    loop (delegates to a helper thread — utils/loops.py)."""
+    from .utils.loops import call_outside_loop
+
+    return call_outside_loop(
+        _sync_execute_write_reqs_impl, write_reqs, storage, memory_budget_bytes, rank
+    )
+
+
+def _sync_execute_write_reqs_impl(
+    write_reqs: List[WriteReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> PendingIOWork:
     loop = asyncio.new_event_loop()
     try:
         pending = loop.run_until_complete(
@@ -486,6 +505,19 @@ def sync_execute_read_reqs(
     rank: int,
 ) -> None:
     """(reference scheduler.py:449-463)"""
+    from .utils.loops import call_outside_loop
+
+    call_outside_loop(
+        _sync_execute_read_reqs_impl, read_reqs, storage, memory_budget_bytes, rank
+    )
+
+
+def _sync_execute_read_reqs_impl(
+    read_reqs: List[ReadReq],
+    storage: StoragePlugin,
+    memory_budget_bytes: int,
+    rank: int,
+) -> None:
     loop = asyncio.new_event_loop()
     try:
         loop.run_until_complete(

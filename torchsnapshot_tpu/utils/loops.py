@@ -1,0 +1,45 @@
+"""Event-loop hygiene for the sync API surface.
+
+The pipelines run on private event loops owned by their caller (design.md:
+no nested-loop monkey-patching, unlike the reference's vendored nest-asyncio,
+asyncio_utils.py:13-153).  One rule makes that safe everywhere: a thread can
+drive at most one loop, so when the *calling* thread is already inside a
+running loop (Jupyter cells, async trainers), the sync entry points delegate
+themselves to a short-lived helper thread and block on it — same semantics,
+no loop re-entrancy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable
+
+
+def call_outside_loop(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+    """Run ``fn`` (which drives an event loop internally) in this thread, or
+    on a helper thread when this thread already runs a loop."""
+    try:
+        asyncio.get_running_loop()
+    except RuntimeError:
+        return fn(*args, **kwargs)
+    result: dict = {}
+
+    def _target() -> None:
+        try:
+            result["value"] = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            result["error"] = e
+
+    thread = threading.Thread(target=_target, name="tpusnap-sync-helper")
+    thread.start()
+    thread.join()
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def run_coro(coro_factory: Callable[[], Any]) -> Any:
+    """asyncio.run the coroutine produced by ``coro_factory``, from any
+    context (the factory is invoked on the thread that runs the loop)."""
+    return call_outside_loop(lambda: asyncio.run(coro_factory()))
