@@ -211,3 +211,38 @@ def test_incremental_on_gcs_server_side_copy(monkeypatch):
         )
     finally:
         server.stop()
+
+
+def test_incremental_and_retention_compose_on_s3(monkeypatch):
+    """Pruning the base snapshot must not break an incremental successor:
+    server-side copies are full independent objects (the object-store
+    analogue of the fs hard-link guarantee)."""
+    import numpy as np
+
+    from fake_s3 import FakeS3Server
+    from torchsnapshot_tpu import StateDict, knobs
+    from torchsnapshot_tpu.manager import SnapshotManager
+
+    server = FakeS3Server()
+    try:
+        monkeypatch.setenv("TPUSNAP_S3_ENDPOINT", server.endpoint)
+        backbone = np.random.RandomState(3).rand(400_000).astype(np.float32)
+        mgr = SnapshotManager("s3://bkt/compose", max_to_keep=1)
+        with knobs.override_batching_disabled(True):
+            mgr.save(1, {"m": StateDict({"backbone": backbone, "s": 1})})
+            mgr.save(
+                2,
+                {"m": StateDict({"backbone": backbone, "s": 2})},
+                incremental=True,
+            )
+        # retention pruned step_1 (the copy source)
+        assert mgr.all_steps() == [2]
+        assert not any(
+            k.startswith("bkt/compose/step_1/") for k in server.objects
+        )
+        assert server.copies >= 1
+        dst = {"m": StateDict({"backbone": np.zeros_like(backbone), "s": -1})}
+        assert mgr.restore_latest(dst) == 2
+        np.testing.assert_array_equal(dst["m"]["backbone"], backbone)
+    finally:
+        server.stop()
