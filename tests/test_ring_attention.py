@@ -1,0 +1,191 @@
+"""Ring attention == dense causal attention, on a sequence-sharded mesh."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from torchsnapshot_tpu.models.ring_attention import ring_attention  # noqa: E402
+
+
+def _dense_causal(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(v.dtype)
+
+
+@pytest.mark.parametrize("ring", [2, 4, 8])
+def test_ring_matches_dense(ring):
+    devices = np.array(jax.devices()[:ring])
+    mesh = Mesh(devices, ("sp",))
+    b, s, h, d = 2, 64, 4, 16
+    key = jax.random.key(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+
+    expected = _dense_causal(q, k, v)
+
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    with mesh:
+        out = jax.jit(
+            lambda a, b2, c: ring_attention(a, b2, c, mesh, "sp")
+        )(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_with_batch_axis():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "sp"))
+    b, s, h, d = 4, 32, 2, 8
+    key = jax.random.key(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+    expected = _dense_causal(q, k, v)
+    spec = NamedSharding(mesh, P("data", "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    with mesh:
+        out = jax.jit(
+            lambda a, b2, c: ring_attention(
+                a, b2, c, mesh, "sp", batch_axis="data"
+            )
+        )(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ring_bf16_inputs():
+    devices = np.array(jax.devices()[:4])
+    mesh = Mesh(devices, ("sp",))
+    b, s, h, d = 1, 32, 2, 16
+    key = jax.random.key(2)
+    q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+    k = q + jnp.bfloat16(0.5)
+    v = q * jnp.bfloat16(2.0)
+    expected = _dense_causal(q, k, v)
+    spec = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    with mesh:
+        out = jax.jit(
+            lambda a, b2, c: ring_attention(a, b2, c, mesh, "sp")
+        )(qs, ks, vs)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32),
+        np.asarray(expected, dtype=np.float32),
+        rtol=3e-2,
+        atol=3e-2,
+    )
+
+
+def test_llama_forward_with_ring_matches_dense():
+    """The flagship model under the context-parallel layout (seq sharded on
+    an 'sp' axis, ring attention) computes the same logits as the dense
+    path — and its train state checkpoints/restores like any other."""
+    import tempfile
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.models import LlamaConfig, forward, init_params
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "sp"))
+    cfg = LlamaConfig(
+        vocab_size=128,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=4,  # no GQA repeat: pure context-parallel layout
+        d_ff=64,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+
+    dense = forward(params, tokens, cfg)
+
+    tokens_sp = jax.device_put(tokens, NamedSharding(mesh, P("data", "sp")))
+    with mesh:
+        ringed = jax.jit(
+            lambda p, t: forward(
+                p, t, cfg, P("data", "sp"), ring=(mesh, "sp", "data")
+            )
+        )(params, tokens_sp)
+    np.testing.assert_allclose(
+        np.asarray(ringed, dtype=np.float32),
+        np.asarray(dense, dtype=np.float32),
+        rtol=5e-2,
+        atol=5e-2,  # bf16 activations
+    )
+
+    # the seq-sharded state checkpoints and round-trips (long-context
+    # manifests preserve the sp axis — SURVEY §5)
+    acts = jax.device_put(
+        jax.random.normal(jax.random.key(2), (2, 32, 32), jnp.float32),
+        NamedSharding(mesh, P("data", "sp", None)),
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        snap = Snapshot.take(tmp + "/s", {"kv": StateDict({"acts": acts})})
+        entry = snap.get_manifest()["0/kv/acts"]
+        assert "sp" in str(entry.partition_spec)
+        dst = {"kv": StateDict({"acts": jax.device_put(
+            jnp.zeros((2, 32, 32), jnp.float32),
+            NamedSharding(mesh, P("data", "sp", None)),
+        )})}
+        snap.restore(dst)
+        np.testing.assert_array_equal(
+            np.asarray(dst["kv"]["acts"]), np.asarray(acts)
+        )
+
+
+def test_ring_train_step_with_gqa():
+    """Full fwd+bwd+adamw step under the context-parallel layout, with GQA
+    (KV repeat feeds the ring; no full-seq gather happens under ring)."""
+    import optax
+
+    from torchsnapshot_tpu.models import (
+        LlamaConfig,
+        init_params,
+        make_train_step,
+    )
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("data", "sp"))
+    cfg = LlamaConfig(
+        vocab_size=128,
+        d_model=32,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,  # GQA: repeat-then-ring path
+        d_ff=64,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    opt = optax.adamw(1e-3)
+    ts = {
+        "params": params,
+        "opt_state": opt.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+    step_fn = make_train_step(
+        cfg, opt, activation_spec=P("data", "sp"), ring=(mesh, "sp", "data")
+    )
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(3), (4, 32), 0, 128),
+        NamedSharding(mesh, P("data", None)),
+    )
+    with mesh:
+        ts, loss = jax.jit(step_fn)(ts, tokens)
+        jax.block_until_ready(loss)
+    assert np.isfinite(float(loss)), float(loss)
+    assert int(jax.device_get(ts["step"])) == 1

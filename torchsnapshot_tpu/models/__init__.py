@@ -8,3 +8,4 @@ from .llama import (
     shard_train_state,
     state_partition_specs,
 )
+from .ring_attention import ring_attention

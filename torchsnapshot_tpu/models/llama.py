@@ -201,6 +201,7 @@ def _layer_body(
     layer: Dict[str, Any],
     positions: jax.Array,
     constrainers=None,
+    ring=None,
 ) -> jax.Array:
     d = cfg.d_model
     head_constrain = gather_constrain = None
@@ -212,7 +213,7 @@ def _layer_body(
     )
     n_rep = cfg.n_heads // cfg.n_kv_heads
     hkv = h
-    if gather_constrain is not None and n_rep > 1:
+    if gather_constrain is not None and n_rep > 1 and ring is None:
         # Grouped-query KV under sequence+tensor parallelism: n_kv_heads may
         # not divide the tensor axis, and XLA has no efficient lowering for
         # an axis-indivisible seq-shard -> head-shard transition across the
@@ -226,19 +227,28 @@ def _layer_body(
     vp = hkv @ layer["attn"]["wv"].astype(cfg.dtype)
     k = kp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
     v = vp.reshape(*h.shape[:2], cfg.n_kv_heads, cfg.head_dim)
-    if head_constrain is not None and n_rep > 1:
+    if (head_constrain is not None or ring is not None) and n_rep > 1:
         # rope is per-head, so it commutes with the GQA repeat.
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
         n_rep = 1
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if head_constrain is not None:
-        # Single constraint point per tensor: all three enter attention
-        # head-sharded (a seq-sharded v against head-sharded q/k would
-        # reintroduce the indivisible transition inside the einsum).
-        q, k, v = head_constrain(q), head_constrain(k), head_constrain(v)
-    attn = _attention(q, k, v, n_rep)
+    if ring is not None:
+        # Long-context path: exact causal attention with KV shards rotating
+        # around the sequence axis ring (O(S/n) memory per device, ICI-ring
+        # transfers) — models/ring_attention.py.
+        from .ring_attention import ring_attention
+
+        mesh, seq_axis, batch_axis = ring
+        attn = ring_attention(q, k, v, mesh, seq_axis, batch_axis=batch_axis)
+    else:
+        if head_constrain is not None:
+            # Single constraint point per tensor: all three enter attention
+            # head-sharded (a seq-sharded v against head-sharded q/k would
+            # reintroduce the indivisible transition inside the einsum).
+            q, k, v = head_constrain(q), head_constrain(k), head_constrain(v)
+        attn = _attention(q, k, v, n_rep)
     attn = attn.reshape(*h.shape[:2], d)
     x = x + attn @ layer["attn"]["wo"].astype(cfg.dtype)
 
@@ -254,10 +264,16 @@ def forward(
     tokens: jax.Array,
     cfg: LlamaConfig,
     activation_spec: Optional[P] = None,
+    ring: Optional[tuple] = None,
 ) -> jax.Array:
     """Logits for next-token prediction.  ``activation_spec`` (e.g.
     P("data", "model") for sequence parallelism on the seq dim) constrains
-    activation sharding so XLA lays collectives on ICI."""
+    activation sharding so XLA lays collectives on ICI.
+
+    ``ring=(mesh, seq_axis, batch_axis)`` switches attention to the ring
+    formulation (models/ring_attention.py): the context-parallel layout for
+    long sequences, where KV blocks rotate around the seq axis instead of
+    any device materializing full-sequence KV."""
 
     def constrain(x: jax.Array) -> jax.Array:
         if activation_spec is not None:
@@ -290,7 +306,7 @@ def forward(
     )
 
     def scan_body(carry: jax.Array, layer: Dict[str, Any]):
-        y = _layer_body(cfg, carry, layer, positions, constrainers)
+        y = _layer_body(cfg, carry, layer, positions, constrainers, ring)
         return constrain(y), None
 
     x, _ = jax.lax.scan(
@@ -306,8 +322,15 @@ def loss_fn(
     tokens: jax.Array,
     cfg: LlamaConfig,
     activation_spec: Optional[P] = None,
+    ring: Optional[tuple] = None,
 ) -> jax.Array:
-    logits = forward(params, tokens[:, :-1], cfg, activation_spec)
+    if ring is not None:
+        # shard_map needs the seq dim divisible by the ring axis; keep the
+        # full (divisible) length through the model and drop the final
+        # position's logits instead of slicing the inputs.
+        logits = forward(params, tokens, cfg, activation_spec, ring)[:, :-1]
+    else:
+        logits = forward(params, tokens[:, :-1], cfg, activation_spec, ring)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -318,13 +341,15 @@ def make_train_step(
     cfg: LlamaConfig,
     optimizer: Any,
     activation_spec: Optional[P] = None,
+    ring: Optional[tuple] = None,
 ):
     """Returns train_step(train_state, tokens) -> (train_state, loss) — a pure
-    jittable function over {params, opt_state, step}."""
+    jittable function over {params, opt_state, step}.  ``ring`` enables the
+    context-parallel ring-attention layout (see forward)."""
 
     def train_step(train_state: Dict[str, Any], tokens: jax.Array):
         loss, grads = jax.value_and_grad(loss_fn)(
-            train_state["params"], tokens, cfg, activation_spec
+            train_state["params"], tokens, cfg, activation_spec, ring
         )
         updates, opt_state = optimizer.update(
             grads, train_state["opt_state"], train_state["params"]
