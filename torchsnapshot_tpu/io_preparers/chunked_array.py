@@ -24,7 +24,12 @@ import numpy as np
 from .. import serialization
 from ..io_types import Future, ReadReq, WriteReq
 from ..manifest import Chunk, ChunkedTensorEntry, Shard, TensorEntry
-from .array import ArrayAssembly, ArrayBufferConsumer, ArrayIOPreparer
+from .array import (
+    _INTO_PLACE_MIN_BYTES,
+    ArrayAssembly,
+    ArrayBufferConsumer,
+    ArrayIOPreparer,
+)
 
 
 class ChunkedArrayIOPreparer:
@@ -124,6 +129,19 @@ class ChunkedArrayIOPreparer:
             flat_offset = chunk.offsets[0] * row_elems * itemsize if chunk.offsets else 0
             nbytes = serialization.array_nbytes(chunk.sizes, entry.dtype)
             tensor_entry = chunk.tensor
+            # Read-into-place: dim-0 chunks map to contiguous slices of the
+            # assembly, so storage can land the bytes directly.  The size
+            # guard matters: small chunks (tail chunks, small-knob
+            # snapshots) live in slabs whose adjacent ranged reads should
+            # keep merging — an `into` req is never merged.
+            into = None
+            if nbytes >= _INTO_PLACE_MIN_BYTES:
+                try:
+                    into = memoryview(assembly.flat_u8())[
+                        flat_offset : flat_offset + nbytes
+                    ]
+                except Exception:
+                    into = None
             read_reqs.append(
                 ReadReq(
                     path=tensor_entry.location,
@@ -134,7 +152,9 @@ class ChunkedArrayIOPreparer:
                         nbytes=nbytes,
                         checksum=tensor_entry.checksum,
                         location=tensor_entry.location,
+                        into=into,
                     ),
+                    into=into,
                 )
             )
         assembly.expect(len(read_reqs))
