@@ -120,17 +120,9 @@ class ArrayIOPreparer:
         assembly = ArrayAssembly(entry=entry, obj_out=obj_out)
         total_bytes = serialization.array_nbytes(entry.shape, entry.dtype)
 
-        def _into_view(offset: int, nbytes: int) -> Optional[memoryview]:
-            # Read-into-place: hand storage the assembly's own memory so fs
-            # preads land the bytes directly (no allocation, no consume
-            # memcpy).  Only worth a syscall-per-request for sizable reads;
-            # small entries keep the merge-and-copy slab path.
-            if nbytes < _INTO_PLACE_MIN_BYTES:
-                return None
-            try:
-                return memoryview(assembly.flat_u8())[offset : offset + nbytes]
-            except Exception:
-                return None
+        # Read-into-place: hand storage the assembly's own memory so fs
+        # preads land the bytes directly (no allocation, no consume memcpy).
+        _into_view = assembly.into_view
 
         if (
             buffer_size_limit_bytes is None
@@ -266,6 +258,18 @@ class ArrayAssembly:
     def flat_u8(self) -> np.ndarray:
         arr = self.host if self.host.ndim > 0 else self.host.reshape(1)
         return arr.view(np.uint8).reshape(-1)
+
+    def into_view(self, offset: int, nbytes: int) -> Optional[memoryview]:
+        """Read-into-place view of ``[offset, offset+nbytes)`` of this
+        assembly, or None when not worth it (below the size threshold —
+        small reads should keep merging in the batcher) or not possible.
+        The single policy point for the dense and chunked read paths."""
+        if nbytes < _INTO_PLACE_MIN_BYTES:
+            return None
+        try:
+            return memoryview(self.flat_u8())[offset : offset + nbytes]
+        except Exception:
+            return None
 
     def piece_done(self) -> None:
         self._pending -= 1

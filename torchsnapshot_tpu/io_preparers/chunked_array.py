@@ -24,12 +24,7 @@ import numpy as np
 from .. import serialization
 from ..io_types import Future, ReadReq, WriteReq
 from ..manifest import Chunk, ChunkedTensorEntry, Shard, TensorEntry
-from .array import (
-    _INTO_PLACE_MIN_BYTES,
-    ArrayAssembly,
-    ArrayBufferConsumer,
-    ArrayIOPreparer,
-)
+from .array import ArrayAssembly, ArrayBufferConsumer, ArrayIOPreparer
 
 
 class ChunkedArrayIOPreparer:
@@ -130,18 +125,9 @@ class ChunkedArrayIOPreparer:
             nbytes = serialization.array_nbytes(chunk.sizes, entry.dtype)
             tensor_entry = chunk.tensor
             # Read-into-place: dim-0 chunks map to contiguous slices of the
-            # assembly, so storage can land the bytes directly.  The size
-            # guard matters: small chunks (tail chunks, small-knob
-            # snapshots) live in slabs whose adjacent ranged reads should
-            # keep merging — an `into` req is never merged.
-            into = None
-            if nbytes >= _INTO_PLACE_MIN_BYTES:
-                try:
-                    into = memoryview(assembly.flat_u8())[
-                        flat_offset : flat_offset + nbytes
-                    ]
-                except Exception:
-                    into = None
+            # assembly, so storage can land the bytes directly (assembly
+            # owns the policy — small chunks keep the slab merge path).
+            into = assembly.into_view(flat_offset, nbytes)
             read_reqs.append(
                 ReadReq(
                     path=tensor_entry.location,

@@ -63,7 +63,7 @@ def is_partially_replicated_entry(entry: Entry) -> bool:
         return False
     if entry.mesh_shape is None or entry.partition_spec is None:
         return False
-    sharded_axes = {a for dim in entry.partition_spec for a in dim}
+    sharded_axes = {a for dim in entry.partition_spec for a in (dim or [])}
     assert entry.axis_names is not None
     return 0 < len(sharded_axes) < len(entry.axis_names)
 
@@ -93,7 +93,7 @@ def get_replicated_rank_sets(entry: ShardedArrayEntry, world_size: int) -> List[
     rank_grid = (
         np.arange(n_devices).reshape(entry.mesh_shape) // devices_per_rank
     )
-    sharded_axes = {a for dim in entry.partition_spec for a in dim}
+    sharded_axes = _sharded_axes(entry.partition_spec)
     slices_per_dim = []
     for axis_name, size in zip(entry.axis_names, entry.mesh_shape):
         if axis_name in sharded_axes:
