@@ -2,7 +2,6 @@
 scheduler.py:222-447)."""
 
 import asyncio
-from typing import Optional
 
 import pytest
 

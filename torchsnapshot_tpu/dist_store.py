@@ -28,7 +28,7 @@ import os
 import tempfile
 import time
 import uuid
-from typing import List, Optional
+from typing import Optional
 
 
 class StorePeerError(RuntimeError):

@@ -16,7 +16,6 @@ one chunk, not the whole array.
 
 from __future__ import annotations
 
-import math
 from typing import Any, List, Optional, Tuple
 
 import numpy as np

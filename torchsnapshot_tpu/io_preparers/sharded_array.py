@@ -44,7 +44,7 @@ from ..io_types import (
 )
 from ..manifest import Shard, ShardedArrayEntry, TensorEntry
 from ..serialization import Serializer
-from .array import ArrayBufferStager, ArrayIOPreparer, _INTO_PLACE_MIN_BYTES
+from .array import ArrayIOPreparer, _INTO_PLACE_MIN_BYTES
 
 
 def _subdivide(

@@ -19,7 +19,6 @@ Design is idiomatic JAX, not a port:
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Dict, Optional
 
 import jax

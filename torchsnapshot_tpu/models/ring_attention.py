@@ -22,7 +22,6 @@ communication schedule, which shard_map expresses exactly.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -5,7 +5,6 @@ a thread at a fixed interval."""
 from __future__ import annotations
 
 import threading
-import time
 from contextlib import contextmanager
 from typing import Generator, List
 

@@ -10,7 +10,6 @@ group.
 from __future__ import annotations
 
 from importlib.metadata import entry_points
-from typing import Optional
 
 from .io_types import StoragePlugin
 

@@ -14,7 +14,6 @@ from __future__ import annotations
 import functools
 import multiprocessing as mp
 import os
-import sys
 import tempfile
 import traceback
 from typing import Any, Callable, Dict
