@@ -66,6 +66,7 @@ def test_parallel_into_reads_saturating_io_pool(tmp_path, monkeypatch):
 
     monkeypatch.setattr(fs_mod, "_PARALLEL_READ_MIN_BYTES", 1024)
     monkeypatch.setattr(fs_mod, "_PARALLEL_READ_CHUNK", 512)
+    monkeypatch.setenv("TPUSNAP_PARALLEL_READ_WAYS", "8")
     plugin = FSStoragePlugin(root=str(tmp_path))
     if plugin._native is None:
         import pytest

@@ -25,6 +25,7 @@ ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR = (
     _ENV_PREFIX + "ENABLE_SHARDED_ARRAY_ELASTICITY_ROOT_ONLY"
 )
 MAX_READ_MERGE_GAP_ENV_VAR = _ENV_PREFIX + "MAX_READ_MERGE_GAP_BYTES"
+PARALLEL_READ_WAYS_ENV_VAR = _ENV_PREFIX + "PARALLEL_READ_WAYS"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -66,6 +67,14 @@ def get_max_per_rank_io_concurrency() -> int:
 
 def is_batching_disabled() -> bool:
     return _get_bool_env(DISABLE_BATCHING_ENV_VAR)
+
+
+def get_parallel_read_ways() -> int:
+    """Intra-file chunk parallelism for large into-place reads (1 = one
+    sequential pread, the default).  Sequential preads ride kernel
+    readahead, which measured 2.6x faster cold on a virtual disk; NVMe
+    arrays with real queue depth may prefer 4-8."""
+    return _get_int_env(PARALLEL_READ_WAYS_ENV_VAR, 1)
 
 
 def get_max_read_merge_gap_bytes() -> int:
@@ -147,4 +156,10 @@ def override_per_rank_memory_budget_bytes(value: int) -> Generator[None, None, N
 @contextmanager
 def override_max_read_merge_gap_bytes(value: int) -> Generator[None, None, None]:
     with _override_env(MAX_READ_MERGE_GAP_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_parallel_read_ways(value: int) -> Generator[None, None, None]:
+    with _override_env(PARALLEL_READ_WAYS_ENV_VAR, str(value)):
         yield
