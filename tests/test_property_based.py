@@ -1,0 +1,198 @@
+"""Property-based tests (hypothesis) for the pure math the framework's
+correctness rests on: flatten/inflate reversibility, overlap-region
+resharding, chunking coverage, and the streaming-softmax merge."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+# ---------------------------------------------------------------- flatten
+
+
+_key_st = st.text(
+    alphabet=st.characters(codec="utf-8", exclude_characters="\x00"),
+    min_size=0,
+    max_size=12,
+)
+_leaf_st = st.one_of(
+    st.integers(-(10**9), 10**9),
+    st.floats(allow_nan=False, allow_infinity=False, width=64),
+    st.text(max_size=8),
+    st.booleans(),
+    st.none(),
+)
+
+
+def _tree_st(depth: int):
+    if depth == 0:
+        return _leaf_st
+    child = _tree_st(depth - 1)
+    return st.one_of(
+        _leaf_st,
+        st.lists(child, max_size=3),
+        st.dictionaries(_key_st, child, max_size=3),
+    )
+
+
+@settings(max_examples=150, deadline=None)
+@given(tree=st.dictionaries(_key_st, _tree_st(3), max_size=4))
+def test_flatten_inflate_roundtrip(tree):
+    """flatten → inflate is the identity for any nesting of dicts/lists with
+    hostile keys (slashes, percents, ints-as-strings, empties)."""
+    from torchsnapshot_tpu.flatten import flatten, inflate
+
+    manifest, leaves = flatten(tree)
+    rebuilt = inflate(manifest, dict(leaves))
+    assert rebuilt == tree
+
+
+# ------------------------------------------------------- overlap resharding
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    data=st.data(),
+    ndim=st.integers(1, 3),
+)
+def test_arbitrary_resharding_overlap_math(data, ndim):
+    """Save any shard partition of a small array, read back through any
+    other partition via the overlap engine: every target element must come
+    from the matching source element (exercised as pure math, no storage)."""
+    from torchsnapshot_tpu.io_preparers.sharded_array import (
+        _box_slices,
+        _overlap,
+    )
+
+    shape = [
+        data.draw(st.integers(1, 6), label=f"dim{i}") for i in range(ndim)
+    ]
+    arr = np.arange(int(np.prod(shape))).reshape(shape)
+
+    def draw_partition(label):
+        # split each dim at sorted random cut points -> a grid partition
+        grids = []
+        for size in shape:
+            n_cuts = data.draw(st.integers(0, min(2, size - 1)), label=label)
+            cuts = sorted(
+                data.draw(
+                    st.lists(
+                        st.integers(1, size - 1),
+                        min_size=n_cuts,
+                        max_size=n_cuts,
+                        unique=True,
+                    ),
+                    label=label + "_cuts",
+                )
+                if size > 1
+                else []
+            )
+            bounds = [0] + cuts + [size]
+            grids.append(
+                [(bounds[i], bounds[i + 1] - bounds[i]) for i in range(len(bounds) - 1)]
+            )
+        boxes = [[]]
+        for dim_options in grids:
+            boxes = [b + [seg] for b in boxes for seg in dim_options]
+        return [
+            ([seg[0] for seg in box], [seg[1] for seg in box]) for box in boxes
+        ]
+
+    saved = draw_partition("saved")
+    targets = draw_partition("target")
+
+    out = np.full(shape, -1, dtype=arr.dtype)
+    for t_off, t_sz in targets:
+        target_view = out[_box_slices(t_off, t_sz, [0] * ndim)]
+        for s_off, s_sz in saved:
+            ov = _overlap(s_off, s_sz, t_off, t_sz)
+            if ov is None:
+                continue
+            ov_off, ov_sz = ov
+            src = arr[_box_slices(s_off, s_sz, [0] * ndim)]
+            target_view[_box_slices(ov_off, ov_sz, t_off)] = src[
+                _box_slices(ov_off, ov_sz, s_off)
+            ]
+    np.testing.assert_array_equal(out, arr)
+
+
+# ----------------------------------------------------------------- chunking
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    rows=st.integers(1, 500),
+    cols=st.integers(1, 64),
+    chunk_bytes=st.integers(1, 1 << 16),
+)
+def test_chunk_instructions_partition_exactly(rows, cols, chunk_bytes):
+    """Chunks tile dim 0 exactly: disjoint, ordered, covering, sized."""
+    from torchsnapshot_tpu.io_preparers.chunked_array import (
+        ChunkedArrayIOPreparer,
+    )
+
+    chunks = ChunkedArrayIOPreparer.chunk_instructions(
+        [rows, cols], np.float32, chunk_bytes
+    )
+    covered = 0
+    for chunk in chunks:
+        assert chunk.offsets[0] == covered
+        assert chunk.sizes[1] == cols
+        covered += chunk.sizes[0]
+    assert covered == rows
+    if len(chunks) > 1:
+        row_bytes = cols * 4
+        for chunk in chunks[:-1]:
+            assert chunk.sizes[0] * row_bytes <= max(chunk_bytes, row_bytes)
+
+
+# ------------------------------------------------- streaming softmax merge
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    n_blocks=st.integers(1, 4),
+    rows=st.integers(1, 6),
+    seed=st.integers(0, 2**31 - 1),
+)
+def test_streaming_softmax_merge_matches_full(n_blocks, rows, seed):
+    """Merging per-block (max, sum, weighted acc) across arbitrary splits
+    equals the softmax over the concatenation — the invariant ring
+    attention's accumulation relies on."""
+    rng = np.random.RandomState(seed)
+    blocks = [rng.randn(rows, rng.randint(1, 5)) * 5 for _ in range(n_blocks)]
+    full = np.concatenate(blocks, axis=1)
+    values = [rng.randn(b.shape[1], 3) for b in blocks]
+    v_full = np.concatenate(values, axis=0)
+
+    expected = (
+        np.exp(full - full.max(axis=1, keepdims=True))
+        / np.exp(full - full.max(axis=1, keepdims=True)).sum(
+            axis=1, keepdims=True
+        )
+    ) @ v_full
+
+    m_run = np.full((rows,), -np.inf)
+    l_run = np.zeros((rows,))
+    acc = np.zeros((rows, 3))
+    for logits, v in zip(blocks, values):
+        m_blk = logits.max(axis=1)
+        p = np.exp(logits - m_blk[:, None])
+        l_blk = p.sum(axis=1)
+        out = p @ v
+        m_new = np.maximum(m_run, m_blk)
+        alpha = np.where(np.isfinite(m_run), np.exp(m_run - m_new), 0.0)
+        beta = np.exp(m_blk - m_new)
+        l_run = l_run * alpha + l_blk * beta
+        acc = acc * alpha[:, None] + out * beta[:, None]
+        m_run = m_new
+    np.testing.assert_allclose(acc / l_run[:, None], expected, rtol=1e-9, atol=1e-9)
+
+
+def test_inflate_reads_legacy_empty_key_components():
+    """Snapshots written before the %0 empty-key marker stored nested empty
+    keys as bare '' path components; inflate still restores them."""
+    from torchsnapshot_tpu.flatten import inflate
+    from torchsnapshot_tpu.manifest import DictEntry
+
+    manifest = {"": DictEntry(keys=["a"]), "a": DictEntry(keys=["", "b"])}
+    leaves = {"a/": 1, "a/b": 2}  # legacy layout
+    assert inflate(manifest, leaves) == {"a": {"": 1, "b": 2}}

@@ -31,10 +31,19 @@ STATE_DICT_KEY_SEPARATOR = "/"
 
 
 def _encode(component: str) -> str:
+    if component == "":
+        # An empty key would produce a path equal to its parent container's
+        # own path, silently overwriting the container entry (data loss the
+        # reference grammar shares; found by the hypothesis round trip).
+        # "%0" cannot collide: escaping only ever emits %25/%2F, and a
+        # literal "%0" key escapes to "%250".
+        return "%0"
     return component.replace("%", "%25").replace("/", "%2F")
 
 
 def _decode(component: str) -> str:
+    if component == "%0":
+        return ""
     return component.replace("%2F", "/").replace("%25", "%")
 
 
@@ -157,6 +166,12 @@ def inflate(
             result = cls()
             for key in entry.keys:
                 component = _encode(str(key))
+                if component not in kid_map and str(key) == "":
+                    # Snapshots written before the "%0" empty-key marker
+                    # stored nested empty keys as bare "" components (which
+                    # round-tripped except at root level) — keep restoring
+                    # them.
+                    component = ""
                 if component in kid_map:
                     result[key] = kid_map[component]
         else:  # pragma: no cover - future container types
