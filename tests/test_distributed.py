@@ -540,3 +540,37 @@ def test_distributed_take_restore_on_s3(monkeypatch):
         )
     finally:
         server.stop()
+
+
+def test_rank_death_mid_take_times_out_without_commit(tmp_path):
+    """A peer process dying mid-take must surface as TimeoutError on the
+    survivor (the blocking-barrier deadline) and the snapshot must NOT
+    commit — the torn-snapshot signal stays a missing metadata file.
+    Storage faults were already injected; this is the process-death class."""
+    import multiprocessing as mp
+    import shutil
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.dist_store import FileStore
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    store_path = str(tmp_path / "store")
+    snap_path = str(tmp_path / "snap")
+    shutil.rmtree(snap_path, ignore_errors=True)
+
+    def doomed(rank):
+        # Rank 1 exits hard before ever joining the take: simulates a crash.
+        os._exit(1)
+
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=doomed, args=(1,))
+    p.start()
+    p.join()
+
+    pg = PGWrapper(
+        store=FileStore(store_path), rank=0, world_size=2, timeout_s=2.0
+    )
+    app = {"m": StateDict({"w": np.ones(64, np.float32)})}
+    with pytest.raises(TimeoutError):
+        Snapshot.take(snap_path, app, pg=pg)
+    assert not os.path.exists(os.path.join(snap_path, ".snapshot_metadata"))
