@@ -91,6 +91,7 @@ def _install_watchdog() -> None:
     import signal
 
     budget_s = int(os.environ.get("BENCH_MAX_S", 540))
+    _PARTIAL["alarm_armed_at"] = time.monotonic()
 
     def _on_alarm(signum, frame):
         result = {
@@ -128,13 +129,49 @@ def main() -> None:
 
     log(f"devices: {devices}")
 
+    # Raw device->host link bandwidth first (the hardware ceiling for
+    # staging): one 64 MiB transfer via the same fast path the stagers use.
+    # Measured early so the state can be sized to the link — a tunneled TPU
+    # at ~20 MB/s must not get a 2 GiB state that blows the watchdog
+    # mid-save.
+    from torchsnapshot_tpu import staging as _staging
+
+    _PARTIAL["phase"] = "link_probe"
+    # Untimed warm transfer first: the probe must not charge one-time costs
+    # (bitcast-kernel compile, native-lib init) to the link.
+    warm = jax.block_until_ready(jnp.ones((256, 256), jnp.bfloat16))
+    _staging.to_host(warm)
+    probe = jax.block_until_ready(
+        jax.jit(lambda k: jax.random.normal(k, (8192, 4096), jnp.bfloat16))(
+            jax.random.key(99)
+        )
+    )
+    t0 = time.monotonic()
+    _staging.to_host(probe)
+    link_gbps = probe.size * 2 / 1e9 / (time.monotonic() - t0)
+    log(f"raw D2H link: {link_gbps:.3f} GB/s")
+
     # ~2 GiB of bf16 params (1B params) on one chip, as stacked layer arrays
     # (mirrors the flagship model's layout: few large arrays, the MXU- and
-    # DMA-friendly shape).  2 GiB default so a >1 GB/s pipeline measures
-    # multi-second phases, not noise; a wedged-transport fallback shrinks to
-    # 512 MiB so the run still finishes over a ~20 MB/s tunnel.  Override
-    # with BENCH_TARGET_BYTES either way.
-    default_bytes = 512 << 20 if _BACKEND["name"] == "cpu_fallback" else 2048 << 20
+    # DMA-friendly shape).  2 GiB so a >1 GB/s pipeline measures
+    # multi-second phases, not noise — scaled down when the measured link
+    # couldn't move 2 GiB through every benchmark phase inside the watchdog
+    # budget (each byte crosses the link ~6x: 3 saves, async, 2 restores).
+    # Override with BENCH_TARGET_BYTES either way.
+    if _BACKEND["name"] == "cpu_fallback":
+        default_bytes = 512 << 20
+    else:
+        budget_s = int(os.environ.get("BENCH_MAX_S", 540))
+        # The watchdog was armed before device probing; flaky-transport
+        # retries may already have burned part of the budget.
+        armed_at = _PARTIAL.get("alarm_armed_at")
+        remaining_s = (
+            budget_s - (time.monotonic() - armed_at)
+            if armed_at is not None
+            else budget_s
+        )
+        link_budget = int(link_gbps * 1e9 * max(remaining_s, 30) * 0.6 / 6)
+        default_bytes = max(64 << 20, min(2048 << 20, link_budget))
     target_bytes = int(os.environ.get("BENCH_TARGET_BYTES", default_bytes))
     n_arrays = 8
     per_array = target_bytes // n_arrays // 2  # bf16 = 2 bytes
@@ -160,20 +197,6 @@ def main() -> None:
     warm_state = {"model": StateDict({"w": jnp.ones((128, 128), jnp.bfloat16)})}
     Snapshot.take(os.path.join(workdir, "warmup"), warm_state)
     shutil.rmtree(os.path.join(workdir, "warmup"), ignore_errors=True)
-
-    # Raw device->host link bandwidth (the hardware ceiling for staging): one
-    # 64 MiB transfer via the same fast path the stagers use.
-    from torchsnapshot_tpu import staging as _staging
-
-    probe = jax.block_until_ready(
-        jax.jit(lambda k: jax.random.normal(k, (8192, 4096), jnp.bfloat16))(
-            jax.random.key(99)
-        )
-    )
-    t0 = time.monotonic()
-    _staging.to_host(probe)
-    link_gbps = probe.size * 2 / 1e9 / (time.monotonic() - t0)
-    log(f"raw D2H link: {link_gbps:.3f} GB/s")
 
     from torchsnapshot_tpu import phase_stats
 
