@@ -264,7 +264,7 @@ def main() -> None:
     }
     restore_attempts_s = []
     restore_phases = {}
-    for attempt in range(min(attempts, 2)):
+    for attempt in range(attempts):
         _drain_writeback()
         phase_stats.reset()
         begin = time.monotonic()
