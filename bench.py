@@ -31,55 +31,141 @@ def log(msg: str) -> None:
 _BACKEND = {"name": "unknown", "fallback_reason": None}
 
 
+_PROBE_CODE = (
+    "import jax, sys;"
+    "d = jax.devices();"
+    "sys.stdout.write(','.join(x.platform for x in d))"
+)
+
+
+def _probe_once(timeout_s: float):
+    """One subprocess device probe.  Returns (platforms|None, error|None)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            timeout=timeout_s,
+            check=True,
+            capture_output=True,
+            text=True,
+        )
+        return proc.stdout.strip(), None
+    except subprocess.TimeoutExpired:
+        return None, f"device init timed out after {timeout_s:.0f}s"
+    except subprocess.CalledProcessError as e:
+        tail = (e.stderr or "").strip().splitlines()
+        return None, f"device init failed: {tail[-1] if tail else 'no stderr'}"
+
+
+def _watchdog_remaining_s() -> float:
+    budget_s = int(os.environ.get("BENCH_MAX_S", 540))
+    armed_at = _PARTIAL.get("alarm_armed_at")
+    if armed_at is None:
+        return float(budget_s)
+    return budget_s - (time.monotonic() - armed_at)
+
+
 def _init_devices():
     """Probe backend health in a subprocess first: if the TPU transport is
     wedged (device init hangs), fall back to CPU in THIS process before any
     backend is touched, so the benchmark always reports a result.
 
-    The probe retries with backoff (a flaky tunnel can recover between
-    attempts) and records WHAT failed; the fallback is stamped into the
-    result JSON as a top-level ``backend: cpu_fallback`` — a CPU number must
-    never masquerade as an accelerator number (round-1 verdict item)."""
-    import subprocess
-
+    The probe timeout is sized to the watchdog budget (round-2 verdict: a
+    fixed 3x90 s schedule gave up while leaving most of the budget unused):
+    one long attempt at ~55% of the remaining budget, then a short retry.
+    A flaky tunnel that recovers AFTER fallback is caught by the re-probe in
+    ``main`` once the CPU run has banked a result (see ``_maybe_rerun_on_tpu``).
+    The fallback is stamped into the result JSON as a top-level
+    ``backend: cpu_fallback`` — a CPU number must never masquerade as an
+    accelerator number (round-1 verdict item)."""
     import jax
 
-    timeout_s = float(os.environ.get("BENCH_DEVICE_TIMEOUT_S", 90))
-    attempts = int(os.environ.get("BENCH_DEVICE_ATTEMPTS", 3))
-    probe_code = (
-        "import jax, sys;"
-        "d = jax.devices();"
-        "sys.stdout.write(','.join(x.platform for x in d))"
+    remaining = max(_watchdog_remaining_s(), 60.0)
+    long_probe = float(
+        os.environ.get("BENCH_DEVICE_TIMEOUT_S", min(300.0, remaining * 0.55))
     )
+    # Long attempt first, then one short retry if budget allows.
+    schedule = [long_probe]
+    if remaining - long_probe > 120:
+        schedule.append(45.0)
     last_error = None
-    for attempt in range(attempts):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", probe_code],
-                timeout=timeout_s,
-                check=True,
-                capture_output=True,
-                text=True,
-            )
-            platforms = proc.stdout.strip()
+    for attempt, timeout_s in enumerate(schedule):
+        platforms, last_error = _probe_once(timeout_s)
+        if platforms is not None:
             _BACKEND["name"] = (
-                "cpu" if platforms and set(platforms.split(",")) == {"cpu"} else "tpu"
+                "cpu" if set(platforms.split(",")) == {"cpu"} else "tpu"
             )
             log(f"device probe ok (attempt {attempt + 1}): platforms={platforms}")
             return jax.devices()
-        except subprocess.TimeoutExpired:
-            last_error = f"device init timed out after {timeout_s:.0f}s"
-        except subprocess.CalledProcessError as e:
-            tail = (e.stderr or "").strip().splitlines()
-            last_error = f"device init failed: {tail[-1] if tail else 'no stderr'}"
-        log(f"device probe attempt {attempt + 1}/{attempts} failed: {last_error}")
-        if attempt + 1 < attempts:
-            time.sleep(min(15 * (attempt + 1), 45))
+        log(
+            f"device probe attempt {attempt + 1}/{len(schedule)} "
+            f"(timeout {timeout_s:.0f}s) failed: {last_error}"
+        )
     log("TPU backend unavailable; falling back to CPU backend")
     _BACKEND["name"] = "cpu_fallback"
     _BACKEND["fallback_reason"] = last_error
     jax.config.update("jax_platforms", "cpu")
     return jax.devices()
+
+
+def _maybe_rerun_on_tpu(cpu_result: dict) -> dict:
+    """After a CPU-fallback run banked a result, re-probe the accelerator and
+    — if the tunnel recovered mid-run — re-exec the benchmark on TPU with the
+    remaining watchdog budget (round-2 verdict item: the probe never retried
+    after fallback, so a recovering tunnel was never caught).
+
+    Returns the result dict to print: the TPU child's (with the CPU numbers
+    preserved in aux) when the re-run lands, else ``cpu_result``."""
+    import subprocess
+
+    if os.environ.get("BENCH_NO_RERUN"):
+        return cpu_result
+    remaining = _watchdog_remaining_s()
+    if remaining < 90:
+        log(f"no TPU re-probe: only {remaining:.0f}s of watchdog budget left")
+        return cpu_result
+    platforms, err = _probe_once(min(45.0, remaining * 0.3))
+    if platforms is None or set(platforms.split(",")) == {"cpu"}:
+        log(f"post-run TPU re-probe: still unavailable ({err or platforms})")
+        return cpu_result
+    remaining = _watchdog_remaining_s()
+    log(f"tunnel recovered; re-running on TPU with {remaining:.0f}s budget")
+    env = dict(os.environ)
+    env["BENCH_NO_RERUN"] = "1"
+    env["BENCH_MAX_S"] = str(max(int(remaining) - 15, 60))
+    env["BENCH_DEVICE_TIMEOUT_S"] = "60"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            timeout=max(remaining - 5, 60),
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log("TPU re-run timed out; keeping CPU-fallback result")
+        return cpu_result
+    sys.stderr.write(proc.stderr)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            child = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if child.get("backend") == "tpu" and not child.get("aux", {}).get(
+            "incomplete"
+        ):
+            child.setdefault("aux", {})["cpu_fallback_first"] = {
+                "value": cpu_result["value"],
+                "aux": cpu_result["aux"],
+            }
+            return child
+        # An incomplete/partial TPU attempt must not displace a banked,
+        # complete CPU run (its headline can be 0.0) — keep it as evidence.
+        cpu_result.setdefault("aux", {})["tpu_rerun_partial"] = child
+        break
+    log("TPU re-run did not produce a complete TPU result; keeping CPU numbers")
+    return cpu_result
 
 
 _PARTIAL = {"save_gbps": 0.0, "phase": "init"}
@@ -156,21 +242,16 @@ def main() -> None:
     # DMA-friendly shape).  2 GiB so a >1 GB/s pipeline measures
     # multi-second phases, not noise — scaled down when the measured link
     # couldn't move 2 GiB through every benchmark phase inside the watchdog
-    # budget (each byte crosses the link ~6x: 3 saves, async, 2 restores).
-    # Override with BENCH_TARGET_BYTES either way.
+    # budget (each byte crosses the link ~8x: first save D2H + 3 fresh async
+    # stagings + 3 restore H2Ds, plus slack).  Override with
+    # BENCH_TARGET_BYTES either way.
     if _BACKEND["name"] == "cpu_fallback":
         default_bytes = 512 << 20
     else:
-        budget_s = int(os.environ.get("BENCH_MAX_S", 540))
         # The watchdog was armed before device probing; flaky-transport
         # retries may already have burned part of the budget.
-        armed_at = _PARTIAL.get("alarm_armed_at")
-        remaining_s = (
-            budget_s - (time.monotonic() - armed_at)
-            if armed_at is not None
-            else budget_s
-        )
-        link_budget = int(link_gbps * 1e9 * max(remaining_s, 30) * 0.6 / 6)
+        remaining_s = _watchdog_remaining_s()
+        link_budget = int(link_gbps * 1e9 * max(remaining_s, 30) * 0.6 / 8)
         default_bytes = max(64 << 20, min(2048 << 20, link_budget))
     target_bytes = int(os.environ.get("BENCH_TARGET_BYTES", default_bytes))
     n_arrays = 8
@@ -220,6 +301,7 @@ def main() -> None:
     save_attempts_s = []
     snapshot = None
     save_phases = {}
+    best_save_s = float("inf")
     for attempt in range(attempts):
         snap_path = os.path.join(workdir, "snap")
         shutil.rmtree(snap_path, ignore_errors=True)
@@ -229,31 +311,59 @@ def main() -> None:
         snapshot = Snapshot.take(snap_path, app_state)
         elapsed = time.monotonic() - begin
         save_attempts_s.append(round(elapsed, 2))
-        if elapsed <= min(save_attempts_s):
+        if elapsed < best_save_s:
+            best_save_s = elapsed
             save_phases = phase_stats.snapshot()
-        _PARTIAL["save_gbps"] = actual_bytes / 1e9 / min(save_attempts_s)
+        _PARTIAL["save_gbps"] = actual_bytes / 1e9 / best_save_s
     save_s = min(save_attempts_s)
     save_gbps = actual_bytes / 1e9 / save_s
-    _PARTIAL["phase"] = "async_save"
     log(f"sync save: {save_s:.2f}s -> {save_gbps:.2f} GB/s (runs: {save_attempts_s})")
     log(f"  save phases: {phase_stats.format_line(save_phases)}")
 
-    # --- async save: training-blocked time ---
-    # Fresh arrays: jax caches host copies after the sync save, which would
-    # fake the staging cost.
-    arrays2 = jax.block_until_ready(make(jax.random.key(1)))
-    app_state2 = {"model": StateDict({f"w{i}": a for i, a in enumerate(arrays2)})}
-    async_path = os.path.join(workdir, "snap_async")
-    shutil.rmtree(async_path, ignore_errors=True)
-    _drain_writeback()
-    begin = time.monotonic()
-    pending = Snapshot.async_take(async_path, app_state2)
-    stall_s = time.monotonic() - begin
-    pending.wait()
-    async_total_s = time.monotonic() - begin
+    # --- async save: training-blocked time, best of N ---
+    # Round-2 verdict: a single async run recorded 11.87 s total vs 0.23 s
+    # best-of-3 sync — cold-start apples vs warm oranges.  Async now gets the
+    # same best-of-N treatment (fresh arrays per attempt: jax caches host
+    # copies, which would fake the staging cost), with per-attempt
+    # (stall, total) pairs and phase attribution so the stall can be checked
+    # against the measured d2h time.
+    _PARTIAL["phase"] = "async_save"
+    async_attempts = []
+    async_phases = {}
+    best_async_total_s = float("inf")
+    stall_s = 0.0
+    arrays2 = app_state2 = pending = None
+    for attempt in range(attempts):
+        # Drop the previous attempt's arrays BEFORE allocating fresh ones:
+        # holding both alongside the original state would peak at ~3x the
+        # state size in device memory and OOM small-HBM chips.
+        arrays2 = app_state2 = pending = None
+        arrays2 = jax.block_until_ready(make(jax.random.key(100 + attempt)))
+        app_state2 = {
+            "model": StateDict({f"w{i}": a for i, a in enumerate(arrays2)})
+        }
+        async_path = os.path.join(workdir, "snap_async")
+        shutil.rmtree(async_path, ignore_errors=True)
+        _drain_writeback()
+        phase_stats.reset()
+        begin = time.monotonic()
+        pending = Snapshot.async_take(async_path, app_state2)
+        attempt_stall_s = time.monotonic() - begin
+        pending.wait()
+        attempt_total_s = time.monotonic() - begin
+        async_attempts.append(
+            {"stall_s": round(attempt_stall_s, 2), "total_s": round(attempt_total_s, 2)}
+        )
+        if attempt_total_s < best_async_total_s:
+            best_async_total_s = attempt_total_s
+            stall_s = attempt_stall_s
+            async_phases = phase_stats.snapshot()
+    async_total_s = best_async_total_s
+    async_d2h_s = async_phases.get("d2h", {}).get("s", 0.0)
     log(
         f"async save: blocked {stall_s:.2f}s of {async_total_s:.2f}s total "
-        f"(stall = D2H staging only)"
+        f"(stall = D2H staging only; measured d2h {async_d2h_s:.2f}s; "
+        f"attempts: {async_attempts})"
     )
 
     # --- restore ---
@@ -264,6 +374,7 @@ def main() -> None:
     }
     restore_attempts_s = []
     restore_phases = {}
+    best_restore_s = float("inf")
     for attempt in range(attempts):
         _drain_writeback()
         phase_stats.reset()
@@ -271,7 +382,8 @@ def main() -> None:
         snapshot.restore(dst)
         elapsed = time.monotonic() - begin
         restore_attempts_s.append(round(elapsed, 2))
-        if elapsed <= min(restore_attempts_s):
+        if elapsed < best_restore_s:
+            best_restore_s = elapsed
             restore_phases = phase_stats.snapshot()
     restore_s = min(restore_attempts_s)
     log(
@@ -311,6 +423,9 @@ def main() -> None:
             "restore_attempts_s": restore_attempts_s,
             "async_stall_s": round(stall_s, 2),
             "async_total_s": round(async_total_s, 2),
+            "async_attempts": async_attempts,
+            "async_d2h_s": round(async_d2h_s, 2),
+            "async_phases": _phases_brief(async_phases),
             "restore_s": round(restore_s, 2),
             "restore_gbps": round(actual_bytes / 1e9 / restore_s, 3),
             "raw_d2h_link_gbps": round(link_gbps, 3),
@@ -323,6 +438,8 @@ def main() -> None:
             "restore_phases": _phases_brief(restore_phases),
         },
     }
+    if _BACKEND["name"] == "cpu_fallback":
+        result = _maybe_rerun_on_tpu(result)
     print(json.dumps(result), flush=True)
 
 
