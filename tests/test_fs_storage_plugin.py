@@ -124,3 +124,53 @@ def test_parallel_into_read_range_mismatch_raises(tmp_path, monkeypatch):
             )
         )
     plugin.sync_close()
+
+
+def test_into_read_strategy_selection(tmp_path, monkeypatch):
+    """Auto mode: checksummed into-reads always take the sequential fused
+    read+hash path; unchecksummed large reads A/B-measure sequential vs
+    parallel once, then the faster strategy sticks."""
+    import pytest
+
+    from torchsnapshot_tpu.storage_plugins import fs as fs_mod
+
+    monkeypatch.setattr(fs_mod, "_PARALLEL_READ_MIN_BYTES", 1024)
+    monkeypatch.setattr(fs_mod, "_PARALLEL_READ_CHUNK", 512)
+    monkeypatch.delenv("TPUSNAP_PARALLEL_READ_WAYS", raising=False)
+    data = bytes(range(256)) * 32  # 8 KiB
+
+    # Checksums enabled (default): fused hash comes back, no A/B sampling.
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    if plugin._native is None:
+        pytest.skip("native IO library unavailable")
+    plugin.sync_write(WriteIO(path="a.bin", buf=data))
+    read_io = ReadIO(
+        path="a.bin", into=memoryview(bytearray(len(data))), want_hash=True
+    )
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == data
+    assert read_io.hash64 == plugin._native.xxhash64(data)
+    assert plugin._seq_gbps is None and plugin._par_gbps is None
+
+    # Reads whose issuer did NOT ask for a digest (merged spanning reads,
+    # digest-less entries) must not pay for one.
+    io_nohash = ReadIO(path="a.bin", into=memoryview(bytearray(len(data))))
+    plugin.sync_read(io_nohash)
+    assert io_nohash.hash64 is None
+    plugin.sync_close()
+
+    # Checksums disabled: hash never computed even when asked; first large
+    # read measures sequential, second parallel, then the winner is used.
+    monkeypatch.setenv("TPUSNAP_CHECKSUM", "0")
+    plugin = FSStoragePlugin(root=str(tmp_path))
+    for i in range(3):
+        io_ = ReadIO(
+            path="a.bin",
+            into=memoryview(bytearray(len(data))),
+            want_hash=True,
+        )
+        plugin.sync_read(io_)
+        assert bytes(io_.buf) == data
+        assert io_.hash64 is None
+    assert plugin._seq_gbps is not None and plugin._par_gbps is not None
+    plugin.sync_close()

@@ -188,7 +188,11 @@ def cmd_verify(args: argparse.Namespace) -> int:
     ok = corrupt = unreadable = 0
     try:
         for (location, br), checksum in sorted(payloads.items()):
-            read_io = ReadIO(path=location, byte_range=list(br) if br else None)
+            read_io = ReadIO(
+                path=location,
+                byte_range=list(br) if br else None,
+                want_hash=True,  # the digest is exactly what we're here for
+            )
             try:
                 storage.sync_read(read_io)
             except Exception as e:  # noqa: BLE001
@@ -196,7 +200,7 @@ def cmd_verify(args: argparse.Namespace) -> int:
                 unreadable += 1
                 continue
             try:
-                verify(read_io.buf, checksum, location)
+                verify(read_io.buf, checksum, location, precomputed=read_io.hash64)
                 ok += 1
             except ChecksumError as e:
                 print(f"CORRUPT {e}")

@@ -470,27 +470,51 @@ static inline uint64_t xx_rotl(uint64_t x, int r) {
   return (x << r) | (x >> (64 - r));
 }
 
-uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
-  static const uint64_t P1 = 11400714785074694791ULL;
-  static const uint64_t P2 = 14029467366897019727ULL;
-  static const uint64_t P3 = 1609587929392839161ULL;
-  static const uint64_t P4 = 9650029242287828579ULL;
-  static const uint64_t P5 = 2870177450012600261ULL;
-  const uint8_t* p = static_cast<const uint8_t*>(data);
-  const uint8_t* end = p + len;
+static const uint64_t P1 = 11400714785074694791ULL;
+static const uint64_t P2 = 14029467366897019727ULL;
+static const uint64_t P3 = 1609587929392839161ULL;
+static const uint64_t P4 = 9650029242287828579ULL;
+static const uint64_t P5 = 2870177450012600261ULL;
+
+// Streaming state shared by the one-shot hasher and the fused read+hash:
+// any change to the stripe round or finalization applies to both, so
+// save-time and restore-time digests can never silently desync.
+struct XXState {
+  uint64_t v1, v2, v3, v4;
+};
+
+static inline void xx_init(XXState* s, uint64_t seed) {
+  s->v1 = seed + P1 + P2;
+  s->v2 = seed + P2;
+  s->v3 = seed;
+  s->v4 = seed - P1;
+}
+
+// Consumes n_stripes complete 32-byte stripes starting at p.
+static inline void xx_stripes(XXState* s, const uint8_t* p,
+                              int64_t n_stripes) {
+  uint64_t v1 = s->v1, v2 = s->v2, v3 = s->v3, v4 = s->v4;
+  for (int64_t i = 0; i < n_stripes; ++i) {
+    uint64_t k;
+    memcpy(&k, p, 8);      v1 = xx_rotl(v1 + k * P2, 31) * P1;
+    memcpy(&k, p + 8, 8);  v2 = xx_rotl(v2 + k * P2, 31) * P1;
+    memcpy(&k, p + 16, 8); v3 = xx_rotl(v3 + k * P2, 31) * P1;
+    memcpy(&k, p + 24, 8); v4 = xx_rotl(v4 + k * P2, 31) * P1;
+    p += 32;
+  }
+  s->v1 = v1; s->v2 = v2; s->v3 = v3; s->v4 = v4;
+}
+
+// Merges the stripe state (when total_len >= 32), mixes in the tail bytes
+// [tail, tail + tail_len), and avalanches.
+static uint64_t xx_finalize(const XXState* s, uint64_t seed,
+                            const uint8_t* tail, int64_t tail_len,
+                            int64_t total_len) {
   uint64_t h;
-  if (len >= 32) {
-    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
-    const uint8_t* limit = end - 32;
-    do {
-      uint64_t k;
-      memcpy(&k, p, 8); v1 = xx_rotl(v1 + k * P2, 31) * P1; p += 8;
-      memcpy(&k, p, 8); v2 = xx_rotl(v2 + k * P2, 31) * P1; p += 8;
-      memcpy(&k, p, 8); v3 = xx_rotl(v3 + k * P2, 31) * P1; p += 8;
-      memcpy(&k, p, 8); v4 = xx_rotl(v4 + k * P2, 31) * P1; p += 8;
-    } while (p <= limit);
-    h = xx_rotl(v1, 1) + xx_rotl(v2, 7) + xx_rotl(v3, 12) + xx_rotl(v4, 18);
-    uint64_t vs[4] = {v1, v2, v3, v4};
+  if (total_len >= 32) {
+    h = xx_rotl(s->v1, 1) + xx_rotl(s->v2, 7) + xx_rotl(s->v3, 12) +
+        xx_rotl(s->v4, 18);
+    uint64_t vs[4] = {s->v1, s->v2, s->v3, s->v4};
     for (uint64_t v : vs) {
       h ^= xx_rotl(v * P2, 31) * P1;
       h = h * P1 + P4;
@@ -498,7 +522,9 @@ uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
   } else {
     h = seed + P5;
   }
-  h += static_cast<uint64_t>(len);
+  h += static_cast<uint64_t>(total_len);
+  const uint8_t* p = tail;
+  const uint8_t* end = tail + tail_len;
   while (p + 8 <= end) {
     uint64_t k;
     memcpy(&k, p, 8);
@@ -524,6 +550,67 @@ uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
   h *= P3;
   h ^= h >> 32;
   return h;
+}
+
+// Number of 32-byte stripes the spec consumes for a payload of len bytes:
+// stripe starts run while start <= len - 32.
+static inline int64_t xx_n_stripes(int64_t len) {
+  return len < 32 ? 0 : (len - 32) / 32 + 1;
+}
+
+uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  XXState s;
+  xx_init(&s, seed);
+  int64_t n_stripes = xx_n_stripes(len);
+  xx_stripes(&s, p, n_stripes);
+  int64_t consumed = n_stripes * 32;
+  return xx_finalize(&s, seed, p + consumed, len - consumed, len);
+}
+
+// Fused ranged read + xxh64: each block is hashed right after its pread,
+// while it is still cache-resident — the restore path pays one memory pass
+// for read+verify instead of two (a full extra traversal of the checkpoint
+// bytes on a host that is busy staging).  Produces bit-identical digests to
+// tpusnap_xxhash64 over the same bytes (the stripe/finalize code IS the
+// same code).
+int tpusnap_read_range_hash(const char* path, void* buf, int64_t offset,
+                            int64_t nbytes, uint64_t seed,
+                            uint64_t* out_hash) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  const int64_t BLOCK = 8 << 20;
+  uint8_t* base = static_cast<uint8_t*>(buf);
+  XXState s;
+  xx_init(&s, seed);
+  int64_t got = 0;     // bytes landed in buf
+  int64_t hashed = 0;  // bytes consumed into the stripe state
+  while (got < nbytes) {
+    int64_t want = nbytes - got < BLOCK ? nbytes - got : BLOCK;
+    int64_t done = 0;
+    while (done < want) {
+      ssize_t r = ::pread(fd, base + got + done,
+                          static_cast<size_t>(want - done),
+                          offset + got + done);
+      if (r == 0) { ::close(fd); return -EIO; }
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        int err = errno;
+        ::close(fd);
+        return -err;
+      }
+      done += r;
+    }
+    got += want;
+    // Consume the stripes now fully available while the block is still
+    // cache-hot; at EOF this has consumed exactly xx_n_stripes(nbytes).
+    int64_t avail = (got - hashed) / 32;
+    xx_stripes(&s, base + hashed, avail);
+    hashed += avail * 32;
+  }
+  ::close(fd);
+  *out_hash = xx_finalize(&s, seed, base + hashed, nbytes - hashed, nbytes);
+  return 0;
 }
 
 }  // extern "C"

@@ -37,21 +37,34 @@ def compute(buf) -> Optional[str]:
         return f"xxh64:{native.xxhash64(buf):016x}"
 
 
-def verify(buf, expected: Optional[str], location: str) -> None:
+def verify(
+    buf,
+    expected: Optional[str],
+    location: str,
+    precomputed: Optional[int] = None,
+) -> None:
+    """Verify ``buf`` against its manifest digest.
+
+    ``precomputed`` is an xxh64 already computed over exactly these bytes
+    (the native fs plugin fuses hashing into the read loop — one memory pass
+    instead of two); when present the buffer is not traversed again."""
     if expected is None or not checksums_enabled():
         return
     algo, _, digest = expected.partition(":")
     if algo != "xxh64":
         return  # unknown algorithm: tolerate (forward compat)
-    from .native_io import NativeFileIO
+    if precomputed is not None:
+        actual = f"{precomputed:016x}"
+    else:
+        from .native_io import NativeFileIO
 
-    native = NativeFileIO.maybe_create()
-    if native is None:
-        return
-    from . import phase_stats
+        native = NativeFileIO.maybe_create()
+        if native is None:
+            return
+        from . import phase_stats
 
-    with phase_stats.timed("checksum", memoryview(buf).nbytes):
-        actual = f"{native.xxhash64(buf):016x}"
+        with phase_stats.timed("checksum", memoryview(buf).nbytes):
+            actual = f"{native.xxhash64(buf):016x}"
     if actual != digest:
         raise ChecksumError(
             f"Checksum mismatch for {location}: stored xxh64:{digest}, "

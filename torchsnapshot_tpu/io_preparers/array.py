@@ -327,6 +327,10 @@ def _device_put_like(host: np.ndarray, like: Any) -> Any:
 
 
 class ArrayBufferConsumer(BufferConsumer):
+    # Leaf consumer (1 read : 1 payload): a read-fused digest of the request's
+    # bytes is valid for this verify (set by the scheduler, io_types.ReadIO).
+    accepts_hash64 = True
+
     def __init__(
         self,
         assembly: ArrayAssembly,
@@ -342,6 +346,10 @@ class ArrayBufferConsumer(BufferConsumer):
         self._checksum = checksum
         self._location = location
         self._into = into
+        self.precomputed_hash64: Optional[int] = None
+        # Tiled reads carry checksum=None (partial payloads are never
+        # verified) — don't ask the plugin to hash them.
+        self.wants_read_hash = checksum is not None
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -351,7 +359,12 @@ class ArrayBufferConsumer(BufferConsumer):
         def _copy() -> None:
             from .. import integrity, phase_stats
 
-            integrity.verify(buf, self._checksum, self._location)
+            integrity.verify(
+                buf,
+                self._checksum,
+                self._location,
+                precomputed=self.precomputed_hash64,
+            )
             if in_place:
                 return  # storage already read the bytes into the assembly
             with phase_stats.timed("consume_copy", self._nbytes):

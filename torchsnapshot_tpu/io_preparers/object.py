@@ -72,17 +72,27 @@ class ObjectBufferStager(BufferStager):
 
 
 class ObjectBufferConsumer(BufferConsumer):
+    # Leaf consumer (1 read : 1 payload): read-fused digests apply.
+    accepts_hash64 = True
+
     def __init__(self, fut: Future, entry: ObjectEntry) -> None:
         self._fut = fut
         self._entry = entry
         self._nbytes_hint = 4096
+        self.precomputed_hash64 = None
+        self.wants_read_hash = entry.checksum is not None
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
     ) -> None:
         from .. import integrity, staging
 
-        integrity.verify(buf, self._entry.checksum, self._entry.location)
+        integrity.verify(
+            buf,
+            self._entry.checksum,
+            self._entry.location,
+            precomputed=self.precomputed_hash64,
+        )
         self._fut.obj = staging.maybe_unwrap_prng_key(
             serialization.pickle_load_from_bytes(bytes(buf))
         )

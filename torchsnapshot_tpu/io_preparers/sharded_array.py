@@ -357,6 +357,9 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
     target assembly buffers (reference ShardedTensorBufferConsumer,
     sharded_tensor.py:301-333)."""
 
+    # Leaf consumer (1 read : 1 piece payload): read-fused digests apply.
+    accepts_hash64 = True
+
     def __init__(
         self,
         restore: _ShardedRestore,
@@ -372,6 +375,8 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         self._piece_sizes = piece_sizes
         self._scatter = scatter
         self._into = into
+        self.precomputed_hash64: Optional[int] = None
+        self.wants_read_hash = piece_entry.checksum is not None
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None
@@ -381,7 +386,12 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         def _work() -> None:
             from .. import integrity, phase_stats
 
-            integrity.verify(buf, self._piece_entry.checksum, self._piece_entry.location)
+            integrity.verify(
+                buf,
+                self._piece_entry.checksum,
+                self._piece_entry.location,
+                precomputed=self.precomputed_hash64,
+            )
             if in_place:
                 return  # storage already read the bytes into the target
             piece = serialization.array_from_memoryview(

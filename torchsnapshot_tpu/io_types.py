@@ -80,6 +80,17 @@ class ReadIO:
     # it (fs readinto/native pread) do so and set buf = into — the consumer
     # then skips its copy.  Plugins that can't simply ignore it.
     into: Optional[memoryview] = None
+    # Set by the issuer (scheduler/CLI) when the consumer of this read will
+    # verify the WHOLE payload against a recorded digest: plugins that can
+    # fuse hashing into the read loop (native fs) then do so.  Off by
+    # default so merged spanning reads, tiled reads, and checksum-less
+    # entries never pay for a digest nobody will use.
+    want_hash: bool = False
+    # xxh64 of exactly the bytes placed in ``buf``, when the plugin computed
+    # it fused with the read (native fs data plane).  Consumers whose
+    # integrity check covers the whole read use it to skip their own hash
+    # pass; None means "not computed" and is always safe.
+    hash64: Optional[int] = None
 
 
 class BufferStager(abc.ABC):

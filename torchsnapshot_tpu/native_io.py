@@ -52,6 +52,15 @@ class NativeFileIO:
             ctypes.c_int64,
             ctypes.c_uint64,
         ]
+        lib.tpusnap_read_range_hash.restype = ctypes.c_int
+        lib.tpusnap_read_range_hash.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         self._lib = lib
 
     def xxhash64(self, buf) -> int:
@@ -130,7 +139,14 @@ class NativeFileIO:
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)
 
-    def read_file(self, path: str, byte_range: Optional[List[int]]) -> bytearray:
+    def read_file(
+        self,
+        path: str,
+        byte_range: Optional[List[int]],
+        want_hash: bool = False,
+    ) -> "tuple[bytearray, Optional[int]]":
+        """Ranged read into a fresh buffer; with ``want_hash`` the xxh64 of
+        the read bytes is computed fused in C (see read_file_into)."""
         if byte_range is None:
             size = self._lib.tpusnap_file_size(path.encode())
             if size < 0:
@@ -140,18 +156,37 @@ class NativeFileIO:
             offset = byte_range[0]
             nbytes = byte_range[1] - byte_range[0]
         out = bytearray(nbytes)
+        hash64: Optional[int] = None
         if nbytes:
             c_buf = (ctypes.c_char * nbytes).from_buffer(out)
-            rc = self._lib.tpusnap_read_range(path.encode(), c_buf, offset, nbytes)
+            if want_hash:
+                h = ctypes.c_uint64()
+                rc = self._lib.tpusnap_read_range_hash(
+                    path.encode(), c_buf, offset, nbytes, 0, ctypes.byref(h)
+                )
+                hash64 = int(h.value) if rc == 0 else None
+            else:
+                rc = self._lib.tpusnap_read_range(
+                    path.encode(), c_buf, offset, nbytes
+                )
             if rc != 0:
                 raise OSError(-rc, os.strerror(-rc), path)
-        return out
+        return out, hash64
 
     def read_file_into(
-        self, path: str, byte_range: Optional[List[int]], view: Any
-    ) -> None:
+        self,
+        path: str,
+        byte_range: Optional[List[int]],
+        view: Any,
+        want_hash: bool = False,
+    ) -> Optional[int]:
         """Ranged pread straight into a caller-owned writable buffer — the
-        zero-copy restore path (no bytearray allocation, no consume memcpy)."""
+        zero-copy restore path (no bytearray allocation, no consume memcpy).
+
+        With ``want_hash`` the read and its xxh64 are fused in C (each block
+        hashed cache-hot right after its pread), and the digest of exactly
+        the read bytes is returned — the consumer's integrity check then
+        skips its own full pass over the payload."""
         import numpy as np
 
         mv = memoryview(view)
@@ -161,12 +196,26 @@ class NativeFileIO:
             offset = byte_range[0]
             nbytes = byte_range[1] - byte_range[0]
         if nbytes == 0:
-            return
+            return None
         if mv.nbytes != nbytes:
             raise ValueError(f"into-view is {mv.nbytes} bytes, range is {nbytes}")
         arr = np.frombuffer(mv, np.uint8)
+        if want_hash:
+            out = ctypes.c_uint64()
+            rc = self._lib.tpusnap_read_range_hash(
+                path.encode(),
+                ctypes.c_void_p(arr.ctypes.data),
+                offset,
+                nbytes,
+                0,
+                ctypes.byref(out),
+            )
+            if rc != 0:
+                raise OSError(-rc, os.strerror(-rc), path)
+            return int(out.value)
         rc = self._lib.tpusnap_read_range(
             path.encode(), ctypes.c_void_p(arr.ctypes.data), offset, nbytes
         )
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)
+        return None

@@ -391,8 +391,10 @@ class _ReadPipeline:
         self.storage = storage
         self.consuming_cost = read_req.buffer_consumer.get_consuming_cost_bytes()
         self.buf: Optional[bytearray] = None
+        self.hash64: Optional[int] = None
 
     async def read_buffer(self) -> "_ReadPipeline":
+        consumer = self.read_req.buffer_consumer
         read_io = ReadIO(
             path=self.read_req.path,
             byte_range=(
@@ -401,14 +403,28 @@ class _ReadPipeline:
                 else None
             ),
             into=self.read_req.into,
+            # Ask for a read-fused digest only when this consumer will
+            # actually verify the whole payload against one — merged
+            # spanning reads (composite consumers) and digest-less entries
+            # must not pay for hashing nobody uses.
+            want_hash=getattr(consumer, "accepts_hash64", False)
+            and getattr(consumer, "wants_read_hash", True),
         )
         await self.storage.read(read_io)
         self.buf = read_io.buf
+        self.hash64 = read_io.hash64
         return self
 
     async def consume_buffer(self, executor: Optional[Executor]) -> "_ReadPipeline":
         assert self.buf is not None
-        await self.read_req.buffer_consumer.consume_buffer(self.buf, executor)
+        consumer = self.read_req.buffer_consumer
+        if self.hash64 is not None and getattr(consumer, "accepts_hash64", False):
+            # The plugin hashed exactly the bytes of this request fused with
+            # the read; a leaf consumer (1 request : 1 payload) verifies
+            # against it without a second pass.  Composite consumers (merged
+            # spanning reads) never opt in — their sub-payloads are slices.
+            consumer.precomputed_hash64 = self.hash64
+        await consumer.consume_buffer(self.buf, executor)
         self.buf = None
         return self
 

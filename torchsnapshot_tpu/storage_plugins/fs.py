@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
@@ -21,6 +22,7 @@ _DEFAULT_IO_THREADS = 16
 _PARALLEL_READ_MIN_BYTES = 64 * 1024 * 1024
 _PARALLEL_READ_CHUNK = 32 * 1024 * 1024
 _PARALLEL_READ_MAX_WAYS = 8
+_ADAPTIVE_REPROBE_EVERY = 16
 
 
 class FSStoragePlugin(StoragePlugin):
@@ -42,6 +44,16 @@ class FSStoragePlugin(StoragePlugin):
             self._native: Optional[NativeFileIO] = NativeFileIO.maybe_create()
         except Exception:
             self._native = None
+        # Adaptive strategy for large UNchecksummed into-reads (checksummed
+        # ones always take the sequential fused read+hash path): the first
+        # two qualifying reads measure sequential vs parallel once, then the
+        # winner sticks for this plugin's lifetime.  No static default is
+        # right everywhere — sequential rode readahead 2.6x faster on a
+        # virtual disk, parallel wins on NVMe queue depth.
+        self._adaptive_lock = threading.Lock()
+        self._seq_gbps: Optional[float] = None
+        self._par_gbps: Optional[float] = None
+        self._reads_since_probe = 0
 
     def _get_executor(self) -> ThreadPoolExecutor:
         if self._executor is None:
@@ -99,65 +111,144 @@ class FSStoragePlugin(StoragePlugin):
                 pass
             raise
 
-    def _blocking_read(self, path: str, byte_range, into=None):
+    def _blocking_read(self, path: str, byte_range, into=None, want_hash=False):
         import time
 
         from .. import phase_stats
 
         begin = time.monotonic()
-        result = self._read_impl(path, byte_range, into)
+        result, hash64 = self._read_impl(path, byte_range, into, want_hash)
         phase_stats.add(
             "fs_read", time.monotonic() - begin, memoryview(result).nbytes
         )
-        return result
+        return result, hash64
 
-    def _read_impl(self, path: str, byte_range, into):
+    def _read_impl(self, path: str, byte_range, into, want_hash):
+        """Returns (buffer, xxh64-of-the-read-bytes-or-None).
+
+        The hash comes from the fused C read (each block hashed cache-hot
+        right after its pread) — one memory pass for read+verify instead of
+        two.  Only reads whose issuer asked (ReadIO.want_hash: the consumer
+        will verify the whole payload) pay for it; parallel chunked reads
+        skip it (xxh64 is order-dependent)."""
+        from .. import integrity
+
+        want_hash = want_hash and integrity.checksums_enabled()
         if into is not None:
             # Read-into-place: bytes land in the restore target's own
             # memory — no allocation, and the consumer skips its copy.
             if self._native is not None:
-                from .. import knobs
-
                 view = memoryview(into).cast("B")
-                if (
-                    knobs.get_parallel_read_ways() > 1
-                    and view.nbytes >= _PARALLEL_READ_MIN_BYTES
+                if view.nbytes >= _PARALLEL_READ_MIN_BYTES and self._use_parallel(
+                    want_hash
                 ):
-                    # Opt-in (TPUSNAP_PARALLEL_READ_WAYS): NVMe rewards
-                    # queue depth, but a sequential pread rides kernel
-                    # readahead — measured 2.6x faster cold on a virtual
-                    # disk, so 1 way is the default.
-                    self._parallel_read_into(path, byte_range, view)
-                    return into
-                self._native.read_file_into(path, byte_range, into)
-            else:
-                with open(path, "rb") as f:
-                    if byte_range is not None:
-                        f.seek(byte_range[0])
-                    view = memoryview(into).cast("B")
-                    filled = 0
-                    while filled < view.nbytes:
-                        n = f.readinto(view[filled:])
-                        if not n:
-                            # A silent short read would leave stale bytes in
-                            # the restore target (and the native-less build
-                            # has no checksum verify to catch it).
-                            raise OSError(
-                                f"short read from {path}: got {filled} of "
-                                f"{view.nbytes} bytes"
-                            )
-                        filled += n
-            return into
+                    parallel_ways = self._parallel_ways(view.nbytes)
+                    if parallel_ways > 1:
+                        self._timed_parallel(path, byte_range, view, parallel_ways)
+                        return into, None
+                if want_hash:
+                    # One memory pass for read+verify — always preferred for
+                    # checksummed payloads (a parallel read would need a
+                    # second full hash pass; xxh64 is order-dependent).
+                    hash64 = self._native.read_file_into(
+                        path, byte_range, into, want_hash=True
+                    )
+                    return into, hash64
+                self._timed_sequential(
+                    path,
+                    byte_range,
+                    into,
+                    record=view.nbytes >= _PARALLEL_READ_MIN_BYTES,
+                )
+                return into, None
+            with open(path, "rb") as f:
+                if byte_range is not None:
+                    f.seek(byte_range[0])
+                view = memoryview(into).cast("B")
+                filled = 0
+                while filled < view.nbytes:
+                    n = f.readinto(view[filled:])
+                    if not n:
+                        # A silent short read would leave stale bytes in
+                        # the restore target (and the native-less build
+                        # has no checksum verify to catch it).
+                        raise OSError(
+                            f"short read from {path}: got {filled} of "
+                            f"{view.nbytes} bytes"
+                        )
+                    filled += n
+            return into, None
         if self._native is not None:
-            return self._native.read_file(path, byte_range)
+            return self._native.read_file(path, byte_range, want_hash=want_hash)
         with open(path, "rb") as f:
             if byte_range is None:
-                return bytearray(f.read())
+                return bytearray(f.read()), None
             offset, end = byte_range
             f.seek(offset)
-            return bytearray(f.read(end - offset))
+            return bytearray(f.read(end - offset)), None
 
-    def _parallel_read_into(self, path: str, byte_range, view) -> None:
+    def _use_parallel(self, want_hash: bool) -> bool:
+        """Strategy for a large into-read: pinned env var wins outright;
+        checksummed reads stay sequential (the fused read+hash is one memory
+        pass — parallel would need a second full hash pass); otherwise the
+        first two qualifying reads A/B-measure and the winner sticks."""
+        from .. import knobs
+
+        pinned = knobs.get_parallel_read_ways()
+        if pinned is not None:
+            return pinned > 1
+        if want_hash:
+            return False
+        with self._adaptive_lock:
+            if self._seq_gbps is None:
+                return False  # first qualifying read measures sequential
+            if self._par_gbps is None:
+                return True  # second measures parallel
+            # Periodically re-measure the losing strategy: a single early
+            # sample can be distorted (cold vs warm cache, pool contention)
+            # and must not lock in the wrong pick for the plugin's lifetime.
+            self._reads_since_probe += 1
+            if self._reads_since_probe >= _ADAPTIVE_REPROBE_EVERY:
+                self._reads_since_probe = 0
+                if self._par_gbps > self._seq_gbps:
+                    self._seq_gbps = None  # next qualifying read re-measures
+                    return False
+                self._par_gbps = None
+                return True
+            return self._par_gbps > self._seq_gbps
+
+    def _parallel_ways(self, total: int) -> int:
+        from .. import knobs
+
+        pinned = knobs.get_parallel_read_ways()
+        return min(
+            pinned if pinned is not None else _PARALLEL_READ_MAX_WAYS,
+            _PARALLEL_READ_MAX_WAYS,
+            max(2, total // _PARALLEL_READ_CHUNK),
+        )
+
+    def _timed_sequential(self, path: str, byte_range, into, record: bool) -> None:
+        import time
+
+        begin = time.monotonic()
+        self._native.read_file_into(path, byte_range, into, want_hash=False)
+        if record:
+            elapsed = max(time.monotonic() - begin, 1e-6)
+            with self._adaptive_lock:
+                if self._seq_gbps is None:
+                    self._seq_gbps = memoryview(into).nbytes / 1e9 / elapsed
+
+    def _timed_parallel(self, path: str, byte_range, view, ways: int) -> None:
+        import time
+
+        begin = time.monotonic()
+        self._parallel_read_into(path, byte_range, view, ways)
+        elapsed = max(time.monotonic() - begin, 1e-6)
+        with self._adaptive_lock:
+            if self._par_gbps is None:
+                self._par_gbps = view.nbytes / 1e9 / elapsed
+
+    def _parallel_read_into(self, path: str, byte_range, view, n_chunks: int) -> None:
         if byte_range is not None:
             expected = byte_range[1] - byte_range[0]
             if view.nbytes != expected:
@@ -166,15 +257,8 @@ class FSStoragePlugin(StoragePlugin):
                 raise ValueError(
                     f"into-view is {view.nbytes} bytes, range is {expected}"
                 )
-        from .. import knobs
-
         base = byte_range[0] if byte_range is not None else 0
         total = view.nbytes
-        n_chunks = min(
-            knobs.get_parallel_read_ways(),
-            _PARALLEL_READ_MAX_WAYS,
-            max(2, total // _PARALLEL_READ_CHUNK),
-        )
         chunk = -(-total // n_chunks)
         futures = []
         offset = 0
@@ -202,12 +286,13 @@ class FSStoragePlugin(StoragePlugin):
     async def read(self, read_io: ReadIO) -> None:
         path = os.path.join(self.root, read_io.path)
         loop = asyncio.get_running_loop()
-        read_io.buf = await loop.run_in_executor(
+        read_io.buf, read_io.hash64 = await loop.run_in_executor(
             self._get_executor(),
             self._blocking_read,
             path,
             read_io.byte_range,
             read_io.into,
+            read_io.want_hash,
         )
 
     async def copy_from_sibling(self, src_root: str, path: str) -> bool:
