@@ -174,6 +174,61 @@ def test_tiled_reads_never_remerged():
     np.testing.assert_array_equal(fut.obj, arr)
 
 
+def test_non_scatter_slab_joins_during_staging():
+    """Backends without scatter support must receive a contiguous buffer:
+    the slab join happens at staging time (covered by the declared staging
+    cost of parts + total), never at write time where io-concurrency joins
+    could overshoot the memory budget at once."""
+    import asyncio
+
+    from torchsnapshot_tpu.io_types import ScatterBuffer
+
+    arrays = {f"a{i}": np.full((64,), i, np.float32) for i in range(6)}
+    entries = {}
+    write_reqs = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries[name] = entry
+        write_reqs += reqs
+    with knobs.override_slab_size_threshold_bytes(1 << 20):
+        _, batched_plain = batch_write_requests(entries, write_reqs, scatter_ok=False)
+    assert len(batched_plain) == 1
+    stager = batched_plain[0].buffer_stager
+    total = sum(a.nbytes for a in arrays.values())
+    # the join's slab-sized allocation is part of the declared staging cost
+    # (member parts are zero-copy views of host arrays, costing 0 here)
+    assert stager.get_staging_cost_bytes() >= total
+    buf = asyncio.run(stager.stage_buffer())
+    assert not isinstance(buf, ScatterBuffer)
+    assert memoryview(buf).nbytes == total
+    for name, entry in entries.items():
+        start, end = entry.byte_range
+        np.testing.assert_array_equal(
+            np.frombuffer(memoryview(buf)[start:end], np.float32), arrays[name]
+        )
+
+    # scatter-capable destinations still get the zero-copy parts
+    # (fresh plan: the first batch call rewrote the entries' locations)
+    entries2 = {}
+    write_reqs2 = []
+    for name, arr in arrays.items():
+        entry, reqs = prepare_write(arr, name, rank=0, replicated=False)
+        entries2[name] = entry
+        write_reqs2 += reqs
+    with knobs.override_slab_size_threshold_bytes(1 << 20):
+        _, batched_scatter = batch_write_requests(
+            entries2, write_reqs2, scatter_ok=True
+        )
+    assert len(batched_scatter) == 1
+    buf = asyncio.run(batched_scatter[0].buffer_stager.stage_buffer())
+    assert isinstance(buf, ScatterBuffer)
+    assert (
+        stager.get_staging_cost_bytes()
+        - batched_scatter[0].buffer_stager.get_staging_cost_bytes()
+        == total
+    )
+
+
 def test_object_entries_not_batched():
     entries = {}
     write_reqs = []

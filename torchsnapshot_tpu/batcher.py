@@ -177,13 +177,26 @@ class BatchedBufferStager(BufferStager):
         views = await asyncio.gather(
             *(_stage_one(s, n) for s, _, n in self._members)
         )
-        return ScatterBuffer(views)
+        scatter = ScatterBuffer(views)
+        if self._scatter_ok:
+            return scatter
+        # The destination would join() scatter parts at write time; do it
+        # HERE, during staging, where the slab-sized allocation is covered
+        # by the declared staging cost (parts + total) and the scheduler
+        # re-credits the parts once staging returns.  Joining at write time
+        # instead would allocate io-concurrency x slab bytes outside any
+        # budget window.  The memcpy runs on the executor: a 128 MB inline
+        # copy would stall the event loop driving every other transfer.
+        if executor is not None:
+            return await asyncio.get_running_loop().run_in_executor(
+                executor, scatter.join
+            )
+        return scatter.join()
 
     def get_staging_cost_bytes(self) -> int:
         cost = sum(s.get_staging_cost_bytes() for s, _, _ in self._members)
         if not self._scatter_ok:
-            # The destination will join() at write time: budget the
-            # slab-sized allocation that copy makes.
+            # Parts and the joined slab coexist during the staging-time pack.
             cost += self._total
         return cost
 

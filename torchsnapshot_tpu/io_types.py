@@ -193,12 +193,13 @@ class StoragePlugin(abc.ABC):
             await self.read(read_io)
             return True
         except (FileNotFoundError, KeyError):
+            # Only typed not-found signals classify as absent.  Transport or
+            # proxy errors must propagate: retention treats "missing commit
+            # marker" as a torn snapshot and prunes it, so misclassifying a
+            # flaky 5xx (or an error page whose text happens to contain
+            # "404") would delete a valid restore point.  Backends whose
+            # not-found surfaces differently must override exists().
             return False
-        except Exception as e:  # noqa: BLE001 - backend-specific not-found
-            msg = str(e)
-            if "404" in msg or "NoSuchKey" in msg or "Not Found" in msg:
-                return False
-            raise
 
     # Sync conveniences (reference io_types.py:101-120); run a private loop,
     # delegating to a helper thread when the caller is already inside a

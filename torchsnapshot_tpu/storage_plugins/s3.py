@@ -132,6 +132,9 @@ class S3StoragePlugin(StoragePlugin):
         self.bucket = bucket
         self.prefix = prefix.strip("/")
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._delete_executor = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="s3_del"
+        )
         region = os.environ.get(
             "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
         )
@@ -168,6 +171,14 @@ class S3StoragePlugin(StoragePlugin):
                 max_workers=_IO_THREADS, thread_name_prefix="s3_io"
             )
         return self._executor
+
+    def _get_delete_executor(self) -> ThreadPoolExecutor:
+        # Child pool for delete_dir's per-key fan-out; see delete_dir.
+        # Built eagerly in __init__ (unlike _get_executor, this getter runs
+        # on I/O-pool worker threads, where a lazy check-then-set races and
+        # leaks a pool); construction is cheap — threads spawn on first
+        # submit.
+        return self._delete_executor
 
     def _key(self, path: str) -> str:
         return f"{self.prefix}/{path}" if self.prefix else path
@@ -393,11 +404,15 @@ class S3StoragePlugin(StoragePlugin):
                             f"S3 DELETE {key} failed: {del_resp.status_code}"
                         )
 
-                # Fan the per-key DELETEs across the I/O pool: one serial
-                # signed round-trip per object would scale delete_dir
-                # linearly with snapshot size.
+                # Fan the per-key DELETEs across a DEDICATED pool: this
+                # function already occupies an I/O-pool thread and blocks on
+                # its children, so submitting them to the same pool can
+                # starve/deadlock once concurrent blocking ops hold every
+                # slot (the same parent/child split fs.py makes for chunk
+                # reads).
                 futures = [
-                    self._get_executor().submit(_del_one, key) for key in keys
+                    self._get_delete_executor().submit(_del_one, key)
+                    for key in keys
                 ]
                 for fut in futures:
                     fut.result()
@@ -417,3 +432,4 @@ class S3StoragePlugin(StoragePlugin):
         if self._executor is not None:
             self._executor.shutdown()
             self._executor = None
+        self._delete_executor.shutdown()
