@@ -174,13 +174,108 @@ def run_world(world_size: int, workers: int = 64) -> dict:
     }
 
 
+def run_small_collective_world(
+    world_size: int, workers: int = 64, measure_allgather_up_to: int = 512
+) -> dict:
+    """Before/after for the small-object collectives (key unions, replicated
+    verification, hostname counts — snapshot.py/_gather_keys etc., round-2
+    verdict item): the naive all_gather_object pattern costs N sets + N²
+    GETs, the reduce-at-root + broadcast pattern costs N sets + 2N GETs + 1
+    set.  The all_gather side is only *measured* while N² stays tractable on
+    one box (``measure_allgather_up_to``); above that its op count is
+    reported analytically — the point of the fix is that nobody should ever
+    run it there.
+    """
+    from collections import Counter
+
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+    from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
+
+    server = TCPStoreServer()
+    store = TCPStore("127.0.0.1", server.port)
+    pgs = [
+        PGWrapper(store=store, rank=r, world_size=world_size, timeout_s=600)
+        for r in range(world_size)
+    ]
+    # A hostname-sized payload, 8 ranks per simulated host.
+    payloads = [f"host-{r // 8:04d}.cluster.internal" for r in range(world_size)]
+    pool = ThreadPoolExecutor(max_workers=workers)
+
+    # --- after: gather-to-root + reduce + broadcast (PGWrapper.all_reduce_object
+    # op sequence, phased so a worker pool smaller than the world can't
+    # deadlock: real deployments run one live process per rank).
+    begin = time.monotonic()
+    root_fut = pool.submit(pgs[0].gather_object_root, payloads[0])
+    for f in [
+        pool.submit(pgs[r].gather_object_root, payloads[r])
+        for r in range(1, world_size)
+    ]:
+        f.result()
+    gathered = root_fut.result()
+    reduced = Counter(gathered)
+    pgs[0].broadcast_object_list([reduced], 0)
+    for f in [
+        pool.submit(pgs[r].broadcast_object_list, [None], 0)
+        for r in range(1, world_size)
+    ]:
+        f.result()
+    reduce_s = time.monotonic() - begin
+    reduce_ops = world_size + 2 * world_size + 1  # sets + gets(root+bcast) + set
+    store.delete_prefix("pg/")
+
+    # --- before: all_gather_object (every rank GETs every rank's key).
+    allgather_s = None
+    allgather_ops = world_size + world_size * world_size
+    if world_size <= measure_allgather_up_to:
+        t0 = time.monotonic()
+        for f in [
+            pool.submit(
+                store.set, f"ag/{r}", pickle.dumps(payloads[r])
+            )
+            for r in range(world_size)
+        ]:
+            f.result()
+
+        def _gather_all(r: int) -> int:
+            n = 0
+            for peer in range(world_size):
+                pickle.loads(store.get(f"ag/{peer}", timeout_s=60))
+                n += 1
+            return n
+
+        for f in [pool.submit(_gather_all, r) for r in range(world_size)]:
+            f.result()
+        allgather_s = round(time.monotonic() - t0, 2)
+        store.delete_prefix("ag/")
+
+    pool.shutdown()
+    store.close()
+    server.stop()
+    return {
+        "world_size": world_size,
+        "collective": "small-object (hostname union/count)",
+        "reduce_bcast_s": round(reduce_s, 2),
+        "reduce_bcast_store_ops": reduce_ops,
+        "allgather_s": allgather_s,
+        "allgather_store_ops": allgather_ops,
+        "op_ratio": round(allgather_ops / reduce_ops, 1),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--worlds", default="256,1024")
+    parser.add_argument(
+        "--small-worlds",
+        default="256,1024,4096",
+        help="world sizes for the small-object collective before/after",
+    )
     args = parser.parse_args()
-    for world in (int(w) for w in args.worlds.split(",")):
+    for world in (int(w) for w in args.worlds.split(",") if w):
         result = run_world(world)
         print(result, flush=True)
+    for world in (int(w) for w in args.small_worlds.split(",") if w):
+        print(run_small_collective_world(world), flush=True)
 
 
 if __name__ == "__main__":

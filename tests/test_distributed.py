@@ -46,6 +46,13 @@ def _collectives_body():
     else:
         assert gathered_root is None
 
+    # reduce-at-root: every rank gets the reduction, not the per-rank list
+    union = pg.all_reduce_object(
+        {f"key{rank}", "shared"},
+        lambda per_rank: sorted(set().union(*per_rank)),
+    )
+    assert union == ["key0", "key1", "key2", "key3", "shared"]
+
     # non-zero root: root's own object spliced at its index, others None
     gathered_r2 = pg.gather_object_root(rank * 100, root=2)
     if rank == 2:
@@ -574,3 +581,69 @@ def test_rank_death_mid_take_times_out_without_commit(tmp_path):
     with pytest.raises(TimeoutError):
         Snapshot.take(snap_path, app, pg=pg)
     assert not os.path.exists(os.path.join(snap_path, ".snapshot_metadata"))
+
+
+def test_filestore_add_recovers_from_crashed_lock_holder(tmp_path):
+    """A rank dying between the add() lock's create and unlink must not hang
+    every peer forever: a waiter past the staleness deadline breaks the lock
+    (torch's TCPStore add is server-atomic and cannot deadlock this way)."""
+    import multiprocessing as mp
+    import time as _time
+
+    from torchsnapshot_tpu.dist_store import FileStore
+
+    store = FileStore(str(tmp_path), lock_stale_s=1.0)
+    assert store.add("counter", 1) == 1
+
+    def crash_holding_lock(path):
+        # Acquire the lock the way add() does, then die without releasing.
+        lock = FileStore(path)._key_path("counter") + ".lock"
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, b"crashed-rank-token")
+        os.close(fd)
+        os._exit(1)
+
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=crash_holding_lock, args=(str(tmp_path),))
+    p.start()
+    p.join()
+    assert os.path.exists(store._key_path("counter") + ".lock")
+
+    begin = _time.monotonic()
+    assert store.add("counter", 1) == 2  # breaks the stale lock, proceeds
+    elapsed = _time.monotonic() - begin
+    assert 1.0 <= elapsed < 10.0, f"recovered in {elapsed:.2f}s"
+    # The broken lock is gone: the next add acquires immediately.
+    begin = _time.monotonic()
+    assert store.add("counter", 1) == 3
+    assert _time.monotonic() - begin < 1.0
+
+
+def test_filestore_add_does_not_break_live_lock(tmp_path):
+    """Lock instances are tracked by identity: a healthy holder that releases
+    and a NEW holder that re-acquires must each get a fresh staleness clock —
+    the waiter only breaks a lock it watched unchanged past the deadline."""
+    import threading
+    import time as _time
+
+    from torchsnapshot_tpu.dist_store import FileStore
+
+    store = FileStore(str(tmp_path), lock_stale_s=1.5)
+    results = []
+
+    def hammer():
+        # 8 quick adds with small sleeps: lock instances keep changing, so
+        # no waiter should ever see one instance as stale.
+        for _ in range(8):
+            results.append(store.add("c", 1))
+            _time.sleep(0.05)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    begin = _time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert _time.monotonic() - begin < 15.0
+    # No lost increments: 3 threads x 8 adds == final counter value.
+    assert store.add("c", 0) == 24

@@ -177,6 +177,23 @@ class PGWrapper:
         self._store.set(f"{key}/{self._rank}", pickle.dumps(obj))
         return None
 
+    def all_reduce_object(self, obj: Any, reduce_fn) -> Any:
+        """Gather per-rank objects to rank 0, apply ``reduce_fn`` to the
+        rank-ordered list there, broadcast the reduced value to everyone.
+
+        O(world) store ops, and the wire carries each rank's contribution
+        once plus the (typically much smaller) reduced value once per rank —
+        where the all_gather_object + reduce-locally pattern costs O(world²)
+        GETs with every rank pulling every other rank's value.  Use for any
+        collective whose consumers only need a reduction (unions,
+        intersections, counts), not the full per-rank list."""
+        if self._store is None or self._world_size == 1:
+            return reduce_fn([obj])
+        gathered = self.gather_object_root(obj)
+        obj_list: List[Any] = [reduce_fn(gathered) if gathered is not None else None]
+        self.broadcast_object_list(obj_list, src=0)
+        return obj_list[0]
+
     def broadcast_object_list(self, obj_list: List[Any], src: int = 0) -> None:
         """In-place broadcast of a list of objects from ``src`` (reference
         pg_wrapper.py:59-64)."""

@@ -55,10 +55,14 @@ _NUM_EXECUTOR_THREADS = 4
 
 
 def get_local_world_size(pg: PGWrapper) -> int:
-    """Number of ranks on this host, via hostname all-gather (reference
-    scheduler.py:35-44)."""
-    hostnames = pg.all_gather_object(socket.gethostname())
-    return hostnames.count(socket.gethostname())
+    """Number of ranks on this host (reference scheduler.py:35-44) — reduced
+    at rank 0 to a {hostname: count} dict and broadcast, O(world) store ops
+    where the reference's hostname all-gather is O(world²) GETs."""
+    from collections import Counter
+
+    hostname = socket.gethostname()
+    counts = pg.all_reduce_object(hostname, Counter)
+    return counts[hostname]
 
 
 def get_process_memory_budget_bytes(pg: PGWrapper) -> int:
