@@ -72,11 +72,24 @@ class FileStore(KVStore):
     """Shared-filesystem KV store.
 
     set() is atomic via write-to-temp + rename; add() serializes through an
-    O_EXCL lock file.  Polling intervals back off to 200 ms.
+    O_EXCL lock file with stale-lock recovery (a rank dying between lock
+    create and unlink must not hang every peer forever — torch's TCPStore
+    ``add`` is server-atomic and cannot deadlock this way, so neither may
+    the FileStore analogue).  Polling intervals back off to 200 ms.
     """
 
-    def __init__(self, path: str) -> None:
+    # A waiter that has watched the SAME lock instance for this long breaks
+    # it.  The critical section is a small-file read + write + rename (ms
+    # even on NFS), so anything holding a lock this long is dead or paused;
+    # the deadline errs high because breaking a live holder's lock can lose
+    # an increment.
+    LOCK_STALE_S = 30.0
+
+    def __init__(self, path: str, lock_stale_s: Optional[float] = None) -> None:
         self._root = path
+        self._lock_stale_s = (
+            lock_stale_s if lock_stale_s is not None else self.LOCK_STALE_S
+        )
         os.makedirs(path, exist_ok=True)
 
     def _key_path(self, key: str) -> str:
@@ -116,13 +129,42 @@ class FileStore(KVStore):
 
     def add(self, key: str, amount: int) -> int:
         lock = self._key_path(key) + ".lock"
+        token = f"{os.getpid()}:{uuid.uuid4().hex}".encode()
         i = 0
+        # Stale detection is clock-skew-free: the waiter times how long the
+        # SAME lock instance (inode+mtime identity) has blocked it on its
+        # own monotonic clock, rather than comparing the lock's mtime (NFS
+        # server time) against local wall time.
+        waiting_since: Optional[tuple] = None
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.close(fd)
+                try:
+                    os.write(fd, token)
+                finally:
+                    os.close(fd)
                 break
             except FileExistsError:
+                try:
+                    st = os.stat(lock)
+                    ident = (st.st_ino, st.st_mtime)
+                except OSError:
+                    # Lock likely released between open and stat — but still
+                    # back off: on NFS a cached dentry can keep open()
+                    # failing while stat() raises ESTALE for the
+                    # revalidation window, and skipping the wait would turn
+                    # that window into a hot spin against the server.
+                    waiting_since = None
+                    self.wait_hint(i)
+                    i += 1
+                    continue
+                now = time.monotonic()
+                if waiting_since is None or waiting_since[0] != ident:
+                    waiting_since = (ident, now)
+                elif now - waiting_since[1] > self._lock_stale_s:
+                    self._break_stale_lock(lock, ident)
+                    waiting_since = None
+                    continue
                 self.wait_hint(i)
                 i += 1
         try:
@@ -131,7 +173,50 @@ class FileStore(KVStore):
             self.set(key, str(value).encode())
             return value
         finally:
-            os.unlink(lock)
+            # Release only if the lock is still OURS: a peer may have broken
+            # it as stale (e.g. this process was paused past the deadline)
+            # and a new holder created a fresh lock at the same path —
+            # unlinking that would hand the lock to two waiters at once.
+            try:
+                with open(lock, "rb") as f:
+                    still_ours = f.read() == token
+                if still_ours:
+                    os.unlink(lock)
+            except OSError:
+                pass
+
+    def _break_stale_lock(self, lock: str, ident: tuple) -> None:
+        """Break a lock whose holder is presumed dead.  The rename is atomic,
+        so of N waiters that all observed the lock as stale exactly one wins
+        and the rest fall back to normal acquisition."""
+        try:
+            st = os.stat(lock)
+            if (st.st_ino, st.st_mtime) != ident:
+                return  # a fresh holder re-created it; not stale
+        except OSError:
+            return  # gone already
+        broken = f"{lock}.broken.{uuid.uuid4().hex}"
+        try:
+            os.rename(lock, broken)
+        except OSError:
+            return  # another waiter broke it first
+        try:
+            st = os.stat(broken)
+            if (st.st_ino, st.st_mtime) != ident:
+                # The stat→rename window let another waiter break the stale
+                # lock AND a new holder re-acquire: what we renamed away is
+                # that holder's LIVE lock.  Put it back via link (restores
+                # the same inode; unlike rename it cannot clobber a third
+                # waiter's even-newer lock — if one exists the EEXIST is
+                # swallowed and the holder's token-checked release keeps the
+                # path safe).
+                try:
+                    os.link(broken, lock)
+                except OSError:
+                    pass
+            os.unlink(broken)
+        except OSError:
+            pass
 
     def delete_prefix(self, prefix: str) -> int:
         encoded = os.path.basename(self._key_path(prefix))
