@@ -24,6 +24,11 @@ class FakeS3Server:
         self.request_count = 0
         self.copies = 0  # server-side copies (x-amz-copy-source PUTs)
         self.put_bytes = 0  # bytes actually uploaded by clients
+        self.multipart_completed = 0  # completed multipart uploads
+        self.fail_parts = 0  # 503 the next N part PUTs (deterministic hook)
+        # upload-id -> {"key": str, "parts": {part_number: bytes}}
+        self.uploads: Dict[str, dict] = {}
+        self._upload_seq = 0
         self._lock = threading.Lock()
         outer = self
 
@@ -65,6 +70,11 @@ class FakeS3Server:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 data = self.rfile.read(length)
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
+                if "partNumber" in query and "uploadId" in query:
+                    return self._do_upload_part(query, data)
                 copy_source = self.headers.get("x-amz-copy-source")
                 if copy_source:
                     src_key = urllib.parse.unquote(copy_source.lstrip("/"))
@@ -169,6 +179,102 @@ class FakeS3Server:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _do_upload_part(self, query, data):
+                with outer._lock:
+                    if outer.fail_parts > 0:
+                        outer.fail_parts -= 1
+                        part_fails = True
+                    else:
+                        part_fails = False
+                if part_fails:
+                    body = b"<Error><Code>SlowDown</Code></Error>"
+                    self.send_response(503)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(body)
+                    self.close_connection = True
+                    return
+                upload_id = query["uploadId"][0]
+                number = int(query["partNumber"][0])
+                with outer._lock:
+                    upload = outer.uploads.get(upload_id)
+                    if upload is None:
+                        body = b"<Error><Code>NoSuchUpload</Code></Error>"
+                        self.send_response(404)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    upload["parts"][number] = data
+                    outer.put_bytes += len(data)
+                self.send_response(200)
+                self.send_header("ETag", f'"fake-etag-{number}"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_POST(self):
+                if self._maybe_fail():
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body_in = self.rfile.read(length) if length else b""
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query,
+                    keep_blank_values=True,
+                )
+                if "uploads" in query:
+                    # initiate
+                    with outer._lock:
+                        outer._upload_seq += 1
+                        upload_id = f"upload-{outer._upload_seq}"
+                        outer.uploads[upload_id] = {
+                            "key": self._obj_key(),
+                            "parts": {},
+                        }
+                    body = (
+                        "<InitiateMultipartUploadResult>"
+                        f"<UploadId>{upload_id}</UploadId>"
+                        "</InitiateMultipartUploadResult>"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if "uploadId" in query:
+                    # complete: assemble parts in part-number order
+                    upload_id = query["uploadId"][0]
+                    with outer._lock:
+                        upload = outer.uploads.pop(upload_id, None)
+                        if upload is None:
+                            body = b"<Error><Code>NoSuchUpload</Code></Error>"
+                            self.send_response(404)
+                            self.send_header(
+                                "Content-Length", str(len(body))
+                            )
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
+                        assembled = b"".join(
+                            upload["parts"][n]
+                            for n in sorted(upload["parts"])
+                        )
+                        outer.objects[upload["key"]] = assembled
+                        outer.multipart_completed += 1
+                    body = (
+                        "<CompleteMultipartUploadResult>"
+                        f"<Key>{escape(upload['key'])}</Key>"
+                        "</CompleteMultipartUploadResult>"
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(400)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def do_HEAD(self):
                 if self._maybe_fail():
                     return
@@ -181,8 +287,14 @@ class FakeS3Server:
             def do_DELETE(self):
                 if self._maybe_fail():
                     return
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query
+                )
                 with outer._lock:
-                    outer.objects.pop(self._obj_key(), None)
+                    if "uploadId" in query:  # abort multipart
+                        outer.uploads.pop(query["uploadId"][0], None)
+                    else:
+                        outer.objects.pop(self._obj_key(), None)
                 self.send_response(204)
                 self.send_header("Content-Length", "0")
                 self.end_headers()

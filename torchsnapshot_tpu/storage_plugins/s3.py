@@ -38,6 +38,16 @@ _TRANSIENT_STATUS = {429, 500, 502, 503, 504}
 _MAX_ATTEMPTS = 5
 _UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 
+# AWS rejects single PUTs over 5 GB; payloads past the threshold go through
+# multipart upload instead.  Normal checkpoint payloads stay far below this
+# (512 MB chunk/shard knobs), but an oversized pickled object or a merged
+# slab must not fail outright.  Env-overridable so tests can exercise the
+# multipart path with small objects.
+_MULTIPART_THRESHOLD_ENV = "TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES"
+_DEFAULT_MULTIPART_THRESHOLD = 5 * 1024 * 1024 * 1024
+_MULTIPART_PART_ENV = "TPUSNAP_S3_MULTIPART_PART_BYTES"
+_DEFAULT_MULTIPART_PART = 256 * 1024 * 1024  # AWS bounds: >=5 MB, <=10k parts
+
 
 def _hmac_sha256(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
@@ -226,6 +236,14 @@ class S3StoragePlugin(StoragePlugin):
             # memoryview body: requests uploads it without copying (the old
             # MemoryviewStream behavior), and retries re-send the same view.
             body = memoryview(contiguous(write_io.buf))
+            threshold = int(
+                os.environ.get(
+                    _MULTIPART_THRESHOLD_ENV, _DEFAULT_MULTIPART_THRESHOLD
+                )
+            )
+            if body.nbytes > threshold:
+                self._multipart_put(self._key(write_io.path), body)
+                return
             resp = self._request(
                 "PUT", self._url(self._key(write_io.path)), data=body
             )
@@ -236,6 +254,84 @@ class S3StoragePlugin(StoragePlugin):
                 )
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _put)
+
+    def _multipart_put(self, key: str, body: memoryview) -> None:
+        """Multipart upload for payloads over the single-PUT ceiling.
+
+        Parts are memoryview slices (no copy) sent sequentially on this
+        write's executor thread — concurrency across payloads already comes
+        from the scheduler's 16-way write fan-out, and each part rides
+        ``_request``'s retry loop independently (a transient mid-upload only
+        re-sends that part, not the whole object).  On any failure the
+        upload is aborted so S3 doesn't bill for orphaned parts."""
+        part_size = int(
+            os.environ.get(_MULTIPART_PART_ENV, _DEFAULT_MULTIPART_PART)
+        )
+        # AWS caps multipart uploads at 10k parts.
+        part_size = max(part_size, -(-body.nbytes // 10000))
+        resp = self._request("POST", self._url(key, "uploads"))
+        if resp.status_code != 200:
+            raise RuntimeError(
+                f"S3 initiate multipart for {key} failed: "
+                f"{resp.status_code} {resp.text[:200]}"
+            )
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        tree = ElementTree.fromstring(resp.content)
+        upload_el = tree.find(f"{ns}UploadId")
+        if upload_el is None:  # fakes may omit the namespace
+            upload_el = tree.find("UploadId")
+        if upload_el is None or not upload_el.text:
+            raise RuntimeError(f"S3 initiate multipart for {key}: no UploadId")
+        upload_id = urllib.parse.quote(upload_el.text, safe="")
+        try:
+            etags = []
+            for number, offset in enumerate(
+                range(0, body.nbytes, part_size), start=1
+            ):
+                part = body[offset : offset + part_size]
+                resp = self._request(
+                    "PUT",
+                    self._url(
+                        key, f"partNumber={number}&uploadId={upload_id}"
+                    ),
+                    data=part,
+                )
+                if resp.status_code != 200:
+                    raise RuntimeError(
+                        f"S3 part {number} of {key} failed: "
+                        f"{resp.status_code} {resp.text[:200]}"
+                    )
+                etags.append((number, resp.headers.get("ETag", "")))
+            complete = (
+                "<CompleteMultipartUpload>"
+                + "".join(
+                    f"<Part><PartNumber>{n}</PartNumber>"
+                    f"<ETag>{etag}</ETag></Part>"
+                    for n, etag in etags
+                )
+                + "</CompleteMultipartUpload>"
+            ).encode()
+            resp = self._request(
+                "POST", self._url(key, f"uploadId={upload_id}"), data=complete
+            )
+            # Complete can return 200 with an <Error> body (same documented
+            # AWS behavior CopyObject has): require the success element.
+            if (
+                resp.status_code != 200
+                or b"CompleteMultipartUploadResult" not in resp.content
+            ):
+                raise RuntimeError(
+                    f"S3 complete multipart for {key} failed: "
+                    f"{resp.status_code} {resp.text[:200]}"
+                )
+        except BaseException:
+            try:
+                self._request(
+                    "DELETE", self._url(key, f"uploadId={upload_id}")
+                )
+            except Exception:
+                pass  # abort is best-effort; the original error propagates
+            raise
 
     async def read(self, read_io: ReadIO) -> None:
         def _get() -> bytearray:
