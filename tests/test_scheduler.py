@@ -336,3 +336,66 @@ def test_memory_budget_env_override():
 
 def test_memory_budget_default_positive():
     assert get_process_memory_budget_bytes(PGWrapper()) > 0
+
+
+def test_progress_table_visible_on_slow_storage(caplog):
+    """The per-rank progress table (pipeline-state counts + RSS delta +
+    budget, reference scheduler.py:98-177) must surface on an interval while
+    writes crawl — at pod scale this line is how an operator spots a stuck
+    rank."""
+    import logging
+
+    from torchsnapshot_tpu import knobs
+
+    class _CrawlingStorage(MemoryStoragePlugin):
+        async def write(self, write_io):
+            await asyncio.sleep(0.03)
+            await super().write(write_io)
+
+    class _SmallStager(BufferStager):
+        async def stage_buffer(self, executor=None):
+            await asyncio.sleep(0.005)
+            return b"x" * 1024
+
+        def get_staging_cost_bytes(self) -> int:
+            return 1024
+
+    MemoryStoragePlugin.reset()
+    storage = _CrawlingStorage(root="progress")
+    write_reqs = [
+        WriteReq(path=f"p{i}", buffer_stager=_SmallStager()) for i in range(12)
+    ]
+    with knobs.override_progress_interval_s(0.01), caplog.at_level(
+        logging.INFO, logger="torchsnapshot_tpu.scheduler"
+    ):
+        pending = sync_execute_write_reqs(
+            write_reqs, storage, memory_budget_bytes=1 << 20, rank=3
+        )
+        pending.sync_complete()
+    tables = [r for r in caplog.messages if "write pipeline:" in r]
+    assert tables, "no progress table logged on slow storage"
+    line = tables[0]
+    for field in (
+        "[rank 3]",
+        "stageable/staging=",
+        "writing=",
+        "done=",
+        "rss",
+        "budget=",
+    ):
+        assert field in line, f"{field!r} missing from: {line}"
+
+    # knob at 0 disables the table entirely
+    MemoryStoragePlugin.reset()
+    caplog.clear()
+    with knobs.override_progress_interval_s(0), caplog.at_level(
+        logging.INFO, logger="torchsnapshot_tpu.scheduler"
+    ):
+        pending = sync_execute_write_reqs(
+            [WriteReq(path="q", buffer_stager=_SmallStager())],
+            _CrawlingStorage(root="progress2"),
+            memory_budget_bytes=1 << 20,
+            rank=0,
+        )
+        pending.sync_complete()
+    assert not any("write pipeline:" in m for m in caplog.messages)

@@ -26,6 +26,7 @@ ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR = (
 )
 MAX_READ_MERGE_GAP_ENV_VAR = _ENV_PREFIX + "MAX_READ_MERGE_GAP_BYTES"
 PARALLEL_READ_WAYS_ENV_VAR = _ENV_PREFIX + "PARALLEL_READ_WAYS"
+PROGRESS_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "PROGRESS_INTERVAL_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -97,6 +98,14 @@ def get_max_read_merge_gap_bytes() -> int:
     return _get_int_env(
         MAX_READ_MERGE_GAP_ENV_VAR, _DEFAULT_MAX_READ_MERGE_GAP_BYTES
     )
+
+
+def get_progress_interval_s() -> float:
+    """Seconds between scheduler progress-table lines (per-pipeline-state
+    counts + RSS delta + budget, the reference's per-rank operator view,
+    reference scheduler.py:98-177).  0 disables the table."""
+    val = os.environ.get(PROGRESS_INTERVAL_S_ENV_VAR)
+    return float(val) if val is not None else 5.0
 
 
 def get_per_rank_memory_budget_bytes_override() -> Optional[int]:
@@ -171,4 +180,10 @@ def override_max_read_merge_gap_bytes(value: int) -> Generator[None, None, None]
 @contextmanager
 def override_parallel_read_ways(value: int) -> Generator[None, None, None]:
     with _override_env(PARALLEL_READ_WAYS_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_progress_interval_s(value: float) -> Generator[None, None, None]:
+    with _override_env(PROGRESS_INTERVAL_S_ENV_VAR, str(value)):
         yield

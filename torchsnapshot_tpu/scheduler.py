@@ -138,23 +138,50 @@ class PendingIOWork:
         io_tasks: List["asyncio.Task"],
         budget_tracker: "_BudgetTracker",
         bytes_total: int,
+        reporter: Optional["_ProgressReporter"] = None,
     ) -> None:
         self._loop = loop
         self._executor = executor
         self._io_tasks = io_tasks
         self._budget_tracker = budget_tracker
         self.bytes_total = bytes_total
+        self._reporter = reporter
 
     def sync_complete(self) -> None:
         from .utils.loops import call_outside_loop
 
         call_outside_loop(self._sync_complete_impl)
 
+    async def _drain(self) -> None:
+        """Await all I/O tasks, surfacing the progress table on its interval
+        while writes crawl — this drain runs in the background thread of an
+        async snapshot, which is exactly where an operator needs to see a
+        stuck rank's pipeline state."""
+        reporter = self._reporter
+        interval = reporter._interval_s if reporter is not None else 0
+        pending = set(self._io_tasks)
+        while pending:
+            # FIRST_COMPLETED always: the first I/O failure must surface
+            # immediately (triggering cancel-and-drain upstream), never
+            # after every other in-flight write finishes.
+            done, pending = await asyncio.wait(
+                pending,
+                timeout=interval or None,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            for task in done:
+                if task.exception() is not None:
+                    raise task.exception()
+            if reporter is not None:
+                reporter.maybe_report(
+                    self._budget_tracker, inflight_io=len(pending)
+                )
+
     def _sync_complete_impl(self) -> None:
         begin = time.monotonic()
         try:
             if self._io_tasks:
-                self._loop.run_until_complete(asyncio.gather(*self._io_tasks))
+                self._loop.run_until_complete(self._drain())
         except BaseException:
             # First failure propagates; cancel and drain the rest so the loop
             # closes clean and staged host buffers release promptly.
@@ -223,6 +250,7 @@ async def execute_write_reqs(
             async with io_semaphore:
                 await pipeline.write_buffer()
             reporter.io_done += 1
+            reporter.bytes_done += pipeline.buf_sz_bytes
         finally:
             # Credit (and release the buffer) on every outcome — success,
             # storage failure, or cancellation during a pipeline teardown —
@@ -258,6 +286,7 @@ async def execute_write_reqs(
         budget.inflight -= 1
         staged_bytes += pipeline.buf_sz_bytes
         reporter.staged += 1
+        reporter.bytes_staged += pipeline.buf_sz_bytes
         io_task = asyncio.ensure_future(_io(pipeline))
         io_tasks.add(io_task)
         all_io_tasks.append(io_task)
@@ -270,8 +299,13 @@ async def execute_write_reqs(
         # guard, staging_tasks can be empty while over-budget requests wait
         # for in-flight writes to free budget — keep waiting on io_tasks.
         while staging_tasks or ready_for_staging:
+            # The timeout lets the progress table fire while a rank is
+            # budget-blocked on hung storage — the flagship stuck-rank case
+            # would otherwise log nothing (no task ever completes).
             done, _ = await asyncio.wait(
-                staging_tasks | io_tasks, return_when=asyncio.FIRST_COMPLETED
+                staging_tasks | io_tasks,
+                timeout=reporter._interval_s or None,
+                return_when=asyncio.FIRST_COMPLETED,
             )
             for task in done:
                 if task in staging_pipelines:
@@ -282,7 +316,12 @@ async def execute_write_reqs(
                 elif task.exception() is not None:
                     raise task.exception()  # I/O failure surfaces immediately
             dispatch_staging()
-            reporter.maybe_report(budget)
+            reporter.maybe_report(
+                budget,
+                pending=len(ready_for_staging),
+                staging=len(staging_tasks),
+                inflight_io=len(io_tasks),
+            )
     except BaseException:
         # Cancel-and-drain every outstanding task before re-raising
         # (reference scheduler.py:299-331 fails clean): no
@@ -337,6 +376,7 @@ async def execute_write_reqs(
         io_tasks=all_io_tasks,
         budget_tracker=budget,
         bytes_total=staged_bytes,
+        reporter=reporter,
     )
 
 
@@ -478,7 +518,9 @@ async def execute_read_reqs(
         dispatch_io()
         while io_tasks or consume_tasks:
             done, _ = await asyncio.wait(
-                io_tasks | consume_tasks, return_when=asyncio.FIRST_COMPLETED
+                io_tasks | consume_tasks,
+                timeout=reporter._interval_s or None,
+                return_when=asyncio.FIRST_COMPLETED,
             )
             for task in done:
                 if task in io_tasks:
@@ -497,8 +539,14 @@ async def execute_read_reqs(
                     budget.remaining += pipeline.consuming_cost
                     budget.inflight -= 1
                     reporter.io_done += 1
+                    reporter.bytes_done += pipeline.consuming_cost
             dispatch_io()
-            reporter.maybe_report(budget)
+            reporter.maybe_report(
+                budget,
+                pending=len(ready_for_io),
+                staging=len(io_tasks),
+                inflight_io=len(consume_tasks),
+            )
     except BaseException:
         # Mirror the write path: cancel-and-drain outstanding reads/consumes
         # before re-raising, releasing buffers and re-crediting the budget.
@@ -548,9 +596,11 @@ def _sync_execute_read_reqs_impl(
 
 
 class _ProgressReporter:
-    """Periodic progress/throughput logging (reference scheduler.py:98-177)."""
-
-    _INTERVAL_S = 5.0
+    """Periodic per-rank progress table (reference scheduler.py:98-177): at
+    pod scale this line is how an operator sees a stuck rank — which
+    pipeline state its requests are parked in, whether its budget is
+    exhausted, and whether RSS is drifting past the budget.  Interval via
+    the ``TPUSNAP_PROGRESS_INTERVAL_S`` knob (0 disables)."""
 
     def __init__(self, rank: int, total: int, verb: str) -> None:
         self.rank = rank
@@ -558,20 +608,58 @@ class _ProgressReporter:
         self.verb = verb
         self.staged = 0
         self.io_done = 0
+        self.bytes_staged = 0
+        self.bytes_done = 0
+        self._interval_s = knobs.get_progress_interval_s()
         self._last = time.monotonic()
         self._begin = self._last
+        try:
+            self._rss_base = psutil.Process().memory_info().rss
+        except Exception:
+            self._rss_base = None
 
-    def maybe_report(self, budget: _BudgetTracker) -> None:
+    def maybe_report(
+        self,
+        budget: _BudgetTracker,
+        pending: int = 0,
+        staging: int = 0,
+        inflight_io: int = 0,
+    ) -> None:
+        if not self._interval_s:
+            return
         now = time.monotonic()
-        if now - self._last < self._INTERVAL_S:
+        if now - self._last < self._interval_s:
             return
         self._last = now
+        if self._rss_base is not None:
+            try:
+                rss_delta = psutil.Process().memory_info().rss - self._rss_base
+                rss_str = f"{rss_delta / 1e6:+.0f}MB"
+            except Exception:
+                rss_str = "?"
+        else:
+            rss_str = "?"
+        stage_verb, io_verb = (
+            ("stageable/staging", "writing")
+            if self.verb == "write"
+            else ("unread/reading", "consuming")
+        )
         logger.info(
-            "[rank %d] %s progress: %d/%d done (%d staged), budget remaining %.1f MB",
+            "[rank %d] %s pipeline: %s=%d/%d %s=%d done=%d/%d "
+            "staged=%.1fMB completed=%.1fMB rss%s budget=%.1fMB "
+            "elapsed=%.0fs",
             self.rank,
             self.verb,
+            stage_verb,
+            pending,
+            staging,
+            io_verb,
+            inflight_io,
             self.io_done,
             self.total,
-            self.staged,
+            self.bytes_staged / 1e6,
+            self.bytes_done / 1e6,
+            rss_str,
             budget.remaining / 1e6,
+            now - self._begin,
         )
