@@ -436,6 +436,15 @@ def main() -> None:
             "fallback_reason": _BACKEND["fallback_reason"],
             "save_phases": _phases_brief(save_phases),
             "restore_phases": _phases_brief(restore_phases),
+            # Overlap evidence: phase wall-times summing past the save wall
+            # means checksum/d2h/fs_write ran concurrently (checksum off the
+            # critical path); a sum at/below the wall means they serialized.
+            "save_phase_sum_s": round(
+                sum(v["s"] for v in save_phases.values()), 3
+            ),
+            "save_phase_overlap_s": round(
+                max(0.0, sum(v["s"] for v in save_phases.values()) - save_s), 3
+            ),
         },
     }
     if _BACKEND["name"] == "cpu_fallback":

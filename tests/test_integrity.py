@@ -60,3 +60,38 @@ def test_checksum_disabled(tmp_path, monkeypatch):
     state = {"w": np.arange(16, dtype=np.float32)}
     snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
     assert snapshot.get_manifest()["0/m/w"].checksum is None
+
+
+def test_save_checksums_disabled_restore_still_verifies(tmp_path, monkeypatch):
+    """TPUSNAP_CHECKSUM_ON_SAVE=0 skips recording digests (for hosts whose
+    link rate outruns the hash) WITHOUT disabling restore-side verification
+    of snapshots that carry them."""
+    # snapshot A: checksums on
+    state = {"w": np.arange(256, dtype=np.float32)}
+    snap_a = Snapshot.take(str(tmp_path / "a"), {"m": StateDict(state)})
+    assert snap_a.get_manifest()["0/m/w"].checksum is not None
+
+    # snapshot B: save-side off -> no digests recorded, restore fine
+    monkeypatch.setenv("TPUSNAP_CHECKSUM_ON_SAVE", "0")
+    snap_b = Snapshot.take(str(tmp_path / "b"), {"m": StateDict(state)})
+    assert snap_b.get_manifest()["0/m/w"].checksum is None
+    dst = {"m": StateDict({"w": np.zeros(256, np.float32)})}
+    snap_b.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], state["w"])
+
+    # snapshot A still verifies (and still catches corruption) while the
+    # save-side knob is off
+    import os
+
+    entry = snap_a.get_manifest()["0/m/w"]
+    payload = os.path.join(str(tmp_path / "a"), entry.location)
+    with open(payload, "r+b") as f:
+        offset = (entry.byte_range[0] if entry.byte_range else 0) + 8
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(ChecksumError):
+        Snapshot(str(tmp_path / "a")).restore(
+            {"m": StateDict({"w": np.zeros(256, np.float32)})}
+        )

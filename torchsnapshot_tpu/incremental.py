@@ -89,7 +89,10 @@ class IncrementalStoragePlugin(StoragePlugin):
             def _matches() -> bool:
                 from . import integrity
 
-                return integrity.compute(contiguous(write_io.buf)) == expected
+                # digest(), not compute(): the comparison must run even when
+                # save-side checksum RECORDING is knobbed off, or every
+                # unchanged payload silently re-uploads in full.
+                return integrity.digest(contiguous(write_io.buf)) == expected
 
             # hash (GB/s-scale work) off the event loop; None = the loop's
             # default executor for plugins without their own pool

@@ -24,9 +24,22 @@ def checksums_enabled() -> bool:
     return os.environ.get("TPUSNAP_CHECKSUM", "1") not in ("0", "false", "")
 
 
-def compute(buf) -> Optional[str]:
-    if not checksums_enabled():
-        return None
+def save_checksums_enabled() -> bool:
+    """Whether saves RECORD digests.  ``TPUSNAP_CHECKSUM_ON_SAVE=0`` skips
+    computing them while restores keep verifying whatever digests snapshots
+    already carry — the escape hatch for hosts whose link rate outruns the
+    hash (restore-side verification is already free: the native fs plugin
+    fuses it into the read loop)."""
+    return checksums_enabled() and os.environ.get(
+        "TPUSNAP_CHECKSUM_ON_SAVE", "1"
+    ) not in ("0", "false", "")
+
+
+def digest(buf) -> Optional[str]:
+    """Unconditional xxh64 digest (None only when the native lib is absent).
+    Callers that hash for COMPARISON (incremental dedup deciding whether a
+    payload changed) use this directly — the save-side recording knob must
+    not silently disable dedup."""
     from .native_io import NativeFileIO
     from . import phase_stats
 
@@ -35,6 +48,29 @@ def compute(buf) -> Optional[str]:
         return None
     with phase_stats.timed("checksum", memoryview(buf).nbytes):
         return f"xxh64:{native.xxhash64(buf):016x}"
+
+
+def compute(buf) -> Optional[str]:
+    """Digest for RECORDING on a manifest entry; honors the save-side knob."""
+    if not save_checksums_enabled():
+        return None
+    return digest(buf)
+
+
+async def compute_on(buf, executor) -> Optional[str]:
+    """``compute`` on the executor: the native xxh64 releases the GIL, so
+    concurrent stagers' hashes overlap with each other and with storage I/O
+    instead of serializing on the event-loop thread (~100 ms per 512 MB
+    chunk at hash rate — the checksum must stay off the critical path)."""
+    if not save_checksums_enabled():
+        return None
+    if executor is None:
+        return digest(buf)
+    import asyncio
+
+    return await asyncio.get_running_loop().run_in_executor(
+        executor, digest, buf
+    )
 
 
 def verify(

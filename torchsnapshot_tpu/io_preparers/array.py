@@ -192,7 +192,7 @@ class ArrayBufferStager(BufferStager):
         if self._entry.serializer == Serializer.PICKLE.value:
             data = serialization.pickle_save_as_bytes(staging.to_host(obj))
             self._obj = None
-            self._entry.checksum = integrity.compute(data)
+            self._entry.checksum = await integrity.compute_on(data, executor)
             return data
         if staging.is_jax_array(obj):
             # Enqueue the async DMA now (we are being admitted by the
@@ -216,7 +216,7 @@ class ArrayBufferStager(BufferStager):
                 host = host.copy()
         self._obj = None  # drop the device reference promptly
         mv = serialization.array_as_memoryview(host)
-        self._entry.checksum = integrity.compute(mv)
+        self._entry.checksum = await integrity.compute_on(mv, executor)
         return mv
 
     def get_staging_cost_bytes(self) -> int:

@@ -62,7 +62,7 @@ class ObjectBufferStager(BufferStager):
         from .. import integrity
 
         data = serialization.pickle_save_as_bytes(self._obj)
-        self._entry.checksum = integrity.compute(data)
+        self._entry.checksum = await integrity.compute_on(data, executor)
         return data
 
     def get_staging_cost_bytes(self) -> int:
