@@ -132,9 +132,13 @@ class FileStore(KVStore):
         token = f"{os.getpid()}:{uuid.uuid4().hex}".encode()
         i = 0
         # Stale detection is clock-skew-free: the waiter times how long the
-        # SAME lock instance (inode+mtime identity) has blocked it on its
-        # own monotonic clock, rather than comparing the lock's mtime (NFS
-        # server time) against local wall time.
+        # SAME lock instance has blocked it on its own monotonic clock,
+        # rather than comparing the lock's mtime (NFS server time) against
+        # local wall time.  Identity is the holder's token CONTENT, not
+        # (inode, mtime): inode numbers recycle and mtime granularity can be
+        # a full second on NFS/ext3, so a broken lock's live successor could
+        # collide with its predecessor's identity and inherit a nearly
+        # expired staleness clock.
         waiting_since: Optional[tuple] = None
         while True:
             try:
@@ -146,12 +150,12 @@ class FileStore(KVStore):
                 break
             except FileExistsError:
                 try:
-                    st = os.stat(lock)
-                    ident = (st.st_ino, st.st_mtime)
+                    with open(lock, "rb") as f:
+                        ident = f.read()
                 except OSError:
-                    # Lock likely released between open and stat — but still
-                    # back off: on NFS a cached dentry can keep open()
-                    # failing while stat() raises ESTALE for the
+                    # Lock likely released between open and read — but still
+                    # back off: on NFS a cached dentry can keep open(O_EXCL)
+                    # failing while the read raises ESTALE for the
                     # revalidation window, and skipping the wait would turn
                     # that window into a hot spin against the server.
                     waiting_since = None
@@ -185,14 +189,14 @@ class FileStore(KVStore):
             except OSError:
                 pass
 
-    def _break_stale_lock(self, lock: str, ident: tuple) -> None:
+    def _break_stale_lock(self, lock: str, ident: bytes) -> None:
         """Break a lock whose holder is presumed dead.  The rename is atomic,
         so of N waiters that all observed the lock as stale exactly one wins
         and the rest fall back to normal acquisition."""
         try:
-            st = os.stat(lock)
-            if (st.st_ino, st.st_mtime) != ident:
-                return  # a fresh holder re-created it; not stale
+            with open(lock, "rb") as f:
+                if f.read() != ident:
+                    return  # a fresh holder re-created it; not stale
         except OSError:
             return  # gone already
         broken = f"{lock}.broken.{uuid.uuid4().hex}"
@@ -201,9 +205,10 @@ class FileStore(KVStore):
         except OSError:
             return  # another waiter broke it first
         try:
-            st = os.stat(broken)
-            if (st.st_ino, st.st_mtime) != ident:
-                # The stat→rename window let another waiter break the stale
+            with open(broken, "rb") as f:
+                grabbed_live = f.read() != ident
+            if grabbed_live:
+                # The read→rename window let another waiter break the stale
                 # lock AND a new holder re-acquire: what we renamed away is
                 # that holder's LIVE lock.  Put it back via link (restores
                 # the same inode; unlike rename it cannot clobber a third
