@@ -140,21 +140,27 @@ class FileStore(KVStore):
         # collide with its predecessor's identity and inherit a nearly
         # expired staleness clock.
         waiting_since: Optional[tuple] = None
-        while True:
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        # Acquisition is link(2), not O_EXCL-create-then-write: the token is
+        # written to a private temp file first, so the lock appears with its
+        # content ATOMICALLY and no reader can ever observe an empty lock —
+        # an empty identity would let two waiters' staleness clocks collide
+        # across different lock instances.
+        fd, tmp = tempfile.mkstemp(dir=self._root, prefix=".locktmp.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(token)
+            while True:
                 try:
-                    os.write(fd, token)
-                finally:
-                    os.close(fd)
-                break
-            except FileExistsError:
+                    os.link(tmp, lock)
+                    break
+                except FileExistsError:
+                    pass
                 try:
                     with open(lock, "rb") as f:
                         ident = f.read()
                 except OSError:
-                    # Lock likely released between open and read — but still
-                    # back off: on NFS a cached dentry can keep open(O_EXCL)
+                    # Lock likely released between link and read — but still
+                    # back off: on NFS a cached dentry can keep the link
                     # failing while the read raises ESTALE for the
                     # revalidation window, and skipping the wait would turn
                     # that window into a hot spin against the server.
@@ -171,6 +177,11 @@ class FileStore(KVStore):
                     continue
                 self.wait_hint(i)
                 i += 1
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         try:
             current = self.try_get(key)
             value = (int(current) if current is not None else 0) + amount
