@@ -197,11 +197,45 @@ class FakeS3Server:
                     return
                 upload_id = query["uploadId"][0]
                 number = int(query["partNumber"][0])
+                copy_source = self.headers.get("x-amz-copy-source")
                 with outer._lock:
                     upload = outer.uploads.get(upload_id)
                     if upload is None:
                         body = b"<Error><Code>NoSuchUpload</Code></Error>"
                         self.send_response(404)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    if copy_source:
+                        # UploadPartCopy: server-side ranged copy, no bytes
+                        # from the client.
+                        src_key = urllib.parse.unquote(copy_source.lstrip("/"))
+                        src = outer.objects.get(src_key)
+                        if src is None:
+                            body = b"<Error><Code>NoSuchKey</Code></Error>"
+                            self.send_response(404)
+                            self.send_header(
+                                "Content-Length", str(len(body))
+                            )
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
+                        range_header = self.headers.get(
+                            "x-amz-copy-source-range"
+                        )
+                        if range_header:
+                            spec = range_header.split("=", 1)[1]
+                            start_s, _, end_s = spec.partition("-")
+                            src = src[int(start_s) : int(end_s) + 1]
+                        upload["parts"][number] = src
+                        outer.copies += 1
+                        body = (
+                            "<CopyPartResult>"
+                            f"<ETag>\"fake-copy-etag-{number}\"</ETag>"
+                            "</CopyPartResult>"
+                        ).encode()
+                        self.send_response(200)
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
@@ -279,9 +313,17 @@ class FakeS3Server:
                 if self._maybe_fail():
                     return
                 with outer._lock:
-                    found = self._obj_key() in outer.objects
-                self.send_response(200 if found else 404)
-                self.send_header("Content-Length", "0")
+                    data = outer.objects.get(self._obj_key())
+                if data is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                # HEAD reports the real object size (copy_from_sibling sizes
+                # the CopyObject-vs-UploadPartCopy decision on it) but a HEAD
+                # response carries no body.
+                self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
 
             def do_DELETE(self):

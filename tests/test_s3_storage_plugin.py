@@ -182,3 +182,33 @@ def test_multipart_upload_true_5gb(s3_env):
     assert stored[:1024] == payload[:1024]
     assert stored[-1024:] == payload[-1024:]
     plugin.sync_close()
+
+
+def test_multipart_server_side_copy_over_5gb_limit(s3_env, monkeypatch):
+    """copy_from_sibling for an object over the CopyObject ceiling goes
+    through UploadPartCopy — server-side ranged part copies, zero bytes
+    through this host — where the reference's path fails outright and
+    re-uploads.  Limits shrunk so a 5 MB object exercises the identical
+    code."""
+    import asyncio
+
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    monkeypatch.setattr(S3StoragePlugin, "_COPY_MAX_BYTES", 1 << 20)
+    monkeypatch.setattr(S3StoragePlugin, "_COPY_PART_BYTES", 2 << 20)
+    plugin = _plugin(root="bkt/new")
+    payload = os.urandom(5 << 20)  # 5 MB -> 3 copy parts of 2/2/1 MB
+    s3_env.objects["bkt/base/big.bin"] = payload
+    uploaded_before = s3_env.put_bytes
+
+    ok = asyncio.run(plugin.copy_from_sibling("bkt/base", "big.bin"))
+    assert ok
+    assert s3_env.objects["bkt/new/big.bin"] == payload
+    assert s3_env.put_bytes == uploaded_before  # no client re-upload
+    assert s3_env.copies >= 3  # ranged server-side part copies
+    assert not s3_env.uploads  # completed, nothing orphaned
+
+    # a missing source still falls back cleanly
+    ok = asyncio.run(plugin.copy_from_sibling("bkt/base", "absent.bin"))
+    assert not ok
+    plugin.sync_close()

@@ -255,6 +255,54 @@ class S3StoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _put)
 
+    def _initiate_multipart(self, key: str) -> str:
+        """POST ?uploads → url-quoted UploadId (raises on failure)."""
+        resp = self._request("POST", self._url(key, "uploads"))
+        if resp.status_code != 200:
+            raise RuntimeError(
+                f"S3 initiate multipart for {key} failed: "
+                f"{resp.status_code} {resp.text[:200]}"
+            )
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        tree = ElementTree.fromstring(resp.content)
+        upload_el = tree.find(f"{ns}UploadId")
+        if upload_el is None:  # fakes may omit the namespace
+            upload_el = tree.find("UploadId")
+        if upload_el is None or not upload_el.text:
+            raise RuntimeError(f"S3 initiate multipart for {key}: no UploadId")
+        return urllib.parse.quote(upload_el.text, safe="")
+
+    def _complete_multipart(self, key: str, upload_id: str, etags) -> None:
+        complete = (
+            "<CompleteMultipartUpload>"
+            + "".join(
+                f"<Part><PartNumber>{n}</PartNumber>"
+                f"<ETag>{etag}</ETag></Part>"
+                for n, etag in etags
+            )
+            + "</CompleteMultipartUpload>"
+        ).encode()
+        resp = self._request(
+            "POST", self._url(key, f"uploadId={upload_id}"), data=complete
+        )
+        # Complete can return 200 with an <Error> body (same documented
+        # AWS behavior CopyObject has): require the success element.
+        if (
+            resp.status_code != 200
+            or b"CompleteMultipartUploadResult" not in resp.content
+        ):
+            raise RuntimeError(
+                f"S3 complete multipart for {key} failed: "
+                f"{resp.status_code} {resp.text[:200]}"
+            )
+
+    def _abort_multipart(self, key: str, upload_id: str) -> None:
+        """Best-effort: an un-aborted upload's parts are billed forever."""
+        try:
+            self._request("DELETE", self._url(key, f"uploadId={upload_id}"))
+        except Exception:
+            pass
+
     def _multipart_put(self, key: str, body: memoryview) -> None:
         """Multipart upload for payloads over the single-PUT ceiling.
 
@@ -269,20 +317,7 @@ class S3StoragePlugin(StoragePlugin):
         )
         # AWS caps multipart uploads at 10k parts.
         part_size = max(part_size, -(-body.nbytes // 10000))
-        resp = self._request("POST", self._url(key, "uploads"))
-        if resp.status_code != 200:
-            raise RuntimeError(
-                f"S3 initiate multipart for {key} failed: "
-                f"{resp.status_code} {resp.text[:200]}"
-            )
-        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
-        tree = ElementTree.fromstring(resp.content)
-        upload_el = tree.find(f"{ns}UploadId")
-        if upload_el is None:  # fakes may omit the namespace
-            upload_el = tree.find("UploadId")
-        if upload_el is None or not upload_el.text:
-            raise RuntimeError(f"S3 initiate multipart for {key}: no UploadId")
-        upload_id = urllib.parse.quote(upload_el.text, safe="")
+        upload_id = self._initiate_multipart(key)
         try:
             etags = []
             for number, offset in enumerate(
@@ -302,35 +337,9 @@ class S3StoragePlugin(StoragePlugin):
                         f"{resp.status_code} {resp.text[:200]}"
                     )
                 etags.append((number, resp.headers.get("ETag", "")))
-            complete = (
-                "<CompleteMultipartUpload>"
-                + "".join(
-                    f"<Part><PartNumber>{n}</PartNumber>"
-                    f"<ETag>{etag}</ETag></Part>"
-                    for n, etag in etags
-                )
-                + "</CompleteMultipartUpload>"
-            ).encode()
-            resp = self._request(
-                "POST", self._url(key, f"uploadId={upload_id}"), data=complete
-            )
-            # Complete can return 200 with an <Error> body (same documented
-            # AWS behavior CopyObject has): require the success element.
-            if (
-                resp.status_code != 200
-                or b"CompleteMultipartUploadResult" not in resp.content
-            ):
-                raise RuntimeError(
-                    f"S3 complete multipart for {key} failed: "
-                    f"{resp.status_code} {resp.text[:200]}"
-                )
+            self._complete_multipart(key, upload_id, etags)
         except BaseException:
-            try:
-                self._request(
-                    "DELETE", self._url(key, f"uploadId={upload_id}")
-                )
-            except Exception:
-                pass  # abort is best-effort; the original error propagates
+            self._abort_multipart(key, upload_id)
             raise
 
     async def read(self, read_io: ReadIO) -> None:
@@ -376,11 +385,12 @@ class S3StoragePlugin(StoragePlugin):
 
         await asyncio.get_running_loop().run_in_executor(self._get_executor(), _delete)
 
-    # AWS CopyObject rejects sources over 5 GB (multipart UploadPartCopy
-    # territory).  Our payloads are bounded well below this by the 512 MB
-    # chunk/shard knobs, but an oversized pickled object would hit it — skip
-    # the attempt rather than round-trip a guaranteed 400.
+    # AWS CopyObject rejects sources over 5 GB; bigger objects are
+    # server-side copied part-by-part with UploadPartCopy instead (the
+    # reference's aiobotocore path just fails there — incremental snapshots
+    # of oversized payloads would re-upload in full).
     _COPY_MAX_BYTES = 5 * 1024 * 1024 * 1024
+    _COPY_PART_BYTES = 1024 * 1024 * 1024
 
     async def copy_from_sibling(self, src_root: str, path: str) -> bool:
         src_bucket, _, src_prefix = src_root.partition("/")
@@ -393,8 +403,9 @@ class S3StoragePlugin(StoragePlugin):
             head = self._request("HEAD", src_url)
             if head.status_code != 200:
                 return False
-            if int(head.headers.get("Content-Length", 0)) > self._COPY_MAX_BYTES:
-                return False
+            src_bytes = int(head.headers.get("Content-Length", 0))
+            if src_bytes > self._COPY_MAX_BYTES:
+                return self._multipart_copy(src_key, path, src_bytes)
             headers = {
                 "x-amz-copy-source": urllib.parse.quote(
                     f"/{self.bucket}/{src_key}", safe="/"
@@ -414,6 +425,56 @@ class S3StoragePlugin(StoragePlugin):
         return await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _copy
         )
+
+    def _multipart_copy(self, src_key: str, path: str, src_bytes: int) -> bool:
+        """Server-side copy of a >5 GB object via UploadPartCopy: no byte
+        ever traverses this host.  Returns False on any failure (after
+        aborting the upload, so no orphaned parts accrue charges) and the
+        caller falls back to a normal write."""
+        dst_key = self._key(path)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        try:
+            upload_id = self._initiate_multipart(dst_key)
+        except RuntimeError:
+            return False
+        try:
+            etags = []
+            for number, offset in enumerate(
+                range(0, src_bytes, self._COPY_PART_BYTES), start=1
+            ):
+                end = min(offset + self._COPY_PART_BYTES, src_bytes) - 1
+                resp = self._request(
+                    "PUT",
+                    self._url(
+                        dst_key, f"partNumber={number}&uploadId={upload_id}"
+                    ),
+                    headers={
+                        "x-amz-copy-source": urllib.parse.quote(
+                            f"/{self.bucket}/{src_key}", safe="/"
+                        ),
+                        # inclusive both ends, like HTTP Range
+                        "x-amz-copy-source-range": f"bytes={offset}-{end}",
+                    },
+                )
+                # UploadPartCopy can 200 with an <Error> body mid-copy, same
+                # as CopyObject: require the success element.
+                if (
+                    resp.status_code != 200
+                    or b"CopyPartResult" not in resp.content
+                ):
+                    raise RuntimeError(
+                        f"UploadPartCopy {number} failed: {resp.status_code}"
+                    )
+                part_tree = ElementTree.fromstring(resp.content)
+                etag_el = part_tree.find(f"{ns}ETag")
+                if etag_el is None:
+                    etag_el = part_tree.find("ETag")
+                etags.append((number, etag_el.text if etag_el is not None else ""))
+            self._complete_multipart(dst_key, upload_id, etags)
+            return True
+        except Exception:
+            self._abort_multipart(dst_key, upload_id)
+            return False
 
     async def exists(self, path: str) -> bool:
         def _head() -> bool:
