@@ -54,6 +54,54 @@ def test_unchanged_payloads_hard_linked(tmp_path):
 
 
 @needs_native
+def test_unchanged_slabs_dedup_through_batching(tmp_path):
+    """Slab locations are deterministic (digest of member paths), so an
+    incremental save dedups whole slabs of small payloads — a uuid-named
+    slab could never match its predecessor, silently disabling dedup for
+    everything under the slab threshold."""
+    rng = np.random.RandomState(1)
+    frozen = {f"f{i:02d}": rng.rand(128).astype(np.float32) for i in range(8)}
+    hot = {f"h{i:02d}": np.zeros(128, np.float32) for i in range(8)}
+    # 2 KB slab cap: the 8 frozen (plan-ordered together) and 8 hot arrays
+    # land in separate slabs of 4 x 512 B members each
+    with knobs.override_slab_size_threshold_bytes(2048):
+        s1 = Snapshot.take(
+            str(tmp_path / "s1"),
+            {"m": StateDict({**frozen, **hot})},
+        )
+        hot2 = {k: v + 1.0 for k, v in hot.items()}
+        s2 = Snapshot.take(
+            str(tmp_path / "s2"),
+            {"m": StateDict({**frozen, **hot2})},
+            incremental_from=str(tmp_path / "s1"),
+        )
+    man1 = s1.get_manifest()
+    man2 = s2.get_manifest()
+    linked = rewritten = 0
+    for name in frozen:
+        loc1, loc2 = man1[f"0/m/{name}"].location, man2[f"0/m/{name}"].location
+        assert loc1 == loc2, "slab location not deterministic"
+        assert loc1.startswith("batched/")
+        if _inode(tmp_path / "s2" / loc2) == _inode(tmp_path / "s1" / loc1):
+            linked += 1
+    for name in hot:
+        loc2 = man2[f"0/m/{name}"].location
+        if _inode(tmp_path / "s2" / loc2) != _inode(
+            tmp_path / "s1" / man1[f"0/m/{name}"].location
+        ):
+            rewritten += 1
+    assert linked == len(frozen), "unchanged slabs were not deduplicated"
+    assert rewritten == len(hot), "changed slabs were wrongly deduplicated"
+
+    dst = {"m": StateDict({})}
+    s2.restore(dst)
+    for name, arr in frozen.items():
+        np.testing.assert_array_equal(dst["m"][name], arr)
+    for name, arr in hot2.items():
+        np.testing.assert_array_equal(dst["m"][name], arr)
+
+
+@needs_native
 def test_incremental_survives_base_pruning(tmp_path):
     import shutil
 

@@ -3,7 +3,7 @@
 TPU-native analogue of the reference's ``torchsnapshot/batcher.py``
 (/root/reference/torchsnapshot/batcher.py:51-486).  Many-small-files is the
 classic checkpoint bottleneck (object stores bill per request; posix pays per
-syscall): batchable small writes are packed into ``batched/<uuid>`` slab
+syscall): batchable small writes are packed into ``batched/<digest>`` slab
 files up to the slab threshold (128 MB knob), and their manifest entries are
 rewritten in place to (slab location, byte_range) — reference :335-353.
 
@@ -24,8 +24,8 @@ spanning read fanned out to sub-consumers (reference batch_read_requests,
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
-import uuid
 from collections import defaultdict
 from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
@@ -114,7 +114,14 @@ def batch_write_requests(
         if len(slab) == 1:
             out_reqs.append(slab[0][0])
         else:
-            location = f"batched/{uuid.uuid4().hex}"
+            # Deterministic location (digest of the member paths): two
+            # snapshots of the same app state produce identically-named
+            # slabs, so incremental saves can dedup an unchanged slab by
+            # path+checksum — a uuid name would defeat dedup for every
+            # payload under the slab threshold.  Member sets are disjoint
+            # within one snapshot, so names cannot collide.
+            member_key = "|".join(wr.path for wr, _, _ in slab).encode()
+            location = f"batched/{hashlib.sha1(member_key).hexdigest()[:24]}"
             offset = 0
             members: List[Tuple[BufferStager, int, int]] = []
             for wr, entry, nbytes in slab:

@@ -18,8 +18,10 @@ exactly the upload bandwidth the north star is measured on).  Properties:
   independent objects)
 - pruning the base snapshot is safe: linked payloads survive via their
   remaining link, copied objects are independent
-- batched slabs never dedup (uuid paths), so the knob to maximize dedup is
-  ``TPUSNAP_DISABLE_BATCHER=1`` or large params (unbatched anyway)
+- batched slabs dedup as units: slab locations are deterministic (digest of
+  member paths, batcher.py), and an incoming slab matches when every
+  member's digest equals the base entry at the same byte range — one
+  changed member rewrites that slab, untouched slabs dedup whole
 - backends without server-side copy and any hash mismatch/missing base file
   fall back to a normal write — correctness never depends on the
   optimization
@@ -42,15 +44,22 @@ from .manifest import (
 logger = logging.getLogger(__name__)
 
 
-def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, str]:
-    """location → checksum for every payload in a snapshot manifest."""
-    out: Dict[str, str] = {}
+def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, object]:
+    """location → expected digest(s) for every payload in a manifest:
+    a plain checksum string for whole-file payloads, or a
+    {(start, end): checksum} dict for slab locations shared by several
+    byte-ranged members."""
+    out: Dict[str, object] = {}
 
     def _add(entry: TensorEntry) -> None:
-        # Batched payloads share a location with other entries; the whole
-        # slab's bytes won't match a single entry's digest — skip them.
-        if entry.checksum is not None and entry.byte_range is None:
+        if entry.checksum is None:
+            return
+        if entry.byte_range is None:
             out[entry.location] = entry.checksum
+            return
+        ranges = out.setdefault(entry.location, {})
+        if isinstance(ranges, dict):
+            ranges[tuple(entry.byte_range)] = entry.checksum
 
     for entry in metadata.manifest.values():
         if isinstance(entry, TensorEntry):
@@ -66,6 +75,39 @@ def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, str]:
     return out
 
 
+def _slab_matches(buf, expected: Dict[tuple, str]) -> bool:
+    """Whether a staged slab equals the base snapshot's slab member-by-
+    member: every base byte range must line up with the incoming bytes and
+    every member digest must match.  Membership changes alter the slab's
+    deterministic location before this is ever called; size changes fail
+    the range lineup here."""
+    from . import integrity
+    from .io_types import ScatterBuffer
+
+    ranges = sorted(expected.items())
+    offset = 0
+    if isinstance(buf, ScatterBuffer):
+        # Parts are member buffers in offset order — compare 1:1 without
+        # joining.
+        if len(buf.parts) != len(ranges):
+            return False
+        for ((start, end), checksum), part in zip(ranges, buf.parts):
+            if start != offset or end - start != part.nbytes:
+                return False
+            if integrity.digest(part) != checksum:
+                return False
+            offset = end
+        return True
+    view = memoryview(buf).cast("B")
+    for (start, end), checksum in ranges:
+        if start != offset or end > view.nbytes:
+            return False
+        if integrity.digest(view[start:end]) != checksum:
+            return False
+        offset = end
+    return offset == view.nbytes
+
+
 class IncrementalStoragePlugin(StoragePlugin):
     """Wraps any plugin with server-side copy support; duplicates unchanged
     payloads from a base snapshot instead of rewriting them."""
@@ -74,7 +116,7 @@ class IncrementalStoragePlugin(StoragePlugin):
         self,
         inner: StoragePlugin,
         base_root: str,
-        base_checksums: Dict[str, str],
+        base_checksums: Dict[str, object],
     ) -> None:
         self._inner = inner
         self._base_root = base_root
@@ -92,6 +134,8 @@ class IncrementalStoragePlugin(StoragePlugin):
                 # digest(), not compute(): the comparison must run even when
                 # save-side checksum RECORDING is knobbed off, or every
                 # unchanged payload silently re-uploads in full.
+                if isinstance(expected, dict):
+                    return _slab_matches(write_io.buf, expected)
                 return integrity.digest(contiguous(write_io.buf)) == expected
 
             # hash (GB/s-scale work) off the event loop; None = the loop's
