@@ -154,7 +154,15 @@ class FileStore(KVStore):
                     os.link(tmp, lock)
                     break
                 except FileExistsError:
-                    pass
+                    # NFS caveat: link(2) is not idempotent — the server can
+                    # apply the link, lose the reply, and the retransmit
+                    # returns EEXIST.  st_nlink == 2 on our temp file means
+                    # the link actually landed: we hold the lock.
+                    try:
+                        if os.stat(tmp).st_nlink == 2:
+                            break
+                    except OSError:
+                        pass
                 try:
                     with open(lock, "rb") as f:
                         ident = f.read()
