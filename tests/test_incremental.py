@@ -294,3 +294,49 @@ def test_incremental_and_retention_compose_on_s3(monkeypatch):
         np.testing.assert_array_equal(dst["m"]["backbone"], backbone)
     finally:
         server.stop()
+
+
+@needs_native
+def test_slab_dedup_random_change_sets(tmp_path):
+    """Randomized: change an arbitrary subset of small arrays; exactly the
+    slabs containing a changed member must rewrite, every untouched slab
+    must hard-link to the base."""
+    rng = np.random.RandomState(7)
+    n = 24
+    base_arrays = {
+        f"p{i:02d}": rng.rand(96).astype(np.float32) for i in range(n)
+    }
+    with knobs.override_slab_size_threshold_bytes(1024):
+        s1 = Snapshot.take(
+            str(tmp_path / "s1"), {"m": StateDict(dict(base_arrays))}
+        )
+        for trial in range(3):
+            changed = set(
+                rng.choice(sorted(base_arrays), size=rng.randint(1, 8), replace=False)
+            )
+            arrays2 = {
+                k: (v + 1.0 if k in changed else v.copy())
+                for k, v in base_arrays.items()
+            }
+            dst_dir = tmp_path / f"s2_{trial}"
+            s2 = Snapshot.take(
+                str(dst_dir),
+                {"m": StateDict(arrays2)},
+                incremental_from=str(tmp_path / "s1"),
+            )
+            man2 = s2.get_manifest()
+            # slab -> does it contain a changed member?
+            slab_dirty = {}
+            for name in base_arrays:
+                loc = man2[f"0/m/{name}"].location
+                slab_dirty[loc] = slab_dirty.get(loc, False) or name in changed
+            for loc, dirty in slab_dirty.items():
+                same_inode = _inode(dst_dir / loc) == _inode(tmp_path / "s1" / loc)
+                if dirty:
+                    assert not same_inode, f"{loc} dirty but deduplicated"
+                else:
+                    assert same_inode, f"{loc} clean but rewritten"
+            dst = {"m": StateDict({})}
+            s2.restore(dst)
+            for k, v in arrays2.items():
+                np.testing.assert_array_equal(dst["m"][k], v)

@@ -292,3 +292,39 @@ def test_snapshot_metadata_json_roundtrip(manifest, world_size):
     assert rebuilt.manifest == md.manifest
     # and the yaml alias the reference exposes reads the same bytes
     assert SnapshotMetadata.from_yaml(md.to_yaml()).manifest == md.manifest
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    n_arrays=st.integers(min_value=2, max_value=24),
+    sizes_seed=st.integers(min_value=0, max_value=2**31),
+)
+def test_slab_locations_deterministic(n_arrays, sizes_seed):
+    """The same write plan must always produce the same slab locations
+    (incremental dedup matches slabs by path), and distinct slabs within a
+    plan must never collide."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.batcher import batch_write_requests
+    from torchsnapshot_tpu.io_preparer import prepare_write
+
+    rng = np.random.RandomState(sizes_seed % (2**31))
+    shapes = [int(rng.randint(1, 200)) for _ in range(n_arrays)]
+
+    def plan():
+        entries, reqs = {}, []
+        for i, n in enumerate(shapes):
+            # content varies run to run; only the PLAN determines names
+            entry, wr = prepare_write(
+                rng.rand(n).astype(np.float32), f"a{i}", rank=0, replicated=False
+            )
+            entries[f"a{i}"] = entry
+            reqs += wr
+        with knobs.override_slab_size_threshold_bytes(512):
+            entries, out = batch_write_requests(entries, reqs)
+        return {k: e.location for k, e in entries.items()}, out
+
+    locs1, out1 = plan()
+    locs2, out2 = plan()
+    assert locs1 == locs2, "slab naming depends on something besides the plan"
+    slab_paths = [wr.path for wr in out1 if wr.path.startswith("batched/")]
+    assert len(slab_paths) == len(set(slab_paths)), "slab name collision"

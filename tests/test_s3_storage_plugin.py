@@ -212,3 +212,24 @@ def test_multipart_server_side_copy_over_5gb_limit(s3_env, monkeypatch):
     ok = asyncio.run(plugin.copy_from_sibling("bkt/base", "absent.bin"))
     assert not ok
     plugin.sync_close()
+
+
+def test_multipart_boundary_sizes(s3_env, monkeypatch):
+    """Part-boundary off-by-ones: payloads at exactly N*part, N*part±1 must
+    all round-trip through multipart with correct assembly."""
+    part = 1 << 20
+    monkeypatch.setenv("TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES", str(1 << 18))
+    monkeypatch.setenv("TPUSNAP_S3_MULTIPART_PART_BYTES", str(part))
+    plugin = _plugin(root="bkt")
+    for size in (part, part - 1, part + 1, 2 * part, 2 * part + 1, 3 * part - 1):
+        payload = os.urandom(size)
+        plugin.sync_write(WriteIO(path=f"b{size}.bin", buf=payload))
+        assert s3_env.objects[f"bkt/b{size}.bin"] == payload, size
+        read_io = ReadIO(path=f"b{size}.bin")
+        plugin.sync_read(read_io)
+        assert bytes(read_io.buf) == payload, size
+    # every size actually took the multipart path (a regressed threshold
+    # parse would fall back to single PUT and pass vacuously)
+    assert s3_env.multipart_completed == 6
+    assert not s3_env.uploads
+    plugin.sync_close()
