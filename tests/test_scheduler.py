@@ -399,3 +399,40 @@ def test_progress_table_visible_on_slow_storage(caplog):
         )
         pending.sync_complete()
     assert not any("write pipeline:" in m for m in caplog.messages)
+
+
+def test_pending_io_drain_fails_fast():
+    """The PendingIOWork drain must surface the FIRST I/O failure
+    immediately — not after every other in-flight write finishes (the
+    drain's progress-reporting rewrite must keep gather()'s fail-fast)."""
+    import time
+
+    class _FailFastStorage(MemoryStoragePlugin):
+        async def write(self, write_io):
+            if write_io.path == "poison":
+                await asyncio.sleep(0.05)
+                raise RuntimeError("poison write failed")
+            await asyncio.sleep(1.0)  # healthy writes crawl
+            await super().write(write_io)
+
+    class _InstantStager(BufferStager):
+        async def stage_buffer(self, executor=None):
+            return b"x" * 64
+
+        def get_staging_cost_bytes(self) -> int:
+            return 64
+
+    MemoryStoragePlugin.reset()
+    storage = _FailFastStorage(root="failfast")
+    write_reqs = [
+        WriteReq(path=("poison" if i == 0 else f"slow{i}"), buffer_stager=_InstantStager())
+        for i in range(6)
+    ]
+    pending = sync_execute_write_reqs(
+        write_reqs, storage, memory_budget_bytes=1 << 20, rank=0
+    )
+    begin = time.monotonic()
+    with pytest.raises(RuntimeError, match="poison"):
+        pending.sync_complete()
+    elapsed = time.monotonic() - begin
+    assert elapsed < 0.9, f"failure surfaced after {elapsed:.2f}s (not fail-fast)"
