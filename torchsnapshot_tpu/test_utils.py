@@ -85,6 +85,12 @@ def rand_state_dict(seed: int, shapes: Dict[str, tuple]) -> Dict[str, np.ndarray
 def _proc_entry(
     fn: Callable, rank: int, world_size: int, store_path: str, conn: Any
 ) -> None:
+    # An ambient production TPUSNAP_STORE_ADDR (exported on a dev box or CI
+    # host for a real job) must not silently reroute every test's
+    # coordination to an external — possibly dead — server; tests that WANT
+    # the TCP store opt in with TPUSNAP_TEST_KEEP_STORE_ADDR.
+    if not os.environ.get("TPUSNAP_TEST_KEEP_STORE_ADDR"):
+        os.environ.pop("TPUSNAP_STORE_ADDR", None)
     os.environ["TPUSNAP_STORE_PATH"] = store_path
     os.environ["TPUSNAP_RANK"] = str(rank)
     os.environ["TPUSNAP_WORLD_SIZE"] = str(world_size)
@@ -99,13 +105,16 @@ def _proc_entry(
 
 def make_test_pg():
     """PGWrapper for the current test subprocess, from env set by
-    run_with_procs."""
-    from .dist_store import FileStore
+    run_with_procs — through the PRODUCTION store resolution
+    (get_or_create_store), so a test that pre-sets ``TPUSNAP_STORE_ADDR``
+    runs the whole snapshot protocol over the C++ TCP store instead of the
+    FileStore run_with_procs provides by default."""
+    from .dist_store import get_or_create_store
     from .pg_wrapper import PGWrapper
 
     rank = int(os.environ["TPUSNAP_RANK"])
     world_size = int(os.environ["TPUSNAP_WORLD_SIZE"])
-    store = FileStore(os.environ["TPUSNAP_STORE_PATH"])
+    store = get_or_create_store(rank, world_size)
     return PGWrapper(store=store, rank=rank, world_size=world_size)
 
 
