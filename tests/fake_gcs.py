@@ -35,7 +35,9 @@ class FakeGCSServer:
         self.fail_put_chunks = 0  # fail the next N chunk PUTs
         self.fail_at_chunks = set()  # fail specific 1-based chunk PUT indices
         self.chunk_puts = 0
-        self.copies = 0  # server-side copyTo calls
+        self.copies = 0  # completed server-side copies (copyTo/rewriteTo)
+        self.rewrite_rounds = 1  # >1: rewriteTo needs N token-carrying calls
+        self._rewrite_progress: dict = {}
         self._lock = threading.Lock()
         outer = self
 
@@ -59,19 +61,61 @@ class FakeGCSServer:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 mc = re.match(
-                    r"/storage/v1/b/([^/]+)/o/(.+)/copyTo/b/([^/]+)/o/(.+)",
+                    r"/storage/v1/b/([^/]+)/o/(.+)/(copyTo|rewriteTo)/b/([^/]+)/o/(.+)",
                     split.path,
                 )
                 if mc:
                     src = f"{mc.group(1)}/{urllib.parse.unquote(mc.group(2))}"
-                    dst = f"{mc.group(3)}/{urllib.parse.unquote(mc.group(4))}"
+                    dst = f"{mc.group(4)}/{urllib.parse.unquote(mc.group(5))}"
+                    rewrite = mc.group(3) == "rewriteTo"
+                    query = urllib.parse.parse_qs(split.query)
                     with outer._lock:
                         data = outer.objects.get(src)
                         if data is None:
                             return self._reply(404)
+                        if rewrite and outer.rewrite_rounds > 1:
+                            # Simulate a multi-round rewrite: the first
+                            # N-1 calls return done=false + a token (the
+                            # real API does this for big cross-class
+                            # copies); only a call carrying the token
+                            # completes.
+                            token = query.get("rewriteToken", [None])[0]
+                            round_no = outer._rewrite_progress.get(
+                                (src, dst), 0
+                            )
+                            if token is None and round_no:
+                                outer._rewrite_progress[(src, dst)] = 0
+                                round_no = 0
+                            if round_no < outer.rewrite_rounds - 1:
+                                outer._rewrite_progress[(src, dst)] = (
+                                    round_no + 1
+                                )
+                                done_bytes = (
+                                    len(data)
+                                    * (round_no + 1)
+                                    // outer.rewrite_rounds
+                                )
+                                out = json.dumps(
+                                    {
+                                        "done": False,
+                                        "rewriteToken": f"tok{round_no + 1}",
+                                        "totalBytesRewritten": str(done_bytes),
+                                        "objectSize": str(len(data)),
+                                    }
+                                ).encode()
+                                return self._reply(
+                                    200,
+                                    out,
+                                    {"Content-Type": "application/json"},
+                                )
+                            outer._rewrite_progress.pop((src, dst), None)
                         outer.objects[dst] = data
                         outer.copies += 1
-                    out = json.dumps({"name": dst}).encode()
+                    out = json.dumps(
+                        {"done": True, "resource": {"name": dst}}
+                        if rewrite
+                        else {"name": dst}
+                    ).encode()
                     return self._reply(
                         200, out, {"Content-Type": "application/json"}
                     )

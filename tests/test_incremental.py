@@ -261,6 +261,36 @@ def test_incremental_on_gcs_server_side_copy(monkeypatch):
         server.stop()
 
 
+def test_gcs_rewrite_token_continuation(monkeypatch):
+    """Large/cross-class GCS copies return done=false + rewriteToken for N
+    rounds before completing; the plugin must loop the token through (a
+    single-call copyTo would time out on multi-GB sources)."""
+    import asyncio
+
+    import numpy as np
+
+    from fake_gcs import FakeGCSServer
+    from torchsnapshot_tpu.storage_plugins.gcs import GCSStoragePlugin
+
+    server = FakeGCSServer()
+    try:
+        monkeypatch.setenv("TPUSNAP_GCS_ENDPOINT", server.endpoint)
+        server.rewrite_rounds = 3  # two done=false rounds, then done
+        plugin = GCSStoragePlugin(root="bkt/new")
+        payload = np.random.RandomState(2).bytes(1 << 16)
+        server.objects["bkt/base/big.bin"] = payload
+        ok = asyncio.run(plugin.copy_from_sibling("bkt/base", "big.bin"))
+        assert ok
+        assert server.objects["bkt/new/big.bin"] == payload
+        assert server.copies == 1
+        # missing source still falls back cleanly
+        ok = asyncio.run(plugin.copy_from_sibling("bkt/base", "absent.bin"))
+        assert not ok
+        plugin.sync_close()
+    finally:
+        server.stop()
+
+
 def test_incremental_and_retention_compose_on_s3(monkeypatch):
     """Pruning the base snapshot must not break an incremental successor:
     server-side copies are full independent objects (the object-store

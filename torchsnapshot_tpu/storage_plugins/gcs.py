@@ -24,6 +24,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
+from urllib.parse import quote as _quote
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from ..memoryview_stream import MemoryviewStream
@@ -292,28 +293,58 @@ class GCSStoragePlugin(StoragePlugin):
             return False
 
         def _copy() -> bool:
+            # objects.rewrite, not copyTo: copyTo is a single call that can
+            # time out on multi-GB sources; rewrite returns done=false + a
+            # rewriteToken for as many continuation calls as the copy needs
+            # (Google's documented path for large/cross-class copies).
             src_name = (
                 f"{src_prefix.strip('/')}/{path}" if src_prefix else path
             )
-            url = (
+            base_url = (
                 f"{self._download_base}/storage/v1/b/{self.bucket_name}/o/"
                 + src_name.replace("/", "%2F")
-                + f"/copyTo/b/{self.bucket_name}/o/"
+                + f"/rewriteTo/b/{self.bucket_name}/o/"
                 + self._blob_url(path).replace("/", "%2F")
             )
             session = self._session()
-            while True:
+            token: Optional[str] = None
+            last_total = -1
+            # Round cap: a misbehaving endpoint replaying done=false forever
+            # must fall back to a normal write, not hang the snapshot.  Real
+            # rewrites move ~1 GiB+ per round, so the cap only binds on
+            # pathological servers.
+            for _ in range(1024):
+                url = base_url
+                if token:
+                    url += "?rewriteToken=" + _quote(token, safe="")
                 try:
                     resp = session.post(url)
                     if resp.status_code == 404:
                         return False
                     resp.raise_for_status()
-                    self._retry.report_progress()
-                    return True
+                    payload = resp.json()
+                    if payload.get("done", True):
+                        self._retry.report_progress()
+                        return True
+                    token = payload.get("rewriteToken")
+                    if not token:
+                        return False  # malformed continuation: fall back
+                    # Refresh the shared deadline only on REAL progress —
+                    # a static done=false replay must run into the
+                    # no-progress timeout like any other stalled transfer.
+                    total = int(payload.get("totalBytesRewritten", 0) or 0)
+                    if total > last_total:
+                        last_total = total
+                        self._retry.report_progress()
+                    else:
+                        self._retry.check_and_backoff(
+                            RuntimeError("rewrite made no progress")
+                        )
                 except Exception as e:  # noqa: BLE001
                     if not _is_transient(e):
                         raise
                     self._retry.check_and_backoff(e)
+            return False
 
         return await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _copy
