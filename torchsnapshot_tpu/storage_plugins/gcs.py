@@ -323,27 +323,32 @@ class GCSStoragePlugin(StoragePlugin):
                         return False
                     resp.raise_for_status()
                     payload = resp.json()
-                    if payload.get("done", True):
-                        self._retry.report_progress()
-                        return True
-                    token = payload.get("rewriteToken")
-                    if not token:
-                        return False  # malformed continuation: fall back
-                    # Refresh the shared deadline only on REAL progress —
-                    # a static done=false replay must run into the
-                    # no-progress timeout like any other stalled transfer.
-                    total = int(payload.get("totalBytesRewritten", 0) or 0)
-                    if total > last_total:
-                        last_total = total
-                        self._retry.report_progress()
-                    else:
-                        self._retry.check_and_backoff(
-                            RuntimeError("rewrite made no progress")
-                        )
                 except Exception as e:  # noqa: BLE001
                     if not _is_transient(e):
                         raise
                     self._retry.check_and_backoff(e)
+                    continue
+                if payload.get("done", True):
+                    self._retry.report_progress()
+                    return True
+                token = payload.get("rewriteToken")
+                if not token:
+                    return False  # malformed continuation: fall back
+                # Refresh the shared deadline only on REAL progress — a
+                # static done=false replay must run into the no-progress
+                # timeout like any other stalled transfer.  This
+                # check_and_backoff sits OUTSIDE the try: its terminal
+                # TimeoutError is the give-up signal and must propagate
+                # (the incremental wrapper catches it and falls back to a
+                # full write), not be reclassified as a transient.
+                total = int(payload.get("totalBytesRewritten", 0) or 0)
+                if total > last_total:
+                    last_total = total
+                    self._retry.report_progress()
+                else:
+                    self._retry.check_and_backoff(
+                        RuntimeError("rewrite made no progress")
+                    )
             return False
 
         return await asyncio.get_running_loop().run_in_executor(
