@@ -88,13 +88,13 @@ def train(ckpt_dir: str, stop_after: int) -> tuple:
         "progress": progress,
         "rng": RNGState(),
     }
-    latest = manager.latest_step()
+    latest = manager.restore_latest(app_state)  # the resume-if-possible idiom
     if latest is not None:
-        manager.snapshot(latest).restore(app_state)
         params = dict(app_state["model"])
         opt_state = app_state["optim"]["opt"]
         print(f"resumed from step {progress['step']} (snapshot {latest})")
 
+    resumed_from = latest
     pending = None
     ran_here = 0
     while progress["step"] < TOTAL_STEPS and ran_here < stop_after:
@@ -115,7 +115,7 @@ def train(ckpt_dir: str, stop_after: int) -> tuple:
             )
     if pending is not None:
         pending.wait()
-    return progress["step"], params
+    return progress["step"], params, resumed_from
 
 
 def main() -> None:
@@ -124,15 +124,20 @@ def main() -> None:
     )
 
     # Phase 1: run 7 steps, then "crash" (process would die here).
-    step, _ = train(ckpt_dir, stop_after=7)
-    assert step == 7
+    step, _, resumed_from = train(ckpt_dir, stop_after=7)
+    assert step == 7 and resumed_from is None
     print(f"-- simulated crash after step {step}; latest committed "
           f"snapshot is step {SAVE_EVERY * (step // SAVE_EVERY)} --")
 
     # Phase 2: a fresh invocation resumes from the latest committed
     # snapshot (step 4) and finishes the run.
-    final_step, resumed_params = train(ckpt_dir, stop_after=TOTAL_STEPS)
+    final_step, resumed_params, resumed_from = train(
+        ckpt_dir, stop_after=TOTAL_STEPS
+    )
     assert final_step == TOTAL_STEPS, final_step
+    # The resume genuinely happened (a silently-fresh run would make the
+    # equality check below pass vacuously).
+    assert resumed_from == 4, resumed_from
 
     # The resumed run retraced steps 4..12 from the checkpoint; a
     # straight-through run must land on identical parameters (exact
@@ -140,7 +145,7 @@ def main() -> None:
     straight_dir = os.path.join(
         tempfile.mkdtemp(prefix="tpusnap_train_straight_"), "ckpts"
     )
-    _, straight_params = train(straight_dir, stop_after=TOTAL_STEPS)
+    _, straight_params, _ = train(straight_dir, stop_after=TOTAL_STEPS)
     for k in resumed_params:
         np.testing.assert_allclose(
             np.asarray(resumed_params[k]),

@@ -436,3 +436,69 @@ def test_pending_io_drain_fails_fast():
         pending.sync_complete()
     elapsed = time.monotonic() - begin
     assert elapsed < 0.9, f"failure surfaced after {elapsed:.2f}s (not fail-fast)"
+
+
+def test_progress_table_fires_while_budget_blocked_on_hung_storage():
+    """The flagship stuck-rank case: storage hangs, the budget is exhausted,
+    NO task completes — the table must still fire on its interval (the
+    scheduler waits carry the interval as a timeout)."""
+    import logging
+    import threading
+    import time
+
+    from torchsnapshot_tpu import knobs
+
+    release = threading.Event()
+
+    class _HangingStorage(MemoryStoragePlugin):
+        async def write(self, write_io):
+            while not release.is_set():
+                await asyncio.sleep(0.01)
+            await super().write(write_io)
+
+    class _BigStager(BufferStager):
+        async def stage_buffer(self, executor=None):
+            return b"x" * 4096
+
+        def get_staging_cost_bytes(self) -> int:
+            return 4096
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = _Capture()
+    sched_logger = logging.getLogger("torchsnapshot_tpu.scheduler")
+    prior_level = sched_logger.level
+    sched_logger.addHandler(handler)
+    sched_logger.setLevel(logging.INFO)
+    MemoryStoragePlugin.reset()
+    try:
+        # budget fits ONE request; the second stays budget-blocked while the
+        # first's write hangs -> the main loop has nothing completing.
+        def _run():
+            pending = sync_execute_write_reqs(
+                [
+                    WriteReq(path="a", buffer_stager=_BigStager()),
+                    WriteReq(path="b", buffer_stager=_BigStager()),
+                ],
+                _HangingStorage(root="hung"),
+                memory_budget_bytes=5000,
+                rank=7,
+            )
+            pending.sync_complete()
+
+        with knobs.override_progress_interval_s(0.05):
+            t = threading.Thread(target=_run)
+            t.start()
+            time.sleep(0.6)  # several intervals with storage hung
+            blocked_lines = [m for m in records if "write pipeline:" in m]
+            release.set()
+            t.join(timeout=30)
+        assert blocked_lines, "no table line while budget-blocked on hung storage"
+        assert "[rank 7]" in blocked_lines[0]
+    finally:
+        sched_logger.removeHandler(handler)
+        sched_logger.setLevel(prior_level)
