@@ -73,7 +73,8 @@ def make_batch(step):
 def train(ckpt_dir: str, stop_after: int) -> tuple:
     """Train until ``stop_after`` steps have run IN THIS PROCESS INVOCATION,
     checkpointing every SAVE_EVERY steps; resumes from the latest committed
-    snapshot if one exists.  Returns (last_step, params)."""
+    snapshot if one exists.  Returns (last_step, params, resumed_from_step
+    or None)."""
     mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
     replicated = NamedSharding(mesh, P())
 
@@ -147,10 +148,11 @@ def main() -> None:
     )
     _, straight_params, _ = train(straight_dir, stop_after=TOTAL_STEPS)
     for k in resumed_params:
-        np.testing.assert_allclose(
+        # Bit-exact: restore is deterministic (params, optimizer state,
+        # step counter, RNG all round-trip exactly).
+        np.testing.assert_array_equal(
             np.asarray(resumed_params[k]),
             np.asarray(straight_params[k]),
-            rtol=1e-6,
             err_msg=k,
         )
     print("resumed run matches straight-through run exactly — OK")

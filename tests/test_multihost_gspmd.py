@@ -89,6 +89,28 @@ def _worker(rank: int, world: int, coord_port: int, store_path: str, conn) -> No
         local_out = np.asarray(out.addressable_shards[0].data)
         np.testing.assert_array_equal(local_out, local)
         np.testing.assert_array_equal(dst["m"]["private"], np.full(3, float(rank)))
+
+        # Async take over the same real jax.distributed job: the background
+        # completion thread + store-based LinearBarrier commit (no
+        # collectives off the main thread) must work multi-process too.
+        pending = Snapshot.async_take(SNAP_PATH + "_async", app_state, pg=pg)
+        pending.wait()
+        assert pending.done()
+        dst2_arr = jax.make_array_from_single_device_arrays(
+            (16, 4),
+            sharding,
+            [
+                jax.device_put(
+                    np.zeros((local_rows, 4), np.float32),
+                    jax.local_devices()[0],
+                )
+            ],
+        )
+        dst2 = {"m": StateDict({"w": dst2_arr, "private": np.zeros(3)})}
+        Snapshot(SNAP_PATH + "_async", pg=pg).restore(dst2)
+        np.testing.assert_array_equal(
+            np.asarray(dst2["m"]["w"].addressable_shards[0].data), local
+        )
         conn.send(None)
     except BaseException:  # noqa: BLE001
         conn.send(traceback.format_exc())
