@@ -83,3 +83,76 @@ def test_cli_verify_handles_object_entries(tmp_path, capsys):
     assert main(["verify", path]) == 0
     out = capsys.readouterr().out
     assert "0 corrupt" in out
+
+
+def test_cli_diff(tmp_path, capsys):
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.__main__ import main
+
+    base = {
+        "same": np.arange(64, dtype=np.float32),
+        "changed": np.zeros(32, np.float32),
+        "gone": np.ones(8, np.float32),
+        "step": 1,
+    }
+    Snapshot.take(str(tmp_path / "a"), {"m": StateDict(dict(base))})
+    after = {
+        "same": base["same"].copy(),
+        "changed": base["changed"] + 1,
+        "new": np.ones(4, np.float32),
+        "step": 2,
+    }
+    Snapshot.take(str(tmp_path / "b"), {"m": StateDict(after)})
+
+    rc = main(["diff", str(tmp_path / "a"), str(tmp_path / "b")])
+    out = capsys.readouterr().out
+    assert rc == 1  # differences found
+    assert "added  0/m/new" in out
+    assert "removed  0/m/gone" in out
+    assert "changed  0/m/changed" in out
+    assert "changed  0/m/step" in out
+    assert "0/m/same" not in out  # identical: not listed
+    assert "1 identical" in out  # only "same" is unchanged
+
+    # identical snapshots diff clean with rc 0
+    rc = main(["diff", str(tmp_path / "a"), str(tmp_path / "a")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 added, 0 removed, 0 changed" in out
+
+
+def test_cli_diff_without_digests_reports_unverified(tmp_path, capsys, monkeypatch):
+    """Structural match without digests must surface as UNVERIFIED, never as
+    a false 'identical' clean bill of health; and digest-asymmetric pairs
+    (one side saved with recording off) must not flood 'changed'."""
+    import numpy as np
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu.__main__ import main
+
+    monkeypatch.setenv("TPUSNAP_CHECKSUM_ON_SAVE", "0")
+    # same shape/dtype, DIFFERENT content, no digests on either side
+    Snapshot.take(
+        str(tmp_path / "a"), {"m": StateDict({"w": np.zeros(16, np.float32)})}
+    )
+    Snapshot.take(
+        str(tmp_path / "b"), {"m": StateDict({"w": np.ones(16, np.float32)})}
+    )
+    rc = main(["diff", str(tmp_path / "a"), str(tmp_path / "b")])
+    out = capsys.readouterr().out
+    assert rc == 0  # no PROVEN difference...
+    assert "unverified  0/m/w" in out
+    assert "UNVERIFIED" in out  # ...but loudly not-identical
+    assert "1 UNVERIFIED" in out
+
+    # asymmetric: snapshot c HAS digests; same content as b structurally.
+    monkeypatch.delenv("TPUSNAP_CHECKSUM_ON_SAVE")
+    Snapshot.take(
+        str(tmp_path / "c"), {"m": StateDict({"w": np.ones(16, np.float32)})}
+    )
+    rc = main(["diff", str(tmp_path / "b"), str(tmp_path / "c")])
+    out = capsys.readouterr().out
+    assert "changed" not in out.replace("0 changed", "")  # not flooded
+    assert "unverified  0/m/w" in out

@@ -24,17 +24,28 @@ def _human(n: float) -> str:
     return f"{n:.1f}PB"
 
 
+def _shards(entry):
+    """Shard/chunk records of a sharded-or-chunked entry, else None."""
+    from .manifest import ChunkedTensorEntry, ShardedArrayEntry
+
+    if isinstance(entry, ShardedArrayEntry):
+        return entry.shards
+    if isinstance(entry, ChunkedTensorEntry):
+        return entry.chunks
+    return None
+
+
 def _entry_size(entry) -> int:
     from . import serialization
-    from .manifest import ChunkedTensorEntry, ShardedArrayEntry, TensorEntry
+    from .manifest import TensorEntry
 
     if isinstance(entry, TensorEntry):
         try:
             return serialization.array_nbytes(entry.shape, entry.dtype)
         except ValueError:
             return 0
-    if isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
-        shards = entry.shards if isinstance(entry, ShardedArrayEntry) else entry.chunks
+    shards = _shards(entry)
+    if shards is not None:
         return sum(_entry_size(s.tensor) for s in shards)
     return 0
 
@@ -215,6 +226,94 @@ def cmd_verify(args: argparse.Namespace) -> int:
     return 1 if corrupt or unreadable else 0
 
 
+def cmd_diff(args: argparse.Namespace) -> int:
+    """What changed between two snapshots, by logical path: added/removed
+    paths, payloads whose content provably differs, and common paths whose
+    equality CANNOT be proven (digests missing on either side — a
+    structural match there is not a content guarantee).  Works straight off
+    the manifests — no payload reads."""
+    from .manifest import ObjectEntry, PrimitiveEntry, TensorEntry
+    from .snapshot import Snapshot
+
+    def _compare(ea, eb):
+        """(changed, proven): ``proven`` means equality/difference is
+        digest- or value-backed, not merely structural."""
+        if type(ea) is not type(eb):
+            return True, True
+        if isinstance(ea, PrimitiveEntry):
+            return (
+                (ea.entry_type, ea.serialized or ea.readable)
+                != (eb.entry_type, eb.serialized or eb.readable),
+                True,
+            )
+        if isinstance(ea, TensorEntry):
+            if (ea.dtype, tuple(ea.shape)) != (eb.dtype, tuple(eb.shape)):
+                return True, True
+            if ea.checksum is not None and eb.checksum is not None:
+                return ea.checksum != eb.checksum, True
+            return False, False  # same structure, content unprovable
+        shards_a, shards_b = _shards(ea), _shards(eb)
+        if shards_a is not None:
+            layout_a = [(tuple(s.offsets), tuple(s.sizes)) for s in shards_a]
+            layout_b = [(tuple(s.offsets), tuple(s.sizes)) for s in shards_b]
+            if layout_a != layout_b:
+                return True, True
+            digests_a = [s.tensor.checksum for s in shards_a]
+            digests_b = [s.tensor.checksum for s in shards_b]
+            if None not in digests_a and None not in digests_b:
+                return digests_a != digests_b, True
+            return False, False
+        if isinstance(ea, ObjectEntry):
+            if ea.checksum is not None and eb.checksum is not None:
+                return ea.checksum != eb.checksum, True
+            return False, False
+        return False, False  # unknown entry type: unprovable
+
+    def _leaves(path):
+        md = Snapshot(path).metadata
+        from .manifest_utils import is_container_entry
+
+        return {
+            p: e
+            for p, e in md.manifest.items()
+            if not is_container_entry(e)
+        }
+
+    a, b = _leaves(args.path_a), _leaves(args.path_b)
+    added = sorted(set(b) - set(a))
+    removed = sorted(set(a) - set(b))
+    changed, identical, unverified = [], 0, []
+    for p in sorted(set(a) & set(b)):
+        delta, proven = _compare(a[p], b[p])
+        if delta:
+            changed.append(p)
+        elif proven:
+            identical += 1
+        else:
+            unverified.append(p)
+    for label, paths in (
+        ("added", added),
+        ("removed", removed),
+        ("changed", changed),
+        ("unverified", unverified),
+    ):
+        for p in paths[: args.limit]:
+            print(f"{label:>10}  {p}")
+        if len(paths) > args.limit:
+            print(f"{label:>10}  ... and {len(paths) - args.limit} more")
+    summary = (
+        f"{len(added)} added, {len(removed)} removed, {len(changed)} "
+        f"changed, {identical} identical"
+    )
+    if unverified:
+        summary += (
+            f", {len(unverified)} UNVERIFIED (digests missing — structural "
+            "match only, content equality unproven)"
+        )
+    print(summary)
+    return 1 if added or removed or changed else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -242,6 +341,14 @@ def main(argv=None) -> int:
     )
     p.add_argument("path")
     p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser(
+        "diff", help="compare two snapshots' content by logical path"
+    )
+    p.add_argument("path_a")
+    p.add_argument("path_b")
+    p.add_argument("--limit", type=int, default=20, help="paths shown per bucket")
+    p.set_defaults(fn=cmd_diff)
 
     args = parser.parse_args(argv)
     return args.fn(args)
