@@ -5,6 +5,7 @@
     python -m torchsnapshot_tpu info <snapshot-url>
     python -m torchsnapshot_tpu steps <manager-root-url>
     python -m torchsnapshot_tpu verify <snapshot-url>
+    python -m torchsnapshot_tpu diff <snapshot-url-a> <snapshot-url-b>
 
 Read-only; works against any storage backend URL.  (Beyond reference parity:
 the reference ships no CLI.)
@@ -182,18 +183,11 @@ def cmd_verify(args: argparse.Namespace) -> int:
         payloads[(entry.location, tuple(br) if br else None)] = entry.checksum
 
     for entry in md.manifest.values():
-        if isinstance(entry, TensorEntry):
+        if isinstance(entry, (TensorEntry, ObjectEntry)):
             _add(entry)
-        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
-            shards = (
-                entry.shards
-                if isinstance(entry, ShardedArrayEntry)
-                else entry.chunks
-            )
-            for shard in shards:
+        else:
+            for shard in _shards(entry) or ():
                 _add(shard.tensor)
-        elif isinstance(entry, ObjectEntry):
-            _add(entry)
 
     storage = url_to_storage_plugin(args.path)
     ok = corrupt = unreadable = 0
@@ -254,16 +248,30 @@ def cmd_diff(args: argparse.Namespace) -> int:
             return False, False  # same structure, content unprovable
         shards_a, shards_b = _shards(ea), _shards(eb)
         if shards_a is not None:
-            layout_a = [(tuple(s.offsets), tuple(s.sizes)) for s in shards_a]
-            layout_b = [(tuple(s.offsets), tuple(s.sizes)) for s in shards_b]
-            if layout_a != layout_b:
+            # Entry-level structure first: global dtype/shape differences
+            # are provable even without digests.
+            if (ea.dtype, tuple(ea.shape)) != (eb.dtype, tuple(eb.shape)):
                 return True, True
-            digests_a = [s.tensor.checksum for s in shards_a]
-            digests_b = [s.tensor.checksum for s in shards_b]
+            # Shard records sorted by offsets: device enumeration order can
+            # legitimately differ between the two saves' meshes.
+            recs_a = sorted(
+                (tuple(s.offsets), tuple(s.sizes), s.tensor.checksum)
+                for s in shards_a
+            )
+            recs_b = sorted(
+                (tuple(s.offsets), tuple(s.sizes), s.tensor.checksum)
+                for s in shards_b
+            )
+            if [r[:2] for r in recs_a] != [r[:2] for r in recs_b]:
+                return True, True  # different shard layouts
+            digests_a = [r[2] for r in recs_a]
+            digests_b = [r[2] for r in recs_b]
             if None not in digests_a and None not in digests_b:
                 return digests_a != digests_b, True
             return False, False
         if isinstance(ea, ObjectEntry):
+            if (ea.obj_type, ea.serializer) != (eb.obj_type, eb.serializer):
+                return True, True  # provably different object kinds
             if ea.checksum is not None and eb.checksum is not None:
                 return ea.checksum != eb.checksum, True
             return False, False

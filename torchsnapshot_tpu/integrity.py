@@ -57,14 +57,21 @@ def compute(buf) -> Optional[str]:
     return digest(buf)
 
 
+# Below this, the executor round-trip costs more than the hash itself
+# (a 1 MB xxh64 at ~5 GB/s is ~200 us; a submit+wakeup hop is comparable —
+# and a 3000-tiny-leaf save would pay the hop 3000 times).
+_INLINE_DIGEST_MAX_BYTES = 1 << 20
+
+
 async def compute_on(buf, executor) -> Optional[str]:
     """``compute`` on the executor: the native xxh64 releases the GIL, so
     concurrent stagers' hashes overlap with each other and with storage I/O
     instead of serializing on the event-loop thread (~100 ms per 512 MB
-    chunk at hash rate — the checksum must stay off the critical path)."""
+    chunk at hash rate — the checksum must stay off the critical path).
+    Small buffers hash inline; see ``_INLINE_DIGEST_MAX_BYTES``."""
     if not save_checksums_enabled():
         return None
-    if executor is None:
+    if executor is None or memoryview(buf).nbytes < _INLINE_DIGEST_MAX_BYTES:
         return digest(buf)
     import asyncio
 
