@@ -232,10 +232,47 @@ def main() -> None:
             jax.random.key(99)
         )
     )
+    # Warm the bitcast kernel's per-shape jit compile at the probe's OWN
+    # shape without transferring (the kernel's device-side run is a real
+    # staging cost and stays timed; its one-time compile is not).
+    try:
+        if _staging._use_bitcast_staging(probe):
+            jax.block_until_ready(_staging._bitcast_to_u8(probe))
+    except Exception:
+        pass
     t0 = time.monotonic()
     _staging.to_host(probe)
     link_gbps = probe.size * 2 / 1e9 / (time.monotonic() - t0)
     log(f"raw D2H link: {link_gbps:.3f} GB/s")
+
+    # Aggregate ceiling: the same bytes as 8 concurrent transfers, enqueued
+    # together so the DMAs overlap — what the scheduler's admission actually
+    # drives.  On transports where one stream is latency-bound (a tunneled
+    # TPU measured 0.011 GB/s single vs 0.025 GB/s with 8 in flight) the
+    # single-stream probe understates the hardware ceiling and efficiency
+    # would read >1.  The ceiling used for efficiency is max(single, agg).
+    _PARTIAL["phase"] = "link_probe_agg"
+    _mk_part = jax.jit(lambda k: jax.random.normal(k, (1024, 4096), jnp.bfloat16))
+    agg_parts = [
+        jax.block_until_ready(_mk_part(k))
+        for k in jax.random.split(jax.random.key(98), 8)
+    ]
+    # Untimed warm transfer at the parts' own shape: begin_d2h jit-compiles
+    # its bitcast kernel per shape, and that one-time compile must not be
+    # charged to the link (same reason as the single-probe warm-up above).
+    _staging.to_host(jax.block_until_ready(_mk_part(jax.random.key(97))))
+    t0 = time.monotonic()
+    handles = [_staging.begin_d2h(a) for a in agg_parts]
+    for h, a in zip(handles, agg_parts):
+        _staging.finish_d2h(h, a.dtype, a.shape)
+    agg_bytes = sum(a.size * 2 for a in agg_parts)
+    link_agg_gbps = agg_bytes / 1e9 / (time.monotonic() - t0)
+    del agg_parts, handles
+    link_ceiling_gbps = max(link_gbps, link_agg_gbps)
+    log(
+        f"raw D2H aggregate (8 streams): {link_agg_gbps:.3f} GB/s "
+        f"(ceiling {link_ceiling_gbps:.3f})"
+    )
 
     # Raw storage write rate (the OTHER hardware ceiling): one 256 MiB
     # native write + fsync to the bench dir, so pipeline efficiency can be
@@ -287,7 +324,7 @@ def main() -> None:
         # The watchdog was armed before device probing; flaky-transport
         # retries may already have burned part of the budget.
         remaining_s = _watchdog_remaining_s()
-        link_budget = int(link_gbps * 1e9 * max(remaining_s, 30) * 0.6 / 8)
+        link_budget = int(link_ceiling_gbps * 1e9 * max(remaining_s, 30) * 0.6 / 8)
         default_bytes = max(64 << 20, min(2048 << 20, link_budget))
     target_bytes = int(os.environ.get("BENCH_TARGET_BYTES", default_bytes))
     n_arrays = 8
@@ -465,9 +502,10 @@ def main() -> None:
             "restore_s": round(restore_s, 2),
             "restore_gbps": round(actual_bytes / 1e9 / restore_s, 3),
             "raw_d2h_link_gbps": round(link_gbps, 3),
+            "raw_d2h_aggregate_gbps": round(link_agg_gbps, 3),
             "raw_disk_write_gbps": round(disk_gbps, 3) if disk_gbps else None,
-            "pipeline_efficiency_vs_link": round(save_gbps / link_gbps, 3)
-            if link_gbps > 0
+            "pipeline_efficiency_vs_link": round(save_gbps / link_ceiling_gbps, 3)
+            if link_ceiling_gbps > 0
             else None,
             # The BASELINE north star: >= 90% of storage write bandwidth.
             "pipeline_efficiency_vs_disk": round(save_gbps / disk_gbps, 3)
