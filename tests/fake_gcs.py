@@ -19,6 +19,7 @@ executed).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import re
 import threading
@@ -26,6 +27,12 @@ import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
+
+
+def _generation(data: bytes) -> str:
+    """Content-addressed generation token, so metadata/``generation=``
+    version pinning works without tracking write counts."""
+    return hashlib.md5(data).hexdigest()
 
 
 class FakeGCSServer:
@@ -36,6 +43,7 @@ class FakeGCSServer:
         self.fail_at_chunks = set()  # fail specific 1-based chunk PUT indices
         self.chunk_puts = 0
         self.copies = 0  # completed server-side copies (copyTo/rewriteTo)
+        self.downloads = 0  # alt=media download requests served
         self.rewrite_rounds = 1  # >1: rewriteTo needs N token-carrying calls
         self._rewrite_progress: dict = {}
         self._lock = threading.Lock()
@@ -210,7 +218,9 @@ class FakeGCSServer:
                     r"/download/storage/v1/b/([^/]+)/o/(.+)", split.path
                 )
                 if m and query.get("alt") == ["media"]:
-                    return self._do_download(m)
+                    with outer._lock:
+                        outer.downloads += 1
+                    return self._do_download(m, query)
                 m = re.match(r"/storage/v1/b/([^/]+)/o$", split.path)
                 if m:
                     return self._do_list(m.group(1), query)
@@ -224,20 +234,31 @@ class FakeGCSServer:
                     if data is None:
                         return self._reply(404)
                     body = json.dumps(
-                        {"name": name, "size": str(len(data))}
+                        {
+                            "name": name,
+                            "size": str(len(data)),
+                            "generation": _generation(data),
+                        }
                     ).encode()
                     return self._reply(
                         200, body, {"Content-Type": "application/json"}
                     )
                 self._reply(404)
 
-            def _do_download(self, m):
+            def _do_download(self, m, query):
                 bucket = m.group(1)
                 name = urllib.parse.unquote(m.group(2))
                 with outer._lock:
                     data = outer.objects.get(f"{bucket}/{name}")
                 if data is None:
                     return self._reply(404)
+                current_gen = _generation(data)
+                gen = query.get("generation")
+                if gen is not None and gen[0] != current_gen:
+                    # A pinned generation that no longer exists: 404, the
+                    # real GCS behavior for a superseded generation.
+                    return self._reply(404)
+                gen_header = {"x-goog-generation": current_gen}
                 total = len(data)
                 range_header = self.headers.get("Range")
                 if range_header:
@@ -249,9 +270,12 @@ class FakeGCSServer:
                     return self._reply(
                         206,
                         bytes(chunk),
-                        {"Content-Range": f"bytes {start}-{end}/{total}"},
+                        {
+                            "Content-Range": f"bytes {start}-{end}/{total}",
+                            **gen_header,
+                        },
                     )
-                return self._reply(200, bytes(data))
+                return self._reply(200, bytes(data), gen_header)
 
             def _do_list(self, bucket, query):
                 prefix = query.get("prefix", [""])[0]

@@ -10,11 +10,18 @@ makes the semantics testable on every run.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict
 from xml.sax.saxutils import escape
+
+
+def _etag(data: bytes) -> str:
+    """Content-addressed ETag (real S3 uses md5 for simple PUTs too), so
+    HEAD/If-Match version pinning works without tracking write counts."""
+    return '"' + hashlib.md5(data).hexdigest() + '"'
 
 
 class FakeS3Server:
@@ -23,6 +30,7 @@ class FakeS3Server:
         self.fail_next = 0
         self.request_count = 0
         self.copies = 0  # server-side copies (x-amz-copy-source PUTs)
+        self.gets = 0  # object GETs served (list requests excluded)
         self.put_bytes = 0  # bytes actually uploaded by clients
         self.multipart_completed = 0  # completed multipart uploads
         self.fail_parts = 0  # 503 the next N part PUTs (deterministic hook)
@@ -109,6 +117,8 @@ class FakeS3Server:
                 query = urllib.parse.parse_qs(split.query)
                 if "list-type" in query:
                     return self._do_list(split, query)
+                with outer._lock:
+                    outer.gets += 1
                 key = self._obj_key()
                 with outer._lock:
                     data = outer.objects.get(key)
@@ -118,6 +128,12 @@ class FakeS3Server:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if_match = self.headers.get("If-Match")
+                if if_match is not None and if_match != _etag(data):
+                    self.send_response(412)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
                     return
                 range_header = self.headers.get("Range")
                 status = 200
@@ -324,6 +340,7 @@ class FakeS3Server:
                 # the CopyObject-vs-UploadPartCopy decision on it) but a HEAD
                 # response carries no body.
                 self.send_header("Content-Length", str(len(data)))
+                self.send_header("ETag", _etag(data))
                 self.end_headers()
 
             def do_DELETE(self):

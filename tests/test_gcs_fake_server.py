@@ -107,3 +107,155 @@ def test_delete_dir(gcs_env):
     assert "bkt/d/a.bin" not in gcs_env.objects
     assert "bkt/d/b.bin" not in gcs_env.objects
     assert gcs_env.objects["bkt/keep/c.bin"] == b"ccc"
+
+
+def test_parallel_ranged_fanout(gcs_env, monkeypatch):
+    """Large reads of known size fan out across concurrent ranged
+    downloads (storage_plugins/_ranged.py) and land bit-exact."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.storage_plugins import gcs as gcs_mod
+
+    # One request per stream (chunk >= payload), so the download counter
+    # distinguishes a 4-way fan-out (4 requests) from one stream (1).
+    monkeypatch.setattr(gcs_mod, "_CHUNK_SIZE_BYTES", 8 << 20)
+    plugin = _plugin()
+    payload = os.urandom(6 << 20)
+
+    async def go():
+        await plugin.write(WriteIO(path="big.bin", buf=payload))
+        before = gcs_env.downloads
+        with knobs.override_cloud_parallel_min_bytes(1 << 20), \
+                knobs.override_parallel_read_ways(4):
+            dst = bytearray(len(payload))
+            read_io = ReadIO(path="big.bin", into=memoryview(dst))
+            await plugin.read(read_io)
+            # read-into-place: bytes landed in the caller's memory
+            assert read_io.buf is read_io.into
+            assert dst == payload
+            assert gcs_env.downloads - before == 4
+
+            ranged = ReadIO(path="big.bin", byte_range=[1 << 20, 5 << 20])
+            await plugin.read(ranged)
+            assert bytes(ranged.buf) == payload[1 << 20 : 5 << 20]
+
+            slice_dst = bytearray(2 << 20)
+            both = ReadIO(
+                path="big.bin",
+                byte_range=[1 << 20, 3 << 20],
+                into=memoryview(slice_dst),
+            )
+            await plugin.read(both)
+            assert both.buf is both.into
+            assert slice_dst == payload[1 << 20 : 3 << 20]
+        await plugin.close()
+
+    asyncio.run(go())
+
+
+def test_into_read_single_stream_and_mismatch(gcs_env):
+    """Below the threshold an into-read lands in place through one stream;
+    an into-view that disagrees with the object size raises rather than
+    leaving stale bytes in the restore target."""
+    plugin = _plugin()
+    payload = os.urandom(1 << 16)
+
+    async def go():
+        await plugin.write(WriteIO(path="small.bin", buf=payload))
+        dst = bytearray(len(payload))
+        read_io = ReadIO(path="small.bin", into=memoryview(dst))
+        await plugin.read(read_io)
+        assert read_io.buf is read_io.into
+        assert dst == payload
+
+        bad = ReadIO(path="small.bin", into=memoryview(bytearray(512)))
+        with pytest.raises(RuntimeError):
+            await plugin.read(bad)
+        await plugin.close()
+
+    asyncio.run(go())
+
+
+def test_fanout_into_wrong_size_raises(gcs_env):
+    """Above the fan-out threshold an un-ranged into-read probes the
+    object size and raises on mismatch instead of silently truncating."""
+    from torchsnapshot_tpu import knobs
+
+    plugin = _plugin()
+    payload = os.urandom(2 << 20)
+
+    async def go():
+        await plugin.write(WriteIO(path="t.bin", buf=payload))
+        with knobs.override_cloud_parallel_min_bytes(1 << 20), \
+                knobs.override_parallel_read_ways(2):
+            bad = ReadIO(
+                path="t.bin", into=memoryview(bytearray((2 << 20) - 4096))
+            )
+            with pytest.raises(RuntimeError, match="into-view expects"):
+                await plugin.read(bad)
+        await plugin.close()
+
+    asyncio.run(go())
+
+
+def test_fanout_version_pin_rejects_overwrite(gcs_env):
+    """Fan-out chunks pin the probed generation: a read whose object was
+    overwritten since the probe fails (the pinned generation 404s, real
+    GCS semantics for a superseded generation) instead of interleaving two
+    versions' bytes into one buffer."""
+    plugin = _plugin()
+
+    async def go():
+        await plugin.write(WriteIO(path="v.bin", buf=os.urandom(1 << 20)))
+        _, stale_gen = plugin._object_stat("v.bin")
+        await plugin.write(WriteIO(path="v.bin", buf=os.urandom(1 << 20)))
+        with pytest.raises(RuntimeError, match="changed mid-read"):
+            plugin._stream_download_into(
+                "v.bin",
+                0,
+                1 << 19,
+                memoryview(bytearray(1 << 19)),
+                version=stale_gen,
+            )
+        await plugin.close()
+
+    asyncio.run(go())
+
+
+def test_generation_guard_detects_mid_read_overwrite(gcs_env):
+    """Single-stream multi-request reads carry no pin (that would cost a
+    metadata round-trip per manifest read); instead every chunk response's
+    x-goog-generation must match the first — an overwrite landing between
+    chunk requests fails the read instead of interleaving two versions."""
+    plugin = _plugin()
+    payload = os.urandom(1 << 20)  # 4 chunks of 256 KiB
+
+    async def setup():
+        await plugin.write(WriteIO(path="g.bin", buf=payload))
+
+    asyncio.run(setup())
+
+    # Overwrite the object server-side after the client consumes chunk 1.
+    orig_session = plugin._session
+    state = {"chunks": 0}
+
+    class _HookedSession:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def request(self, *a, **k):
+            resp = self._inner.request(*a, **k)
+            if resp.status_code in (200, 206):
+                state["chunks"] += 1
+                if state["chunks"] == 1:
+                    gcs_env.objects["bkt/pre/g.bin"] = os.urandom(1 << 20)
+            return resp
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    plugin._session = lambda: _HookedSession(orig_session())
+    with pytest.raises(RuntimeError, match="changed mid-read"):
+        plugin._download_range("g.bin", None)
+
+    plugin._session = orig_session
+    asyncio.run(plugin.close())

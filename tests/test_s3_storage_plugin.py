@@ -233,3 +233,102 @@ def test_multipart_boundary_sizes(s3_env, monkeypatch):
     assert s3_env.multipart_completed == 6
     assert not s3_env.uploads
     plugin.sync_close()
+
+
+def test_parallel_ranged_fanout(s3_env):
+    """Large reads of known size fan out across concurrent ranged GETs
+    (storage_plugins/_ranged.py) and land bit-exact: full-object
+    into-reads, ranged slices, and the into+range combination."""
+    from torchsnapshot_tpu import knobs
+
+    plugin = _plugin()
+    payload = os.urandom(6 << 20)
+    plugin.sync_write(WriteIO(path="big.bin", buf=payload))
+    gets_before = s3_env.gets
+    with knobs.override_cloud_parallel_min_bytes(1 << 20), \
+            knobs.override_parallel_read_ways(4):
+        dst = bytearray(len(payload))
+        read_io = ReadIO(path="big.bin", into=memoryview(dst))
+        plugin.sync_read(read_io)
+        # read-into-place: bytes landed in the caller's memory, no copy
+        assert read_io.buf is read_io.into
+        assert dst == payload
+
+        ranged = ReadIO(path="big.bin", byte_range=[1 << 20, 5 << 20])
+        plugin.sync_read(ranged)
+        assert bytes(ranged.buf) == payload[1 << 20 : 5 << 20]
+
+        slice_dst = bytearray(2 << 20)
+        both = ReadIO(
+            path="big.bin",
+            byte_range=[1 << 20, 3 << 20],
+            into=memoryview(slice_dst),
+        )
+        plugin.sync_read(both)
+        assert both.buf is both.into
+        assert slice_dst == payload[1 << 20 : 3 << 20]
+    # 3 reads x 4 ways each — a regressed threshold/knob parse would issue
+    # 3 single GETs and pass the value checks vacuously.  The un-ranged
+    # into-read adds no GETs for its HEAD size probe (HEADs aren't counted).
+    assert s3_env.gets - gets_before == 12
+    plugin.sync_close()
+
+
+def test_fanout_into_wrong_size_raises(s3_env):
+    """An un-ranged into-read above the fan-out threshold must probe the
+    object size and raise on mismatch — every planned range is in-bounds,
+    so without the probe a too-small view would silently truncate."""
+    from torchsnapshot_tpu import knobs
+
+    plugin = _plugin()
+    payload = os.urandom(2 << 20)
+    plugin.sync_write(WriteIO(path="t.bin", buf=payload))
+    with knobs.override_cloud_parallel_min_bytes(1 << 20), \
+            knobs.override_parallel_read_ways(2):
+        bad = ReadIO(path="t.bin", into=memoryview(bytearray((2 << 20) - 4096)))
+        with pytest.raises(RuntimeError, match="into-view expects"):
+            plugin.sync_read(bad)
+    plugin.sync_close()
+
+
+def test_into_read_single_stream(s3_env):
+    """Below the fan-out threshold an into-read still lands in place."""
+    plugin = _plugin()
+    payload = os.urandom(1 << 16)
+    plugin.sync_write(WriteIO(path="small.bin", buf=payload))
+    dst = bytearray(len(payload))
+    read_io = ReadIO(path="small.bin", into=memoryview(dst))
+    plugin.sync_read(read_io)
+    assert read_io.buf is read_io.into
+    assert dst == payload
+    plugin.sync_close()
+
+
+def test_into_size_mismatch_raises(s3_env):
+    """An into-view that disagrees with the object size must raise, not
+    silently truncate or leave stale bytes in the restore target."""
+    plugin = _plugin()
+    plugin.sync_write(WriteIO(path="obj.bin", buf=os.urandom(1024)))
+    bad = ReadIO(path="obj.bin", into=memoryview(bytearray(512)))
+    with pytest.raises(RuntimeError):
+        plugin.sync_read(bad)
+    plugin.sync_close()
+
+
+def test_fanout_version_pin_rejects_overwrite(s3_env):
+    """Fan-out chunks carry If-Match with the probed ETag: a read whose
+    object was overwritten since the probe fails outright (412) instead of
+    interleaving two versions' bytes into one buffer."""
+    plugin = _plugin()
+    plugin.sync_write(WriteIO(path="v.bin", buf=os.urandom(2 << 20)))
+    _, stale_etag = plugin._object_stat("v.bin")
+    plugin.sync_write(WriteIO(path="v.bin", buf=os.urandom(2 << 20)))
+    with pytest.raises(RuntimeError, match="changed mid-read"):
+        plugin._stream_get_into(
+            "v.bin",
+            0,
+            1 << 20,
+            memoryview(bytearray(1 << 20)),
+            version=stale_etag,
+        )
+    plugin.sync_close()

@@ -27,12 +27,14 @@ ENABLE_SHARDED_ELASTICITY_ROOT_ONLY_ENV_VAR = (
 MAX_READ_MERGE_GAP_ENV_VAR = _ENV_PREFIX + "MAX_READ_MERGE_GAP_BYTES"
 PARALLEL_READ_WAYS_ENV_VAR = _ENV_PREFIX + "PARALLEL_READ_WAYS"
 PROGRESS_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "PROGRESS_INTERVAL_S"
+CLOUD_PARALLEL_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "CLOUD_PARALLEL_MIN_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
 _DEFAULT_MAX_PER_RANK_IO_CONCURRENCY = 16
 _DEFAULT_MAX_READ_MERGE_GAP_BYTES = 8 * 1024 * 1024
+_DEFAULT_CLOUD_PARALLEL_MIN_BYTES = 64 * 1024 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -97,6 +99,14 @@ def get_max_read_merge_gap_bytes() -> int:
     for a few entries' bytes."""
     return _get_int_env(
         MAX_READ_MERGE_GAP_ENV_VAR, _DEFAULT_MAX_READ_MERGE_GAP_BYTES
+    )
+
+
+def get_cloud_parallel_min_bytes() -> int:
+    """Smallest S3/GCS read that fans out across concurrent ranged
+    requests (storage_plugins/_ranged.py)."""
+    return _get_int_env(
+        CLOUD_PARALLEL_MIN_BYTES_ENV_VAR, _DEFAULT_CLOUD_PARALLEL_MIN_BYTES
     )
 
 
@@ -186,4 +196,10 @@ def override_parallel_read_ways(value: int) -> Generator[None, None, None]:
 @contextmanager
 def override_progress_interval_s(value: float) -> Generator[None, None, None]:
     with _override_env(PROGRESS_INTERVAL_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_cloud_parallel_min_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(CLOUD_PARALLEL_MIN_BYTES_ENV_VAR, str(value)):
         yield

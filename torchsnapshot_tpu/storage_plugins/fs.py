@@ -18,10 +18,11 @@ from typing import Optional, Set
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
 
+from ._ranged import PARALLEL_READ_CHUNK_BYTES as _PARALLEL_READ_CHUNK
+from ._ranged import PARALLEL_READ_MAX_WAYS as _PARALLEL_READ_MAX_WAYS
+
 _DEFAULT_IO_THREADS = 16
 _PARALLEL_READ_MIN_BYTES = 64 * 1024 * 1024
-_PARALLEL_READ_CHUNK = 32 * 1024 * 1024
-_PARALLEL_READ_MAX_WAYS = 8
 _ADAPTIVE_REPROBE_EVERY = 16
 
 

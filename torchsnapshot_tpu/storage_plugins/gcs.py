@@ -51,9 +51,12 @@ class _SharedDeadlineRetryStrategy:
             self._expires_at = time.monotonic() + self._deadline_s
             self._attempts = 0
 
-    def check_and_backoff(self, exc: BaseException) -> None:
+    def check_and_backoff(self, exc: BaseException, cancel=None) -> None:
         """Raise if the shared deadline expired, else sleep with jittered
-        exponential backoff."""
+        exponential backoff.  A ``cancel`` event cuts the sleep short so a
+        sibling fan-out chunk's hard failure is not held back a full
+        backoff interval (the caller's loop re-checks the event and
+        raises)."""
         with self._lock:
             if time.monotonic() > self._expires_at:
                 raise TimeoutError(
@@ -64,7 +67,10 @@ class _SharedDeadlineRetryStrategy:
             attempts = self._attempts
         backoff = min(2 ** min(attempts, 6), 32.0) * (0.5 + random.random())
         logger.warning("GCS transient error (%r); retrying in %.1fs", exc, backoff)
-        time.sleep(backoff)
+        if cancel is not None:
+            cancel.wait(backoff)
+        else:
+            time.sleep(backoff)
 
 
 def _is_transient(exc: BaseException) -> bool:
@@ -87,6 +93,53 @@ def _is_transient(exc: BaseException) -> bool:
     )
 
 
+class _ViewWriter(io.RawIOBase):
+    """Writable file-like over a memoryview: ranged downloads land bytes
+    straight in the restore target's memory."""
+
+    def __init__(self, view: memoryview) -> None:
+        super().__init__()
+        self._view = view
+        self._pos = 0
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        n = len(b)
+        if self._pos + n > self._view.nbytes:
+            # RuntimeError, not ValueError: this is the extent check for a
+            # whole-object stream into a fixed-size destination (an object
+            # bigger than the view), the same error class every other
+            # extent mismatch in the plugins raises.
+            raise RuntimeError(
+                f"write of {n} bytes at {self._pos} past end of "
+                f"{self._view.nbytes}-byte destination view"
+            )
+        self._view[self._pos : self._pos + n] = b
+        self._pos += n
+        return n
+
+    def seek(self, pos: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            new_pos = pos
+        elif whence == io.SEEK_CUR:
+            new_pos = self._pos + pos
+        elif whence == io.SEEK_END:
+            new_pos = self._view.nbytes + pos
+        else:
+            raise ValueError(f"invalid whence: {whence}")
+        if new_pos < 0:
+            # A negative position would make the next write's slice index
+            # land at the wrong end of the restore buffer.
+            raise ValueError(f"negative seek position: {new_pos}")
+        self._pos = new_pos
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+
 class GCSStoragePlugin(StoragePlugin):
     def __init__(self, root: str) -> None:
         import os
@@ -98,6 +151,17 @@ class GCSStoragePlugin(StoragePlugin):
         self._executor: Optional[ThreadPoolExecutor] = None
         self._retry = _SharedDeadlineRetryStrategy()
         self._local = threading.local()
+        # Child pool for intra-object ranged-download fan-out: the parent
+        # read occupies a gcs_io thread and blocks on its chunks, so
+        # submitting chunks to the same pool deadlocks once every io thread
+        # holds a parent read (same parent/child split as fs.py).  Sized
+        # above the 16-thread io pool so a full fan-out never drops
+        # aggregate in-flight requests below the 16 single streams it
+        # replaces.  Built eagerly — reached from io-pool worker threads
+        # where lazy init would race.
+        self._chunk_executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="gcs_chunk"
+        )
         # Endpoint override (local fake GCS / emulator): anonymous sessions,
         # both the resumable-upload and download bases point at it.
         endpoint = os.environ.get("TPUSNAP_GCS_ENDPOINT")
@@ -147,6 +211,15 @@ class GCSStoragePlugin(StoragePlugin):
         name = f"{self.prefix}/{path}" if self.prefix else path
         return name
 
+    def _object_url(self, path: str, media: bool = False) -> str:
+        """Storage-API URL for one object: media download or metadata/ops."""
+        kind = "/download/storage/v1/b/" if media else "/storage/v1/b/"
+        url = (
+            f"{self._download_base}{kind}{self.bucket_name}/o/"
+            + self._blob_url(path).replace("/", "%2F")
+        )
+        return url + "?alt=media" if media else url
+
     def _blocking_write(self, path: str, buf) -> None:
         from google.resumable_media.requests import ResumableUpload
 
@@ -187,24 +260,151 @@ class GCSStoragePlugin(StoragePlugin):
                 self._retry.check_and_backoff(e)
                 stream.seek(0)
 
-    def _blocking_read(self, path: str, byte_range) -> bytearray:
+    def _object_stat(self, path: str):
+        """(size, generation) from one metadata GET — the generation pins
+        fan-out reads to a single object version (``generation=`` on every
+        ranged download)."""
+        resp = self._get_with_retry(self._object_url(path), {})
+        if resp.status_code != 200:
+            raise RuntimeError(
+                f"GCS metadata GET {path} failed: {resp.status_code}"
+            )
+        meta = resp.json()
+        return int(meta.get("size", -1)), meta.get("generation") or None
+
+    def _blocking_read(self, path: str, byte_range, into=None):
+        from ._ranged import orchestrated_read
+
+        return orchestrated_read(
+            byte_range=byte_range,
+            into=into,
+            chunk_executor=self._chunk_executor,
+            stream_into=lambda s, e, v, version=None, cancel=None: (
+                self._stream_download_into(
+                    path, s, e, v, version=version, cancel=cancel
+                )
+            ),
+            probe_stat=lambda: self._object_stat(path),
+            single_read=lambda: self._download_range(path, byte_range),
+            label=f"GCS object {path}",
+        )
+
+    def _stream_download_into(
+        self,
+        path: str,
+        start: Optional[int],
+        end: Optional[int],
+        view,
+        version: Optional[str] = None,
+        cancel=None,
+    ) -> None:
+        """One download streamed straight into the caller's view — no
+        BytesIO staging, no copy (the write-side counterpart of
+        MemoryviewStream; a buffered path would move every chunk through
+        three extra memcpys on the hot restore path).  ``start``/``end``
+        (exclusive) select a range; ``(None, None)`` streams the whole
+        object, which must be exactly ``view.nbytes`` long — the writer's
+        overflow check and the final length check enforce that."""
         from google.resumable_media.requests import ChunkedDownload
 
-        url = (
-            f"{self._download_base}/download/storage/v1/b/"
-            f"{self.bucket_name}/o/"
-            + self._blob_url(path).replace("/", "%2F")
-            + "?alt=media"
-        )
+        expected = view.nbytes
+        url = self._object_url(path, media=True)
+        if version is not None:
+            # Version pin for fan-out chunks: the download serves exactly
+            # this generation or 404s — a concurrent overwrite must fail
+            # the read, never interleave two versions' bytes.  Non-fan-out
+            # multi-request streams are covered by the generation guard.
+            url += f"&generation={version}"
+        writer = _ViewWriter(view)
+        kwargs = {} if start is None else {"start": start, "end": end - 1}
+        guard = self._GenerationGuard(path)
+        while True:
+            if cancel is not None and cancel.is_set():
+                # A sibling fan-out chunk failed hard: abandon the retry
+                # schedule instead of making the caller wait it out.
+                raise RuntimeError(
+                    f"GCS read of {path} abandoned: a sibling chunk failed"
+                )
+            try:
+                download = ChunkedDownload(
+                    url, _CHUNK_SIZE_BYTES, writer, **kwargs
+                )
+                while not download.finished:
+                    if cancel is not None and cancel.is_set():
+                        raise RuntimeError(
+                            f"GCS read of {path} abandoned: a sibling "
+                            f"chunk failed"
+                        )
+                    guard.check(download.consume_next_chunk(self._session()))
+                    self._retry.report_progress()
+                if writer.tell() != expected:
+                    raise RuntimeError(
+                        f"GCS read of {path} returned "
+                        f"{writer.tell()} bytes, expected {expected}"
+                    )
+                return
+            except Exception as e:  # noqa: BLE001
+                status = getattr(
+                    getattr(e, "response", None), "status_code", None
+                )
+                if version is not None and status == 404:
+                    # The pinned generation is gone — same diagnostic the
+                    # S3 path raises on 412, not a bare "not found" that
+                    # reads like data loss.
+                    raise RuntimeError(
+                        f"GCS object {path} changed mid-read "
+                        f"(generation {version} superseded or deleted)"
+                    ) from e
+                if not _is_transient(e):
+                    raise
+                self._retry.check_and_backoff(e, cancel)
+                writer.seek(0)
+                guard.reset()
+
+    class _GenerationGuard:
+        """Detects a mid-read overwrite across ChunkedDownload's multiple
+        HTTP requests (one per 100 MB chunk) with zero extra round-trips:
+        every media response carries ``x-goog-generation``, and a transfer
+        whose chunks disagree has interleaved two object versions — the
+        same torn read the fan-out path's explicit pin prevents.  Costs
+        nothing on single-request transfers and small objects (a metadata
+        probe here would double round-trips for every manifest read)."""
+
+        def __init__(self, path: str) -> None:
+            self._path = path
+            self._seen: Optional[str] = None
+
+        def check(self, resp) -> None:
+            gen = resp.headers.get("x-goog-generation")
+            if gen is None:
+                return  # emulators may omit it; nothing to compare
+            if self._seen is None:
+                self._seen = gen
+            elif gen != self._seen:
+                raise RuntimeError(
+                    f"GCS object {self._path} changed mid-read "
+                    f"(generation {self._seen} -> {gen})"
+                )
+
+        def reset(self) -> None:
+            # A full restart re-reads every byte, so chunks need only be
+            # consistent within the new attempt.
+            self._seen = None
+
+    def _download_range(self, path: str, byte_range) -> bytearray:
+        from google.resumable_media.requests import ChunkedDownload
+
+        url = self._object_url(path, media=True)
         out = io.BytesIO()
         kwargs = {}
         if byte_range is not None:
             kwargs = {"start": byte_range[0], "end": byte_range[1] - 1}
+        guard = self._GenerationGuard(path)
         while True:
             try:
                 download = ChunkedDownload(url, _CHUNK_SIZE_BYTES, out, **kwargs)
                 while not download.finished:
-                    download.consume_next_chunk(self._session())
+                    guard.check(download.consume_next_chunk(self._session()))
                     self._retry.report_progress()
                 return bytearray(out.getvalue())
             except Exception as e:  # noqa: BLE001
@@ -213,6 +413,7 @@ class GCSStoragePlugin(StoragePlugin):
                 self._retry.check_and_backoff(e)
                 out.seek(0)
                 out.truncate()
+                guard.reset()
 
     async def write(self, write_io: WriteIO) -> None:
         loop = asyncio.get_running_loop()
@@ -227,16 +428,12 @@ class GCSStoragePlugin(StoragePlugin):
             self._blocking_read,
             read_io.path,
             read_io.byte_range,
+            read_io.into,
         )
 
     async def delete(self, path: str) -> None:
         def _delete() -> None:
-            url = (
-                f"{self._download_base}/storage/v1/b/"
-                f"{self.bucket_name}/o/"
-                + self._blob_url(path).replace("/", "%2F")
-            )
-            resp = self._session().delete(url)
+            resp = self._session().delete(self._object_url(path))
             if resp.status_code not in (200, 204, 404):
                 resp.raise_for_status()
 
@@ -359,11 +556,7 @@ class GCSStoragePlugin(StoragePlugin):
         def _probe() -> bool:
             # Metadata GET (no alt=media): one cheap round-trip instead of
             # downloading the object.
-            url = (
-                f"{self._download_base}/storage/v1/b/{self.bucket_name}/o/"
-                + self._blob_url(path).replace("/", "%2F")
-            )
-            resp = self._get_with_retry(url, {})
+            resp = self._get_with_retry(self._object_url(path), {})
             return resp.status_code == 200
 
         return await asyncio.get_running_loop().run_in_executor(
@@ -401,3 +594,4 @@ class GCSStoragePlugin(StoragePlugin):
         if self._executor is not None:
             self._executor.shutdown()
             self._executor = None
+        self._chunk_executor.shutdown()

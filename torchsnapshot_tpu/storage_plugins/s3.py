@@ -145,6 +145,17 @@ class S3StoragePlugin(StoragePlugin):
         self._delete_executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="s3_del"
         )
+        # Child pool for intra-object ranged-GET fan-out: the parent read
+        # occupies an s3_io thread and blocks on its chunks, so submitting
+        # chunks to the same pool deadlocks once every io thread holds a
+        # parent read (same parent/child split as fs.py's chunk reads).
+        # Sized above the 16-thread io pool: with all 16 parents fanning
+        # out, a smaller pool would cap aggregate in-flight requests BELOW
+        # the 16 single streams it replaces.  Built eagerly — this is
+        # reached from io-pool worker threads where lazy init would race.
+        self._chunk_executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="s3_chunk"
+        )
         region = os.environ.get(
             "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
         )
@@ -342,11 +353,140 @@ class S3StoragePlugin(StoragePlugin):
             self._abort_multipart(key, upload_id)
             raise
 
+    def _stream_get_into(
+        self,
+        path: str,
+        start: Optional[int],
+        end: Optional[int],
+        view,
+        version: Optional[str] = None,
+        cancel=None,
+    ) -> None:
+        """One GET streamed straight into the caller's view — no
+        resp.content staging (with up to 32 concurrent chunks, fully
+        buffered responses would hold whole chunk copies outside the
+        scheduler's memory budget, plus an extra memcpy pass).  ``start``
+        ``end`` (exclusive) select a range; ``(None, None)`` streams the
+        whole object, which must be exactly ``view.nbytes`` long.
+
+        Owns its retry loop instead of riding ``_request``: transient
+        errors can surface mid-body here, after ``_request`` would already
+        have returned."""
+        import time as _time
+
+        expected = view.nbytes
+        url = self._url(self._key(path))
+        last_exc: Optional[BaseException] = None
+        for attempt in range(_MAX_ATTEMPTS):
+            if cancel is not None and cancel.is_set():
+                # A sibling fan-out chunk failed hard: abandon the retry
+                # schedule instead of making the caller wait it out.
+                raise RuntimeError(
+                    f"S3 GET {path} abandoned: a sibling chunk failed"
+                )
+            if attempt:
+                _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
+            req_headers = {}
+            if start is not None:
+                req_headers["Range"] = f"bytes={start}-{end - 1}"
+            if version is not None:
+                # Version pin for fan-out chunks: a concurrent overwrite
+                # must fail the read (412), never interleave two versions'
+                # bytes into one buffer.
+                req_headers["If-Match"] = version
+            if self._signer is not None:
+                self._signer.sign("GET", url, req_headers)
+            try:
+                with self._session().get(
+                    url, headers=req_headers, timeout=300, stream=True
+                ) as resp:
+                    if resp.status_code == 412:
+                        raise RuntimeError(
+                            f"S3 object {path} changed mid-read "
+                            f"(ETag no longer {version})"
+                        )
+                    if resp.status_code in _TRANSIENT_STATUS:
+                        last_exc = RuntimeError(
+                            f"S3 transient {resp.status_code}"
+                        )
+                        continue
+                    if resp.status_code not in (200, 206):
+                        raise RuntimeError(
+                            f"S3 GET {path} failed: {resp.status_code} "
+                            f"{resp.text[:200]}"
+                        )
+                    clen = resp.headers.get("Content-Length")
+                    if resp.status_code == 200 and start is not None:
+                        # A server legally may ignore Range and return 200
+                        # with the full object.  A mid-object chunk's body
+                        # would start at offset 0, not ``start``; an
+                        # offset-0 chunk's body is acceptable only when a
+                        # Content-Length proves it is exactly the
+                        # requested prefix (i.e. the whole object).
+                        if start > 0 or clen is None or int(clen) != expected:
+                            raise RuntimeError(
+                                f"S3 ignored Range for {path} "
+                                f"(200 for bytes={start}-{end - 1})"
+                            )
+                    if clen is not None and int(clen) != expected:
+                        raise RuntimeError(
+                            f"S3 GET {path} returned {clen} bytes, "
+                            f"expected {expected} "
+                            f"(status {resp.status_code})"
+                        )
+                    filled = 0
+                    for piece in resp.iter_content(chunk_size=1 << 20):
+                        if cancel is not None and cancel.is_set():
+                            # Mirror the GCS between-chunk check: a
+                            # sibling's hard failure must not wait out
+                            # this stream's full remaining transfer.
+                            raise RuntimeError(
+                                f"S3 GET {path} abandoned: a sibling "
+                                f"chunk failed"
+                            )
+                        n = len(piece)
+                        if filled + n > expected:
+                            raise RuntimeError(
+                                f"S3 GET {path} exceeded the expected "
+                                f"{expected} bytes"
+                            )
+                        view[filled : filled + n] = piece
+                        filled += n
+                    if filled != expected:
+                        raise RuntimeError(
+                            f"S3 GET {path} returned {filled} "
+                            f"bytes, expected {expected} "
+                            f"(status {resp.status_code})"
+                        )
+                    return
+            except (
+                self._requests.exceptions.ConnectionError,
+                self._requests.exceptions.Timeout,
+                self._requests.exceptions.ChunkedEncodingError,
+            ) as e:
+                last_exc = e
+                continue
+        raise RuntimeError(
+            f"S3 GET {path} failed after {_MAX_ATTEMPTS} attempts"
+        ) from last_exc
+
+    def _object_stat(self, path: str):
+        """(size, etag) from one HEAD — the etag pins fan-out reads to a
+        single object version (If-Match on every ranged GET)."""
+        resp = self._request("HEAD", self._url(self._key(path)))
+        if resp.status_code != 200:
+            raise RuntimeError(f"S3 HEAD {path} failed: {resp.status_code}")
+        return (
+            int(resp.headers.get("Content-Length", -1)),
+            resp.headers.get("ETag") or None,
+        )
+
     async def read(self, read_io: ReadIO) -> None:
-        def _get() -> bytearray:
+        def _single_read() -> bytearray:
             headers = {}
-            if read_io.byte_range is not None:
-                start, end = read_io.byte_range
+            byte_range = read_io.byte_range
+            if byte_range is not None:
+                start, end = byte_range
                 # HTTP Range is inclusive on both ends (reference s3.py:60-66)
                 headers["Range"] = f"bytes={start}-{end - 1}"
             resp = self._request(
@@ -357,18 +497,35 @@ class S3StoragePlugin(StoragePlugin):
                     f"S3 GET {read_io.path} failed: {resp.status_code} "
                     f"{resp.text[:200]}"
                 )
-            if read_io.byte_range is not None:
-                expected = read_io.byte_range[1] - read_io.byte_range[0]
-                if len(resp.content) != expected:
-                    # A server legally may ignore Range and return 200 with
-                    # the full object — that must not masquerade as the
-                    # requested slice.
-                    raise RuntimeError(
-                        f"S3 ranged GET {read_io.path} returned "
-                        f"{len(resp.content)} bytes, expected {expected} "
-                        f"(status {resp.status_code})"
-                    )
+            if byte_range is not None and len(resp.content) != (
+                byte_range[1] - byte_range[0]
+            ):
+                # A server legally may ignore Range and return 200 with
+                # the full object — that must not masquerade as the slice.
+                raise RuntimeError(
+                    f"S3 GET {read_io.path} returned "
+                    f"{len(resp.content)} bytes, expected "
+                    f"{byte_range[1] - byte_range[0]} "
+                    f"(status {resp.status_code})"
+                )
             return bytearray(resp.content)
+
+        def _get():
+            from ._ranged import orchestrated_read
+
+            return orchestrated_read(
+                byte_range=read_io.byte_range,
+                into=read_io.into,
+                chunk_executor=self._chunk_executor,
+                stream_into=lambda s, e, v, version=None, cancel=None: (
+                    self._stream_get_into(
+                        read_io.path, s, e, v, version=version, cancel=cancel
+                    )
+                ),
+                probe_stat=lambda: self._object_stat(read_io.path),
+                single_read=_single_read,
+                label=f"S3 object {read_io.path}",
+            )
 
         read_io.buf = await asyncio.get_running_loop().run_in_executor(
             self._get_executor(), _get
@@ -590,3 +747,4 @@ class S3StoragePlugin(StoragePlugin):
             self._executor.shutdown()
             self._executor = None
         self._delete_executor.shutdown()
+        self._chunk_executor.shutdown()
