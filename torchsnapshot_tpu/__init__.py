@@ -9,6 +9,7 @@ API mirrors the reference's tiny surface:
 
 from .rng_state import RNGState
 from .manager import SnapshotManager
+from .replication import copy_snapshot
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
@@ -21,6 +22,7 @@ __all__ = [
     "StateDict",
     "RNGState",
     "SnapshotManager",
+    "copy_snapshot",
 ]
 
 from .version import __version__

@@ -6,9 +6,10 @@
     python -m torchsnapshot_tpu steps <manager-root-url>
     python -m torchsnapshot_tpu verify <snapshot-url>
     python -m torchsnapshot_tpu diff <snapshot-url-a> <snapshot-url-b>
+    python -m torchsnapshot_tpu cp <src-url> <dst-url> [--verify]
 
-Read-only; works against any storage backend URL.  (Beyond reference parity:
-the reference ships no CLI.)
+Read-only except ``cp``; works against any storage backend URL.  (Beyond
+reference parity: the reference ships no CLI.)
 """
 
 from __future__ import annotations
@@ -149,14 +150,6 @@ def cmd_verify(args: argparse.Namespace) -> int:
     """Audit every payload checksum without restoring: catches bit rot /
     truncation before a resume depends on the snapshot."""
     from . import integrity
-    from .integrity import ChecksumError, verify
-    from .io_types import ReadIO
-    from .manifest import (
-        ChunkedTensorEntry,
-        ObjectEntry,
-        ShardedArrayEntry,
-        TensorEntry,
-    )
     from .native_io import NativeFileIO
     from .snapshot import Snapshot
     from .storage_plugin import url_to_storage_plugin
@@ -171,48 +164,14 @@ def cmd_verify(args: argparse.Namespace) -> int:
         return 2
 
     md = Snapshot(args.path).metadata
-    # (location, byte_range) -> checksum, deduped: replicated references
-    # point at one durable payload.  ObjectEntry has no byte_range (whole
-    # file), hence the getattr.
-    payloads = {}
-
-    def _add(entry) -> None:
-        if entry.checksum is None:
-            return
-        br = getattr(entry, "byte_range", None)
-        payloads[(entry.location, tuple(br) if br else None)] = entry.checksum
-
-    for entry in md.manifest.values():
-        if isinstance(entry, (TensorEntry, ObjectEntry)):
-            _add(entry)
-        else:
-            for shard in _shards(entry) or ():
-                _add(shard.tensor)
-
     storage = url_to_storage_plugin(args.path)
-    ok = corrupt = unreadable = 0
     try:
-        for (location, br), checksum in sorted(payloads.items()):
-            read_io = ReadIO(
-                path=location,
-                byte_range=list(br) if br else None,
-                want_hash=True,  # the digest is exactly what we're here for
-            )
-            try:
-                storage.sync_read(read_io)
-            except Exception as e:  # noqa: BLE001
-                print(f"UNREADABLE {location}: {e}")
-                unreadable += 1
-                continue
-            try:
-                verify(read_io.buf, checksum, location, precomputed=read_io.hash64)
-                ok += 1
-            except ChecksumError as e:
-                print(f"CORRUPT {e}")
-                corrupt += 1
+        ok, corrupt, unreadable, problems = integrity.audit(storage, md)
     finally:
         storage.sync_close()
-    skipped = "" if payloads else " (no checksums recorded)"
+    for line in problems:
+        print(line)
+    skipped = "" if ok or corrupt or unreadable else " (no checksums recorded)"
     print(
         f"verified {ok} payloads, {corrupt} corrupt, "
         f"{unreadable} unreadable{skipped}"
@@ -322,6 +281,24 @@ def cmd_diff(args: argparse.Namespace) -> int:
     return 1 if added or removed or changed else 0
 
 
+def cmd_cp(args: argparse.Namespace) -> int:
+    """Replicate a committed snapshot between storage backends (fs ↔ s3 ↔
+    gs, any direction): DR uploads of local checkpoints, cloud→local
+    restore prefetch.  Payloads first, commit marker last — an interrupted
+    copy never leaves a destination that opens as a valid snapshot."""
+    from .replication import copy_snapshot
+
+    copy_snapshot(
+        args.src,
+        args.dst,
+        overwrite=args.overwrite,
+        io_concurrency=args.concurrency,
+        verify=args.verify,
+    )
+    print(f"copied {args.src} -> {args.dst}" + (" (verified)" if args.verify else ""))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -357,6 +334,26 @@ def main(argv=None) -> int:
     p.add_argument("path_b")
     p.add_argument("--limit", type=int, default=20, help="paths shown per bucket")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "cp", help="replicate a snapshot to another storage backend"
+    )
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument(
+        "--overwrite",
+        action="store_true",
+        help="replace a committed snapshot at dst",
+    )
+    p.add_argument(
+        "--verify",
+        action="store_true",
+        help="audit all checksummed payloads on dst after the copy",
+    )
+    p.add_argument(
+        "--concurrency", type=int, default=4, help="concurrent payload copies"
+    )
+    p.set_defaults(fn=cmd_cp)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -80,6 +80,72 @@ async def compute_on(buf, executor) -> Optional[str]:
     )
 
 
+def payload_checksums(metadata) -> dict:
+    """``{(location, byte_range_tuple_or_None): checksum_or_None}`` for every
+    payload a snapshot's manifest references, deduplicated (replicated
+    entries and slab members point at shared durable payloads).  The file
+    set of a snapshot is exactly these locations plus the commit marker."""
+    from .manifest import ChunkedTensorEntry, ObjectEntry, ShardedArrayEntry, TensorEntry
+
+    payloads: dict = {}
+
+    def _add(entry) -> None:
+        byte_range = getattr(entry, "byte_range", None)
+        key = (entry.location, tuple(byte_range) if byte_range else None)
+        # A digest-carrying reference must win over a checksum-less
+        # duplicate of the same payload (replicated references share one
+        # durable file) — the audit would otherwise silently skip it.
+        if payloads.get(key) is None:
+            payloads[key] = entry.checksum
+    for entry in metadata.manifest.values():
+        if isinstance(entry, (TensorEntry, ObjectEntry)):
+            _add(entry)
+        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
+            shards = (
+                entry.shards
+                if isinstance(entry, ShardedArrayEntry)
+                else entry.chunks
+            )
+            for shard in shards:
+                _add(shard.tensor)
+    return payloads
+
+
+def audit(storage, metadata) -> tuple:
+    """Audit every checksummed payload without restoring: reads each
+    (location, byte_range) and verifies its digest.  Returns
+    ``(ok, corrupt, unreadable, problems)`` where ``problems`` is a list of
+    human-readable failure lines.  Payloads without a recorded digest are
+    skipped (nothing to prove)."""
+    from .io_types import ReadIO
+
+    ok = corrupt = unreadable = 0
+    problems = []
+    for (location, byte_range), checksum in sorted(
+        payload_checksums(metadata).items()
+    ):
+        if checksum is None:
+            continue
+        read_io = ReadIO(
+            path=location,
+            byte_range=list(byte_range) if byte_range else None,
+            want_hash=True,
+        )
+        try:
+            storage.sync_read(read_io)
+        except Exception as e:  # noqa: BLE001
+            problems.append(f"UNREADABLE {location}: {e}")
+            unreadable += 1
+            continue
+        try:
+            verify(read_io.buf, checksum, location, precomputed=read_io.hash64)
+            ok += 1
+        except ChecksumError as e:
+            problems.append(f"CORRUPT {e}")
+            corrupt += 1
+    return ok, corrupt, unreadable, problems
+
+
 def verify(
     buf,
     expected: Optional[str],

@@ -33,6 +33,7 @@ class FSStoragePlugin(StoragePlugin):
         self.root = root
         self._dir_cache: Set[str] = set()
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._executor_lock = threading.Lock()
         # Built eagerly: the getter runs concurrently on fs_io worker
         # threads, where lazy init would race and leak a pool.  Construction
         # is cheap — ThreadPoolExecutor spawns threads on first submit.
@@ -57,10 +58,16 @@ class FSStoragePlugin(StoragePlugin):
         self._reads_since_probe = 0
 
     def _get_executor(self) -> ThreadPoolExecutor:
+        # Double-checked under a lock: the sync_* surface is driven from
+        # multiple caller threads (replication workers), where an unlocked
+        # check-then-set would build two pools and leak one.
         if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=_DEFAULT_IO_THREADS, thread_name_prefix="fs_io"
-            )
+            with self._executor_lock:
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=_DEFAULT_IO_THREADS,
+                        thread_name_prefix="fs_io",
+                    )
         return self._executor
 
     def _get_chunk_executor(self) -> ThreadPoolExecutor:

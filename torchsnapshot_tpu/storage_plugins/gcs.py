@@ -149,6 +149,7 @@ class GCSStoragePlugin(StoragePlugin):
         self.bucket_name = bucket
         self.prefix = prefix.strip("/")
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._executor_lock = threading.Lock()
         self._retry = _SharedDeadlineRetryStrategy()
         self._local = threading.local()
         # Child pool for intra-object ranged-download fan-out: the parent
@@ -201,10 +202,15 @@ class GCSStoragePlugin(StoragePlugin):
         return self._local.session
 
     def _get_executor(self) -> ThreadPoolExecutor:
+        # Double-checked under a lock: the sync_* surface is driven from
+        # multiple caller threads (replication workers), where an unlocked
+        # check-then-set would build two pools and leak one.
         if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=_IO_THREADS, thread_name_prefix="gcs_io"
-            )
+            with self._executor_lock:
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=_IO_THREADS, thread_name_prefix="gcs_io"
+                    )
         return self._executor
 
     def _blob_url(self, path: str) -> str:

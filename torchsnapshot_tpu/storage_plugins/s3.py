@@ -26,6 +26,7 @@ import datetime
 import hashlib
 import hmac
 import os
+import threading
 import urllib.parse
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
@@ -142,6 +143,7 @@ class S3StoragePlugin(StoragePlugin):
         self.bucket = bucket
         self.prefix = prefix.strip("/")
         self._executor: Optional[ThreadPoolExecutor] = None
+        self._executor_lock = threading.Lock()
         self._delete_executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="s3_del"
         )
@@ -177,8 +179,6 @@ class S3StoragePlugin(StoragePlugin):
             )
         # One session per executor thread: requests.Session is not
         # thread-safe under concurrent use (same pattern as gcs.py).
-        import threading
-
         self._local = threading.local()
 
     def _session(self):
@@ -187,10 +187,15 @@ class S3StoragePlugin(StoragePlugin):
         return self._local.session
 
     def _get_executor(self) -> ThreadPoolExecutor:
+        # Double-checked under a lock: the sync_* surface is driven from
+        # multiple caller threads (replication workers), where an unlocked
+        # check-then-set would build two pools and leak one.
         if self._executor is None:
-            self._executor = ThreadPoolExecutor(
-                max_workers=_IO_THREADS, thread_name_prefix="s3_io"
-            )
+            with self._executor_lock:
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=_IO_THREADS, thread_name_prefix="s3_io"
+                    )
         return self._executor
 
     def _get_delete_executor(self) -> ThreadPoolExecutor:
