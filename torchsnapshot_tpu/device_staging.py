@@ -1,0 +1,263 @@
+"""Device-side async-snapshot staging: return from ``async_take`` in
+milliseconds on any transport.
+
+The reference's async snapshot must stage every tensor to host RAM before
+returning (/root/reference/torchsnapshot/snapshot.py:962-1068 — its
+donation-safety contract is "bytes are off the GPU"), so its training stall
+is bounded below by D2H bandwidth.  On a TPU the same contract can be met
+*inside* the accelerator: copy the app state to spare HBM (one jitted
+device-side copy at HBM bandwidth) or to the ``pinned_host`` memory space
+(one PCIe-rate DMA on the TPU host — the closest reference analogue is fbgemm
+UVM, /root/reference/torchsnapshot/uvm_tensor.py:28-47, which it can only
+*read*, not snapshot to).  Either way the caller's buffers are free for
+donation the moment ``async_take`` returns, and the slow D2H + storage drain
+happens entirely on the background thread.
+
+Mode selection (``TPUSNAP_ASYNC_STAGING``):
+
+- ``auto`` (default): ``pinned_host`` when the backend exposes that memory
+  space (it frees HBM immediately and host RAM is the larger pool), else
+  ``device`` when HBM headroom fits a full copy, else ``host``.
+- ``pinned_host`` / ``device``: force that placement (falling back down the
+  same chain with a warning if unsupported).
+- ``host``: the reference-equivalent behavior — stage to process RAM on the
+  main thread before returning.
+
+What gets copied before return, by leaf type:
+
+- device-resident ``jax.Array`` (sharded or not) → one batched
+  ``jax.device_put`` to the same sharding in ``pinned_host`` space, or one
+  jitted on-device copy (``device`` mode).  Shardings (mesh, spec, process
+  mapping) are preserved, so all downstream planning — replication
+  detection, partitioning, shard ownership — is unaffected.
+- host-resident ``jax.Array`` (already ``pinned_host``) → left in place:
+  jax arrays are immutable and their staging reads host memory; donating a
+  host-offloaded array into a jit while its async snapshot is in flight is
+  undefined (same exposure as the reference's UVM reads).
+- ``np.ndarray`` → eager defensive copy (host memcpy), replacing the
+  staging-time copy the host path performs.
+- anything pickled (objects) → eagerly pickled into a
+  :class:`~torchsnapshot_tpu.serialization.PrePickled` envelope, so caller
+  mutations after return can't reach the payload.
+- primitives / typed PRNG keys → untouched (both are captured eagerly at
+  prepare time already).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from . import staging
+from .serialization import PrePickled
+
+logger = logging.getLogger(__name__)
+
+from .knobs import ASYNC_STAGING_ENV_VAR
+
+# Fraction of free HBM a device-mode copy may claim; the rest is slack for
+# the training step's own activations resuming underneath the drain.
+_HBM_HEADROOM_FRACTION = 0.8
+
+
+def configured_mode() -> str:
+    import os
+
+    mode = os.environ.get(ASYNC_STAGING_ENV_VAR, "auto").lower()
+    if mode not in ("auto", "device", "pinned_host", "host"):
+        raise ValueError(
+            f"{ASYNC_STAGING_ENV_VAR} must be one of "
+            f"auto/device/pinned_host/host, got {mode!r}"
+        )
+    return mode
+
+
+def _device_resident_arrays(flattened: Dict[str, Any]) -> Dict[str, Any]:
+    """Leaves that would need a D2H DMA to stage (device jax arrays that are
+    not typed PRNG keys — keys are captured eagerly at prepare time)."""
+    out = {}
+    for path, obj in flattened.items():
+        if not staging.is_jax_array(obj) or staging.is_prng_key_array(obj):
+            continue
+        if getattr(obj.sharding, "memory_kind", None) == "pinned_host":
+            continue
+        out[path] = obj
+    return out
+
+
+def _supports_pinned_host(arr: Any) -> bool:
+    try:
+        dev = next(iter(arr.sharding.device_set))
+        return "pinned_host" in {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        return False
+
+
+def _hbm_headroom_fits(arrays: Dict[str, Any]) -> bool:
+    """True when every device touched has free HBM for its share of the copy.
+    Backends without memory_stats (CPU) always fit — host RAM is the pool."""
+    need_per_device: Dict[Any, int] = {}
+    for arr in arrays.values():
+        try:
+            shards = arr.addressable_shards
+        except Exception:
+            continue
+        for shard in shards:
+            nbytes = int(np.prod(shard.data.shape)) * np.dtype(arr.dtype).itemsize
+            need_per_device[shard.device] = (
+                need_per_device.get(shard.device, 0) + nbytes
+            )
+    for device, need in need_per_device.items():
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        if limit is None or in_use is None:
+            continue
+        if need > (limit - in_use) * _HBM_HEADROOM_FRACTION:
+            return False
+    return True
+
+
+def resolve_mode(flattened: Dict[str, Any]) -> str:
+    """Resolve the configured mode against this app state and backend.
+    Returns the placement that will actually be used."""
+    mode = configured_mode()
+    if mode == "host":
+        return "host"
+    arrays = _device_resident_arrays(flattened)
+    if not arrays:
+        # Nothing needs a D2H DMA; host staging is already instant.
+        return "host"
+    probe = next(iter(arrays.values()))
+    pinned_ok = _supports_pinned_host(probe) and not _PINNED_HOST_BROKEN
+    if mode == "pinned_host" and not pinned_ok:
+        logger.warning(
+            "TPUSNAP_ASYNC_STAGING=pinned_host but the backend has no "
+            "pinned_host memory space; falling back to device-copy staging"
+        )
+        mode = "device"
+    if mode == "device" or (mode == "auto" and not pinned_ok):
+        if _hbm_headroom_fits(arrays):
+            return "device"
+        logger.warning(
+            "Insufficient HBM headroom for device-copy async staging; "
+            "falling back to host staging"
+        )
+        return "host"
+    # auto with pinned_host available, or explicit pinned_host
+    return "pinned_host"
+
+
+_DEVICE_COPY_CACHE: dict = {}
+
+
+def _device_copy_batch(arrays: list) -> list:
+    """One jitted on-device copy over all arrays (outputs are fresh HBM
+    buffers: no donation, so XLA cannot alias them to the inputs).  The
+    compile is cached per (shape, dtype, sharding) tuple — in a training
+    loop every async_take after the first reuses it."""
+    import jax
+
+    fn = _DEVICE_COPY_CACHE.get("fn")
+    if fn is None:
+        import jax.numpy as jnp
+
+        fn = jax.jit(lambda xs: [jnp.copy(x) for x in xs])
+        _DEVICE_COPY_CACHE["fn"] = fn
+    return jax.block_until_ready(fn(arrays))
+
+
+# Set when a pinned_host transfer failed on this backend (some stacks can't
+# reshard multi-process sharded arrays into the host memory space); later
+# snapshots skip straight to the device-copy path.
+_PINNED_HOST_BROKEN = False
+
+
+def _pinned_host_copy_batch(arrays: list) -> list:
+    """One batched DMA into the pinned_host memory space, preserving each
+    array's logical sharding.  The transfer runs on the accelerator host at
+    PCIe rate — it never crosses a slow client↔host transport."""
+    import jax
+
+    targets = [a.sharding.with_memory_kind("pinned_host") for a in arrays]
+    return jax.block_until_ready(jax.device_put(arrays, targets))
+
+
+def stage_app_state(
+    flattened: Dict[str, Any], mode: str
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Substitute every mutation-exposed leaf with a snapshot-stable copy
+    per the resolved ``mode`` ("device" or "pinned_host").  Returns the new
+    flattened dict and a stats dict for events/benchmarks."""
+    begin = time.monotonic()
+    arrays = _device_resident_arrays(flattened)
+    paths = list(arrays.keys())
+    copy_bytes = sum(
+        int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize for a in arrays.values()
+    )
+    global _PINNED_HOST_BROKEN
+    if mode == "pinned_host":
+        try:
+            copies = _pinned_host_copy_batch([arrays[p] for p in paths])
+        except Exception as e:
+            # Some backends cannot place multi-process sharded arrays into
+            # the host memory space (observed: "Side-effect ops cannot be
+            # replicated" from the reshard path).  The on-device copy meets
+            # the same donation contract; remember the failure so later
+            # snapshots skip the doomed attempt.
+            _PINNED_HOST_BROKEN = True
+            logger.warning(
+                "pinned_host staging failed (%s); using device-copy staging",
+                type(e).__name__,
+            )
+            mode = "device"
+            copies = _device_copy_batch([arrays[p] for p in paths])
+    elif mode == "device":
+        copies = _device_copy_batch([arrays[p] for p in paths])
+    else:  # pragma: no cover - callers resolve mode first
+        raise ValueError(f"stage_app_state cannot run in mode {mode!r}")
+
+    out: Dict[str, Any] = {}
+    copied = dict(zip(paths, copies))
+    for path, obj in flattened.items():
+        if path in copied:
+            out[path] = copied[path]
+        elif isinstance(obj, np.ndarray):
+            out[path] = obj.copy()
+        elif (
+            staging.is_jax_array(obj)
+            or isinstance(obj, np.generic)
+            or _is_prepare_time_safe(obj)
+        ):
+            out[path] = obj
+        else:
+            # Arbitrary objects are pickled lazily at staging time on the
+            # host path; here staging runs in the background, so capture the
+            # bytes now.
+            out[path] = PrePickled(obj)
+    stats = {
+        "mode": mode,
+        "copy_bytes": copy_bytes,
+        "copy_s": time.monotonic() - begin,
+        "n_arrays": len(paths),
+    }
+    return out, stats
+
+
+def _is_prepare_time_safe(obj: Any) -> bool:
+    """Leaves whose bytes are captured eagerly during prepare_write on the
+    main thread (no background mutation window): primitives inline into the
+    manifest, typed PRNG keys convert to a host envelope."""
+    from .manifest import PrimitiveEntry
+
+    if staging.is_prng_key_array(obj):
+        return True
+    return PrimitiveEntry.supports(obj) and not isinstance(obj, np.generic)

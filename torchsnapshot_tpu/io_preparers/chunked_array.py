@@ -26,6 +26,31 @@ from ..manifest import Chunk, ChunkedTensorEntry, Shard, TensorEntry
 from .array import ArrayAssembly, ArrayBufferConsumer, ArrayIOPreparer
 
 
+class _LazyHostSlice:
+    """A dim-0 slice of a host-resident jax.Array, materialized only when
+    staged (``np.asarray`` → numpy view of the cached host copy).  Exposes
+    dtype/shape so write planning never touches the data."""
+
+    def __init__(self, base: Any, start: int, stop: int) -> None:
+        self._base = base
+        self._start = start
+        self._stop = min(stop, base.shape[0])
+
+    @property
+    def dtype(self):
+        return np.dtype(self._base.dtype)
+
+    @property
+    def shape(self):
+        return (self._stop - self._start,) + tuple(self._base.shape[1:])
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.asarray(self._base)[self._start : self._stop]
+        if dtype is not None and out.dtype != np.dtype(dtype):
+            out = out.astype(dtype)
+        return out
+
+
 class ChunkedArrayIOPreparer:
     @staticmethod
     def chunk_instructions(
@@ -54,6 +79,16 @@ class ChunkedArrayIOPreparer:
 
     @staticmethod
     def _slice0(obj: Any, start: int, stop: int) -> Any:
+        from .. import staging
+        from ..utils.host_offload import is_host_resident
+
+        if staging.is_jax_array(obj) and is_host_resident(obj):
+            # Device-slicing a pinned_host array is a mixed-memory-space
+            # gather (rejected by XLA); materializing it here would stall
+            # the caller with a full transfer.  Defer to staging time: jax
+            # caches the base array's host copy, so N chunk slices cost one
+            # read total.
+            return _LazyHostSlice(obj, start, stop)
         return obj[start:stop]
 
     @classmethod

@@ -23,7 +23,9 @@ class ObjectIOPreparer:
         entry = ObjectEntry(
             location=storage_path,
             serializer="pickle",
-            obj_type=type(obj).__name__,
+            obj_type=obj.obj_type
+            if isinstance(obj, serialization.PrePickled)
+            else type(obj).__name__,
             replicated=False,
         )
         return entry, [
@@ -61,11 +63,16 @@ class ObjectBufferStager(BufferStager):
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         from .. import integrity
 
-        data = serialization.pickle_save_as_bytes(self._obj)
+        if isinstance(self._obj, serialization.PrePickled):
+            data = self._obj.data
+        else:
+            data = serialization.pickle_save_as_bytes(self._obj)
         self._entry.checksum = await integrity.compute_on(data, executor)
         return data
 
     def get_staging_cost_bytes(self) -> int:
+        if isinstance(self._obj, serialization.PrePickled):
+            return len(self._obj.data)
         # sys.getsizeof is knowingly inaccurate (reference object.py:78-80);
         # pickling to measure would defeat the lazy staging.
         return max(sys.getsizeof(self._obj), 4096)

@@ -28,6 +28,7 @@ MAX_READ_MERGE_GAP_ENV_VAR = _ENV_PREFIX + "MAX_READ_MERGE_GAP_BYTES"
 PARALLEL_READ_WAYS_ENV_VAR = _ENV_PREFIX + "PARALLEL_READ_WAYS"
 PROGRESS_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "PROGRESS_INTERVAL_S"
 CLOUD_PARALLEL_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "CLOUD_PARALLEL_MIN_BYTES"
+ASYNC_STAGING_ENV_VAR = _ENV_PREFIX + "ASYNC_STAGING"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -202,4 +203,12 @@ def override_progress_interval_s(value: float) -> Generator[None, None, None]:
 @contextmanager
 def override_cloud_parallel_min_bytes(value: int) -> Generator[None, None, None]:
     with _override_env(CLOUD_PARALLEL_MIN_BYTES_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_async_staging(mode: str) -> Generator[None, None, None]:
+    """auto / device / pinned_host / host — where async_take makes the app
+    state snapshot-stable before returning (device_staging.py)."""
+    with _override_env(ASYNC_STAGING_ENV_VAR, mode):
         yield

@@ -213,6 +213,39 @@ class _BudgetTracker:
         self.inflight = 0
 
 
+class DeferredIOWork:
+    """PendingIOWork variant for device-staged async snapshots: the ENTIRE
+    write pipeline — D2H staging included — runs at ``sync_complete`` time
+    on the async background thread.  Safe because the app state was already
+    copied on-device (device_staging.py): the donation-safety contract is
+    met by the copies, not by host staging, so nothing here needs to finish
+    before ``async_take`` returns."""
+
+    def __init__(
+        self,
+        write_reqs: List[WriteReq],
+        storage: StoragePlugin,
+        memory_budget_bytes: int,
+        rank: int,
+    ) -> None:
+        self._write_reqs = write_reqs
+        self._storage = storage
+        self._memory_budget_bytes = memory_budget_bytes
+        self._rank = rank
+        self.bytes_total = 0
+
+    def sync_complete(self) -> None:
+        pending = sync_execute_write_reqs(
+            write_reqs=self._write_reqs,
+            storage=self._storage,
+            memory_budget_bytes=self._memory_budget_bytes,
+            rank=self._rank,
+        )
+        self._write_reqs = []
+        self.bytes_total = pending.bytes_total
+        pending.sync_complete()
+
+
 async def execute_write_reqs(
     write_reqs: List[WriteReq],
     storage: StoragePlugin,

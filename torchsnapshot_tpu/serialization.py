@@ -139,6 +139,18 @@ def pickle_load_from_bytes(data: bytes) -> Any:
     return pickle.loads(data)
 
 
+class PrePickled:
+    """An object whose pickle bytes were captured eagerly (device-staged
+    async snapshots pickle on the main thread so the background pipeline
+    never races caller mutations — device_staging.py)."""
+
+    __slots__ = ("data", "obj_type")
+
+    def __init__(self, obj: Any) -> None:
+        self.data = pickle_save_as_bytes(obj)
+        self.obj_type = type(obj).__name__
+
+
 def cast_copy(src: np.ndarray, dst_dtype: Any) -> np.ndarray:
     """Dtype-converting copy used when restoring into a differently-typed
     target (the reference's quantization-aware ``tensor_copy``,

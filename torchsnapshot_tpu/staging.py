@@ -8,11 +8,15 @@ host copy).  Because stagers call ``enqueue_d2h`` when the scheduler *admits*
 them (not at plan time), host memory stays under the scheduler's budget while
 admitted transfers still overlap each other and storage I/O.
 
-Donation safety for async snapshots: by the time ``async_take`` returns, every
-stager has completed (PendingIOWork early-return happens after staging —
-scheduler.py), so all bytes live in host memory and the training step is free
-to donate/overwrite the device buffers.  Host numpy arrays are defensively
-copied for async snapshots instead (reference tensor.py:283-293).
+Donation safety for async snapshots comes in two flavors: with device-side
+staging (device_staging.py, the default where supported) the state is copied
+inside the accelerator before ``async_take`` returns and these helpers drain
+the copies in the background; in host mode every stager completes before
+return (PendingIOWork early-return happens after staging — scheduler.py), so
+all bytes live in host memory.  Either way the training step is free to
+donate/overwrite the device buffers the moment ``async_take`` returns.  Host
+numpy arrays are defensively copied (eagerly in device modes, at staging
+time in host mode — reference tensor.py:283-293).
 """
 
 from __future__ import annotations
