@@ -223,3 +223,49 @@ def test_verify_refuses_digestless_source(tmp_path, monkeypatch):
     dst2 = str(tmp_path / "dst2")
     copy_snapshot(src, dst2)
     _assert_restores(dst2, app)
+
+
+def test_force_stream_makes_physical_replica(tmp_path):
+    """fs-to-fs with force_stream=True must NOT hard-link: the replica's
+    payloads live on their own inodes (a physically independent copy — the
+    DR case the hard-link dedup cannot serve)."""
+    app = _app()
+    src = str(tmp_path / "src")
+    snap = Snapshot.take(src, app)
+    dst = str(tmp_path / "dst")
+    copy_snapshot(src, dst, verify=True, force_stream=True)
+    _assert_restores(dst, app)
+    locations = {
+        e.location
+        for e in snap.get_manifest().values()
+        if getattr(e, "location", None)
+    }
+    assert locations
+    for loc in locations:
+        assert os.stat(os.path.join(dst, loc)).st_ino != os.stat(
+            os.path.join(src, loc)
+        ).st_ino, loc
+
+
+def test_payload_sizes_cover_standalone_tensors(tmp_path):
+    """Standalone tensor payloads (no byte_range in the manifest) must get
+    real sizes from dtype x shape — size 0 let the copy's byte budget admit
+    the LARGEST payloads at zero cost and inverted the largest-first order
+    (round-3 advisor finding)."""
+    from torchsnapshot_tpu import knobs
+    from torchsnapshot_tpu.replication import _payload_sizes
+
+    big = np.zeros((1024, 256), dtype=np.float32)  # 1 MiB, above tiny slabs
+    small = np.zeros(16, dtype=np.float32)
+    with knobs.override_batching_disabled(True):  # no slabs: no byte_ranges
+        snap = Snapshot.take(
+            str(tmp_path / "s"),
+            {"m": StateDict({"big": big, "small": small})},
+        )
+    sizes = _payload_sizes(snap.metadata)
+    by_suffix = {loc.rsplit("/", 1)[-1]: n for loc, n in sizes.items()}
+    assert by_suffix["big"] == big.nbytes
+    assert by_suffix["small"] == small.nbytes
+    # Largest-first ordering is now real: big sorts before small.
+    ordered = sorted(sizes, key=lambda loc: -sizes[loc])
+    assert ordered[0].endswith("big")

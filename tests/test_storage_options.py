@@ -1,0 +1,92 @@
+"""Per-call storage_options: plugin configuration that overrides env vars
+(reference torchsnapshot/storage_plugin.py:20-53 + snapshot.py:697-718).
+
+The load-bearing case: two plugins pointed at DIFFERENT endpoints in one
+process — impossible with env-only configuration (round-3 verdict item)."""
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.storage_plugin import (
+    PROTOCOL_ALIASES,
+    parse_url,
+    url_to_storage_plugin,
+)
+
+from fake_s3 import FakeS3Server
+
+
+@pytest.fixture()
+def two_s3_servers(monkeypatch):
+    # A poisoned env endpoint proves the options override actually wins.
+    monkeypatch.setenv("TPUSNAP_S3_ENDPOINT", "http://127.0.0.1:1")
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "k")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "s")
+    a, b = FakeS3Server(), FakeS3Server()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def test_two_endpoints_one_process(two_s3_servers):
+    a, b = two_s3_servers
+    plug_a = url_to_storage_plugin(
+        "s3://bkt/x", storage_options={"endpoint": a.endpoint}
+    )
+    plug_b = url_to_storage_plugin(
+        "s3://bkt/x", storage_options={"endpoint": b.endpoint}
+    )
+    try:
+        plug_a.sync_write(WriteIO(path="p", buf=b"from-a"))
+        plug_b.sync_write(WriteIO(path="p", buf=b"from-b"))
+        ra, rb = ReadIO(path="p"), ReadIO(path="p")
+        plug_a.sync_read(ra)
+        plug_b.sync_read(rb)
+        assert bytes(ra.buf) == b"from-a"
+        assert bytes(rb.buf) == b"from-b"
+    finally:
+        plug_a.sync_close()
+        plug_b.sync_close()
+
+
+def test_snapshot_take_restore_with_options(two_s3_servers):
+    a, _ = two_s3_servers
+    opts = {"endpoint": a.endpoint}
+    state = {"m": StateDict({"w": np.arange(256, dtype=np.float32)})}
+    snapshot = Snapshot.take("s3://bkt/snap", state, storage_options=opts)
+    dst = {"m": StateDict({})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], np.arange(256, dtype=np.float32))
+    # A fresh handle with the same options can also open it.
+    reopened = Snapshot("s3://bkt/snap", storage_options=opts)
+    assert any("w" in k for k in reopened.get_manifest())
+
+
+def test_async_take_with_options(two_s3_servers):
+    _, b = two_s3_servers
+    opts = {"endpoint": b.endpoint}
+    state = {"m": StateDict({"w": np.full(64, 7.0, np.float32)})}
+    pending = Snapshot.async_take("s3://bkt/asnap", state, storage_options=opts)
+    snapshot = pending.wait()
+    dst = {"m": StateDict({})}
+    snapshot.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], np.full(64, 7.0))
+
+
+def test_unknown_option_rejected():
+    with pytest.raises(ValueError, match="storage_options"):
+        url_to_storage_plugin("s3://bkt/x", storage_options={"bogus": 1})
+    with pytest.raises(ValueError, match="storage_options"):
+        url_to_storage_plugin("/tmp/x", storage_options={"bogus": 1})
+    with pytest.raises(ValueError, match="storage_options"):
+        url_to_storage_plugin("gs://bkt/x", storage_options={"bogus": 1})
+
+
+def test_parse_url_aliases():
+    assert parse_url("gs://bkt/p") == ("gcs", "bkt/p")
+    assert parse_url("gcs://bkt/p") == ("gcs", "bkt/p")
+    assert parse_url("/local/path") == ("fs", "/local/path")
+    assert parse_url("://odd") == ("fs", "odd")
+    assert PROTOCOL_ALIASES["gs"] == "gcs"

@@ -181,7 +181,12 @@ class IncrementalStoragePlugin(StoragePlugin):
 
 
 def _scheme(path: str) -> str:
-    return path.split("://", 1)[0] if "://" in path else "fs"
+    # The resolver's canonical protocol (storage_plugin.PROTOCOL_ALIASES):
+    # a private split here would compare gs != gcs and silently disable
+    # incremental dedup between alias spellings of the same backend.
+    from .storage_plugin import parse_url
+
+    return parse_url(path)[0]
 
 
 def maybe_wrap_incremental(
@@ -201,7 +206,7 @@ def maybe_wrap_incremental(
         )
         return storage
     base_root = base_path.split("://", 1)[-1]
-    if target_path is not None and _scheme(base_path) in ("s3", "gs", "gcs"):
+    if target_path is not None and _scheme(base_path) in ("s3", "gcs"):
         # Object-store copies are same-bucket only; catch the mismatch once
         # here instead of hashing every payload and refusing every copy.
         base_bucket = base_root.partition("/")[0]

@@ -111,21 +111,27 @@ def payload_checksums(metadata) -> dict:
     return payloads
 
 
-def audit(storage, metadata) -> tuple:
+def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
     """Audit every checksummed payload without restoring: reads each
     (location, byte_range) and verifies its digest.  Returns
     ``(ok, corrupt, unreadable, problems)`` where ``problems`` is a list of
     human-readable failure lines.  Payloads without a recorded digest are
-    skipped (nothing to prove)."""
+    skipped (nothing to prove).
+
+    Reads fan across ``io_concurrency`` threads (round-3 advisor finding:
+    a strictly sequential audit re-downloaded cloud snapshots one payload
+    at a time, making ``cp --verify`` much slower than the copy it
+    checked); results are aggregated in deterministic payload order."""
+    from concurrent.futures import ThreadPoolExecutor
+
     from .io_types import ReadIO
 
-    ok = corrupt = unreadable = 0
-    problems = []
-    for (location, byte_range), checksum in sorted(
-        payload_checksums(metadata).items()
-    ):
-        if checksum is None:
-            continue
+    items = sorted(
+        (k, v) for k, v in payload_checksums(metadata).items() if v is not None
+    )
+
+    def _check_one(item) -> tuple:
+        (location, byte_range), checksum = item
         read_io = ReadIO(
             path=location,
             byte_range=list(byte_range) if byte_range else None,
@@ -134,15 +140,29 @@ def audit(storage, metadata) -> tuple:
         try:
             storage.sync_read(read_io)
         except Exception as e:  # noqa: BLE001
-            problems.append(f"UNREADABLE {location}: {e}")
-            unreadable += 1
-            continue
+            return "unreadable", f"UNREADABLE {location}: {e}"
         try:
             verify(read_io.buf, checksum, location, precomputed=read_io.hash64)
-            ok += 1
+            return "ok", None
         except ChecksumError as e:
-            problems.append(f"CORRUPT {e}")
-            corrupt += 1
+            return "corrupt", f"CORRUPT {e}"
+
+    ok = corrupt = unreadable = 0
+    problems = []
+    if not items:
+        return ok, corrupt, unreadable, problems
+    with ThreadPoolExecutor(
+        max_workers=max(1, io_concurrency), thread_name_prefix="snap_audit"
+    ) as pool:
+        for status, problem in pool.map(_check_one, items):
+            if status == "ok":
+                ok += 1
+            elif status == "corrupt":
+                corrupt += 1
+                problems.append(problem)
+            else:
+                unreadable += 1
+                problems.append(problem)
     return ok, corrupt, unreadable, problems
 
 

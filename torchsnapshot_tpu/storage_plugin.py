@@ -4,44 +4,84 @@ TPU-native analogue of the reference's ``torchsnapshot/storage_plugin.py``
 (/root/reference/torchsnapshot/storage_plugin.py:20-80): ``fs`` (default when
 the URL has no scheme), ``gs``, ``s3``, ``memory`` (test fake) built in;
 third-party plugins via the ``torchsnapshot_tpu.storage_plugins`` entry-point
-group.
+group.  ``storage_options`` (reference :20-53) travels from the Snapshot
+APIs into plugin constructors, overriding env-var configuration per call —
+multi-bucket / multi-endpoint jobs can't share one process-global env.
 """
 
 from __future__ import annotations
 
 from importlib.metadata import entry_points
+from typing import Any, Dict, Optional, Tuple
 
 from .io_types import StoragePlugin
 
+# Canonical protocol spellings.  The ONLY alias table — consumers that
+# compare protocols (replication.py's same-backend fast path) import this so
+# a new alias cannot make the resolver and a comparison disagree.
+PROTOCOL_ALIASES = {"gs": "gcs", "": "fs"}
 
-def url_to_storage_plugin(url_path: str) -> StoragePlugin:
+
+def parse_url(url_path: str) -> Tuple[str, str]:
+    """(normalized protocol, root path) — the single URL grammar."""
     if "://" in url_path:
         protocol, path = url_path.split("://", 1)
-        if not protocol:
-            protocol = "fs"
     else:
         protocol, path = "fs", url_path
+    return PROTOCOL_ALIASES.get(protocol, protocol), path
+
+
+def url_to_storage_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
+    protocol, path = parse_url(url_path)
 
     if protocol == "fs":
         from .storage_plugins.fs import FSStoragePlugin
 
-        return FSStoragePlugin(root=path)
-    if protocol in ("gs", "gcs"):
+        return FSStoragePlugin(root=path, storage_options=storage_options)
+    if protocol == "gcs":
         from .storage_plugins.gcs import GCSStoragePlugin
 
-        return GCSStoragePlugin(root=path)
+        return GCSStoragePlugin(root=path, storage_options=storage_options)
     if protocol == "s3":
         from .storage_plugins.s3 import S3StoragePlugin
 
-        return S3StoragePlugin(root=path)
+        return S3StoragePlugin(root=path, storage_options=storage_options)
     if protocol == "memory":
         from .storage_plugins.memory import MemoryStoragePlugin
 
+        if storage_options:
+            # Same loud failure as fs: no tunables means any key is a bug.
+            raise ValueError(
+                f"memory accepts no storage_options, got {sorted(storage_options)}"
+            )
         return MemoryStoragePlugin(root=path)
 
     eps = entry_points(group="torchsnapshot_tpu.storage_plugins")
     for ep in eps:
         if ep.name == protocol:
-            return ep.load()(path)
+            cls = ep.load()
+            if storage_options is not None:
+                # Signature check, not try/except TypeError: a TypeError
+                # raised INSIDE an options-aware constructor must surface,
+                # not silently retry with the user's options dropped.
+                import inspect
+
+                try:
+                    params = inspect.signature(cls).parameters
+                    accepts = "storage_options" in params or any(
+                        p.kind is inspect.Parameter.VAR_KEYWORD
+                        for p in params.values()
+                    )
+                except (TypeError, ValueError):
+                    accepts = True  # uninspectable: assume modern plugin
+                if accepts:
+                    return cls(path, storage_options=storage_options)
+                raise ValueError(
+                    f"Storage plugin {ep.name!r} does not accept "
+                    f"storage_options; remove them or upgrade the plugin"
+                )
+            return cls(path)
 
     raise RuntimeError(f"Unsupported protocol: {protocol}")

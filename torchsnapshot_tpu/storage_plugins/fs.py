@@ -29,7 +29,13 @@ _ADAPTIVE_REPROBE_EVERY = 16
 class FSStoragePlugin(StoragePlugin):
     supports_scatter = True  # writes ScatterBuffer parts with no join
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, storage_options=None) -> None:
+        if storage_options:
+            # No fs tunables today; unknown keys must fail loudly rather
+            # than silently change nothing (reference storage_plugin.py:20).
+            raise ValueError(
+                f"fs accepts no storage_options, got {sorted(storage_options)}"
+            )
         self.root = root
         self._dir_cache: Set[str] = set()
         self._executor: Optional[ThreadPoolExecutor] = None

@@ -141,9 +141,21 @@ class _ViewWriter(io.RawIOBase):
 
 
 class GCSStoragePlugin(StoragePlugin):
-    def __init__(self, root: str) -> None:
+    # Per-call configuration via storage_options (reference
+    # storage_plugin.py:20-53); keys override env-var equivalents for this
+    # plugin instance only.
+    _KNOWN_OPTIONS = frozenset({"endpoint"})
+
+    def __init__(self, root: str, storage_options=None) -> None:
         import os
 
+        options = dict(storage_options or {})
+        unknown = set(options) - self._KNOWN_OPTIONS
+        if unknown:
+            raise ValueError(
+                f"Unknown gcs storage_options: {sorted(unknown)} "
+                f"(supported: {sorted(self._KNOWN_OPTIONS)})"
+            )
         # root: "bucket/optional/prefix"
         bucket, _, prefix = root.partition("/")
         self.bucket_name = bucket
@@ -165,7 +177,7 @@ class GCSStoragePlugin(StoragePlugin):
         )
         # Endpoint override (local fake GCS / emulator): anonymous sessions,
         # both the resumable-upload and download bases point at it.
-        endpoint = os.environ.get("TPUSNAP_GCS_ENDPOINT")
+        endpoint = options.get("endpoint", os.environ.get("TPUSNAP_GCS_ENDPOINT"))
         if endpoint:
             endpoint = endpoint.rstrip("/")
             self._upload_base = endpoint

@@ -135,9 +135,25 @@ def _orig(headers: Dict[str, str], lower_name: str) -> str:
 
 
 class S3StoragePlugin(StoragePlugin):
-    def __init__(self, root: str) -> None:
+    # Per-call configuration accepted via storage_options (reference
+    # storage_plugin.py:20-53 threads an options dict to constructors);
+    # each key overrides its env-var equivalent for THIS plugin instance.
+    _KNOWN_OPTIONS = frozenset(
+        {"endpoint", "region", "access_key", "secret_key", "session_token"}
+    )
+
+    def __init__(
+        self, root: str, storage_options: Optional[Dict[str, str]] = None
+    ) -> None:
         import requests
 
+        options = dict(storage_options or {})
+        unknown = set(options) - self._KNOWN_OPTIONS
+        if unknown:
+            raise ValueError(
+                f"Unknown s3 storage_options: {sorted(unknown)} "
+                f"(supported: {sorted(self._KNOWN_OPTIONS)})"
+            )
         self._requests = requests
         bucket, _, prefix = root.partition("/")
         self.bucket = bucket
@@ -158,23 +174,28 @@ class S3StoragePlugin(StoragePlugin):
         self._chunk_executor = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="s3_chunk"
         )
-        region = os.environ.get(
-            "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
+        region = options.get(
+            "region",
+            os.environ.get(
+                "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
+            ),
         )
-        endpoint = os.environ.get("TPUSNAP_S3_ENDPOINT")
+        endpoint = options.get("endpoint", os.environ.get("TPUSNAP_S3_ENDPOINT"))
         if endpoint:
             # Path-style addressing for custom endpoints (fakes, minio).
             self._base = f"{endpoint.rstrip('/')}/{bucket}"
         else:
             self._base = f"https://{bucket}.s3.{region}.amazonaws.com"
-        access_key = os.environ.get("AWS_ACCESS_KEY_ID")
-        secret_key = os.environ.get("AWS_SECRET_ACCESS_KEY")
+        access_key = options.get("access_key", os.environ.get("AWS_ACCESS_KEY_ID"))
+        secret_key = options.get(
+            "secret_key", os.environ.get("AWS_SECRET_ACCESS_KEY")
+        )
         self._signer: Optional[_SigV4] = None
         if access_key and secret_key:
             self._signer = _SigV4(
                 access_key,
                 secret_key,
-                os.environ.get("AWS_SESSION_TOKEN"),
+                options.get("session_token", os.environ.get("AWS_SESSION_TOKEN")),
                 region,
             )
         # One session per executor thread: requests.Session is not
