@@ -49,7 +49,7 @@ def test_bench_py_produces_json_line():
         "restore_phases",
         "async_stall_s",
         "raw_d2h_link_gbps",
-        "save_phase_sum_s",
+        "save_phase_cpu_sum_s",
     ):
         assert key in aux, key
 
