@@ -111,8 +111,11 @@ def prepare_read(
     entry: Entry,
     obj_out: Optional[Any] = None,
     buffer_size_limit_bytes: Optional[int] = None,
+    h2d_batch: Optional[Any] = None,
 ) -> Tuple[List[ReadReq], Future]:
-    """Read dispatch by entry type (reference io_preparer.py:150-182)."""
+    """Read dispatch by entry type (reference io_preparer.py:150-182).
+    ``h2d_batch``: optional cross-array H2D upload batcher (dense-array
+    restores only; the caller flushes it after the read pipeline drains)."""
     if isinstance(entry, PrimitiveEntry):
         return [], Future(obj=entry.get_value())
     if isinstance(entry, ShardedArrayEntry):
@@ -122,7 +125,9 @@ def prepare_read(
             entry, obj_out, buffer_size_limit_bytes
         )
     if isinstance(entry, TensorEntry):
-        return ArrayIOPreparer.prepare_read(entry, obj_out, buffer_size_limit_bytes)
+        return ArrayIOPreparer.prepare_read(
+            entry, obj_out, buffer_size_limit_bytes, h2d_batch=h2d_batch
+        )
     if isinstance(entry, ObjectEntry):
         return ObjectIOPreparer.prepare_read(entry, obj_out)
     raise TypeError(f"Cannot prepare read for entry type: {type(entry)}")
