@@ -249,6 +249,66 @@ def test_h2d_batcher_dtype_cast():
     np.testing.assert_array_equal(np.asarray(f.obj, dtype=np.float32), np.arange(8))
 
 
+def test_h2d_batcher_drain_lands_and_attributes():
+    """drain() leaves nothing in flight and the landing time is attributed
+    to the byte-carrying h2d_land phase (r04 verdict: 159 s of restore wall
+    was invisible to every phase)."""
+    from torchsnapshot_tpu import phase_stats
+    from torchsnapshot_tpu.io_preparers.array import H2DBatcher
+    from torchsnapshot_tpu.io_types import Future
+
+    phase_stats.reset()
+    b = H2DBatcher(flush_bytes=64, inflight_cap_bytes=128)
+    like = jnp.zeros(16, jnp.float32)
+    futs = [Future() for _ in range(4)]
+    for i, f in enumerate(futs):
+        b.submit(np.full(16, float(i), dtype=np.float32), like, f)
+    b.drain()
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(np.asarray(f.obj), np.full(16, float(i)))
+    assert b._inflight_bytes == 0 and not b._inflight
+    stats = phase_stats.snapshot()
+    assert stats.get("h2d_land", {}).get("bytes", 0) > 0
+    assert stats.get("h2d_dispatch", {}).get("bytes", 0) > 0
+
+
+def test_h2d_batcher_paces_inflight_window():
+    """Dispatches past the in-flight-bytes window land earlier batches first
+    — the window is what lets landings overlap the remaining reads instead
+    of piling up behind the caller's final sync."""
+    from torchsnapshot_tpu.io_preparers.array import H2DBatcher
+    from torchsnapshot_tpu.io_types import Future
+
+    b = H2DBatcher(flush_bytes=64, inflight_cap_bytes=64)
+    like = jnp.zeros(16, jnp.float32)  # 64 bytes: every submit flushes
+    futs = [Future() for _ in range(3)]
+    for i, f in enumerate(futs):
+        b.submit(np.full(16, float(i), dtype=np.float32), like, f)
+    assert b._inflight_bytes <= 64
+    b.drain()
+    for i, f in enumerate(futs):
+        np.testing.assert_array_equal(np.asarray(f.obj), np.full(16, float(i)))
+
+
+def test_h2d_batcher_bad_item_fails_alone():
+    """One bad item must not sink the batch: good arrays restore, the bad
+    one's error surfaces with correct attribution (advisor r4 finding)."""
+    from torchsnapshot_tpu.io_preparers.array import H2DBatcher
+    from torchsnapshot_tpu.io_types import Future
+
+    class _Bad:
+        dtype = np.float32  # no .sharding: dispatch raises on this item
+
+    b = H2DBatcher()
+    f_good, f_bad = Future(), Future()
+    b.submit(np.ones(8, dtype=np.float32), jnp.zeros(8, jnp.float32), f_good)
+    b.submit(np.ones(8, dtype=np.float32), _Bad(), f_bad)
+    with pytest.raises(Exception):
+        b.flush()
+    np.testing.assert_array_equal(np.asarray(f_good.obj), np.ones(8))
+    assert f_bad.obj is None
+
+
 def test_h2d_batcher_mixed_targets():
     """Plain-device and sharded targets in one batch both restore."""
     from torchsnapshot_tpu.io_preparers.array import H2DBatcher

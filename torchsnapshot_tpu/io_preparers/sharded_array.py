@@ -338,7 +338,12 @@ class _ShardedRestore:
                                 shard.device, memory_kind=memory_kind
                             )
                         )
-                per_device = staging.device_put_fast_batch(bufs, targets)
+                from .. import phase_stats
+
+                with phase_stats.timed(
+                    "h2d_dispatch", sum(b.nbytes for b in bufs)
+                ):
+                    per_device = staging.device_put_fast_batch(bufs, targets)
                 self.fut.obj = jax.make_array_from_single_device_arrays(
                     tuple(self.entry.shape), obj_out.sharding, per_device
                 )

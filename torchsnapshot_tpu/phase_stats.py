@@ -54,7 +54,14 @@ def add(
         ivs = _intervals.setdefault(phase, [])
         ivs.append((end - seconds, end))
         if len(ivs) >= _COMPACT_THRESHOLD:
-            _intervals[phase] = _merge(ivs)
+            merged = _merge(ivs)
+            if len(merged) >= _COMPACT_THRESHOLD // 2:
+                # Exact merge couldn't shrink (disjoint intervals — e.g.
+                # periodic snapshots in a week-long trainer): coarsen by
+                # closing the smallest gaps so the list — and every
+                # snapshot()'s sort under the global lock — stays bounded.
+                merged = _coarsen(merged, _COMPACT_THRESHOLD // 2)
+            _intervals[phase] = merged
 
 
 @contextmanager
@@ -79,6 +86,28 @@ def _merge(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
     return merged
 
 
+def _coarsen(
+    merged: List[Tuple[float, float]], target: int
+) -> List[Tuple[float, float]]:
+    """Reduce a sorted disjoint interval list to ~``target`` entries by
+    closing the smallest inter-interval gaps first.  Overstates the wall
+    union by at most the sum of the closed gaps — a bounded error, traded
+    for a bounded list."""
+    if len(merged) <= target:
+        return merged
+    gaps = sorted(
+        merged[i + 1][0] - merged[i][1] for i in range(len(merged) - 1)
+    )
+    cutoff = gaps[len(merged) - target - 1]
+    out = [list(merged[0])]
+    for begin, end in merged[1:]:
+        if begin - out[-1][1] <= cutoff:
+            out[-1][1] = max(out[-1][1], end)
+        else:
+            out.append([begin, end])
+    return [(b, e) for b, e in out]
+
+
 def _union_s(intervals: List[Tuple[float, float]]) -> float:
     return sum(end - begin for begin, end in _merge(intervals))
 
@@ -89,6 +118,16 @@ def snapshot() -> Dict[str, Dict[str, float]]:
         for phase, ivs in _intervals.items():
             out[phase]["wall"] = _union_s(ivs)
     return out
+
+
+def attributed_wall_s() -> float:
+    """Union of EVERY phase's active intervals: the share of elapsed time
+    that at least one phase accounts for.  A bench attempt's coverage is
+    this over its wall time — the r4 verdict's blind spot was 159 s of
+    restore wall no phase could see (coverage 0.23)."""
+    with _lock:
+        ivs = [iv for lst in _intervals.values() for iv in lst]
+    return _union_s(ivs)
 
 
 def reset() -> None:

@@ -499,7 +499,12 @@ class Snapshot:
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
         )
-        h2d_batch.flush()
+        # Flush the tail AND wait for every H2D transfer to land: restore's
+        # contract is "state is on device when we return", and the landing
+        # time belongs to restore's own phase record (h2d_land), not to
+        # whatever the caller happens to block on next (r04 verdict: 159 s
+        # of restore wall invisible to every phase).
+        h2d_batch.drain()
 
         resolved = {path: fut.obj for path, fut in futures.items()}
         restored_state_dict = inflate(

@@ -209,38 +209,11 @@ def _use_bitcast_h2d(device: Any, dtype: Any) -> bool:
     return np.dtype(dtype).itemsize < 4
 
 
-def device_put_fast_batch(bufs: List[np.ndarray], targets: List[Any]) -> List[Any]:
-    """Upload many host buffers to their targets (devices or single-device
-    shardings).  Owns the fast-path decision: when the u8-bitcast path
-    applies (plain device targets, sub-word dtype, penalizing transport) the
-    buffers upload individually through it; otherwise everything goes in ONE
-    batched pjrt transfer."""
+def _bitcast_unpack_fn(dtype: np.dtype) -> Any:
+    """Cached jitted u8→dtype unpack kernel (the reverse of begin_d2h's
+    device-side repack)."""
     import jax
 
-    from . import phase_stats
-
-    if not bufs:
-        return []
-    # Recorded as dispatch time with no byte count: device_put enqueues the
-    # transfer and returns, so timing it against the bytes would report
-    # impossible rates.  The actual transfer overlaps downstream work
-    # (wall minus the other phases approximates true H2D).
-    with phase_stats.timed("h2d_dispatch"):
-        first_target = targets[0]
-        plain_device = not hasattr(first_target, "memory_kind")
-        if plain_device and _use_bitcast_h2d(first_target, bufs[0].dtype):
-            return [device_put_fast(b, t) for b, t in zip(bufs, targets)]
-        return jax.device_put(bufs, targets)
-
-
-def device_put_fast(host: np.ndarray, device: Any) -> Any:
-    """H2D upload to one device, taking the u8-bitcast fast path for
-    sub-word dtypes (the reverse of begin_d2h's staging repack)."""
-    import jax
-
-    dtype = host.dtype
-    if host.ndim == 0 or not _use_bitcast_h2d(device, dtype):
-        return jax.device_put(host, device)
     itemsize = dtype.itemsize
     key = (str(dtype), itemsize)
     fn = _H2D_BITCAST_CACHE.get(key)
@@ -256,12 +229,76 @@ def device_put_fast(host: np.ndarray, device: Any) -> Any:
 
         fn = jax.jit(_unpack)
         _H2D_BITCAST_CACHE[key] = fn
+    return fn
+
+
+def device_put_fast_batch(bufs: List[np.ndarray], targets: List[Any]) -> List[Any]:
+    """Upload many host buffers to their targets (devices or single-device
+    shardings).  Owns the fast-path decision per buffer (one batch may mix
+    dtypes): buffers eligible for the u8-bitcast path (plain device targets,
+    sub-word dtype, penalizing transport) upload as u8 views in ONE batched
+    pjrt transfer followed by per-dtype device-side unpacks; everything else
+    goes in one batched ``device_put`` that preserves shardings exactly.
+
+    No phase timing here — callers attribute dispatch (``h2d_dispatch``) and
+    landing (``h2d_land``) themselves, with byte counts (round-4 verdict:
+    zero-byte phase lines made the restore wall unattributable)."""
+    import jax
+
+    if not bufs:
+        return []
+    fast_idx: List[int] = []
+    fast_bufs: List[np.ndarray] = []
+    fast_targets: List[Any] = []
+    plain_idx: List[int] = []
+    plain_bufs: List[np.ndarray] = []
+    plain_targets: List[Any] = []
+    for i, (b, t) in enumerate(zip(bufs, targets)):
+        if (
+            not hasattr(t, "memory_kind")  # bare device, not a sharding
+            and b.ndim > 0
+            and _use_bitcast_h2d(t, b.dtype)
+        ):
+            fast_idx.append(i)
+            fast_bufs.append(b)
+            fast_targets.append(t)
+        else:
+            plain_idx.append(i)
+            plain_bufs.append(b)
+            plain_targets.append(t)
+    outs: List[Any] = [None] * len(bufs)
+    if fast_bufs:
+        u8s = []
+        for b in fast_bufs:
+            if not b.flags.c_contiguous:
+                b = np.ascontiguousarray(b)
+            u8s.append(b.view(np.uint8).reshape(-1))
+        dev_u8s = jax.device_put(u8s, fast_targets)
+        for i, b, t, du8 in zip(fast_idx, fast_bufs, fast_targets, dev_u8s):
+            try:
+                outs[i] = _bitcast_unpack_fn(b.dtype)(du8).reshape(b.shape)
+            except Exception:
+                outs[i] = jax.device_put(b, t)
+    if plain_bufs:
+        for i, out in zip(plain_idx, jax.device_put(plain_bufs, plain_targets)):
+            outs[i] = out
+    return outs
+
+
+def device_put_fast(host: np.ndarray, device: Any) -> Any:
+    """H2D upload to one device, taking the u8-bitcast fast path for
+    sub-word dtypes (the reverse of begin_d2h's staging repack)."""
+    import jax
+
+    dtype = host.dtype
+    if host.ndim == 0 or not _use_bitcast_h2d(device, dtype):
+        return jax.device_put(host, device)
     if not host.flags.c_contiguous:
         host = np.ascontiguousarray(host)
     u8 = host.view(np.uint8).reshape(-1)
     dev_u8 = jax.device_put(u8, device)
     try:
-        return fn(dev_u8).reshape(host.shape)
+        return _bitcast_unpack_fn(dtype)(dev_u8).reshape(host.shape)
     except Exception:
         return jax.device_put(host, device)
 
