@@ -185,7 +185,12 @@ def _drift_dominant_phase(attempt_phases: list, attempts_s: list):
     }
     if not deltas:
         return None
+    drift_s = max(attempts_s) - min(attempts_s)
     ph = max(deltas, key=deltas.get)
+    if deltas[ph] <= max(0.1, 0.25 * drift_s):
+        # No phase explains the drift — naming one would be actively
+        # misleading; the gap lives in unattributed wall (see coverage).
+        return {"phase": "unattributed", "delta_s": round(drift_s, 2)}
     return {"phase": ph, "delta_s": round(deltas[ph], 2)}
 
 
@@ -351,8 +356,10 @@ def main() -> None:
     # DMA-friendly shape).  2 GiB so a >1 GB/s pipeline measures
     # multi-second phases, not noise.  The SCHEDULE is budgeted against the
     # measured link (round-3 verdict: sizing only the state while keeping 9
-    # fixed passes blew the watchdog): attempts shed first (best-of-1 on a
-    # slow transport), state size sheds last.  Override with
+    # fixed passes blew the watchdog): state size sheds first (to a 256 MB
+    # floor — still link-dominated on a slow transport), attempts shed
+    # last and only below 2 as a last resort (round-4 verdict: best-of-1
+    # numbers made drift ratios vacuous).  Override with
     # BENCH_TARGET_BYTES / BENCH_SAVE_ATTEMPTS either way.
     if _BACKEND["name"] == "cpu_fallback":
         default_bytes = 512 << 20
@@ -638,6 +645,25 @@ def main() -> None:
             "async_stall_target_met": stall_s <= max(2.0, 0.1 * save_s),
             "async_d2h_wall_s": round(async_d2h_s, 2),
             "async_phases": _phases_brief(async_phases),
+            # The r4 open question: storage writes sharing the process with
+            # the D2H drain ran 48% slower than sync writes (wall AND
+            # thread-seconds up — CPU/memory-bandwidth contention between
+            # the drain's host materialization and write syscalls on a
+            # small host, not queueing).  Tracked here; it is only a
+            # problem if async_total also exceeds the d2h wall materially,
+            # since the pipeline is D2H-bound and the write stretch hides
+            # under the drain.
+            "async_fs_write_stretch": round(
+                async_phases["fs_write"].get(
+                    "wall", async_phases["fs_write"]["s"]
+                )
+                / save_phases["fs_write"].get(
+                    "wall", save_phases["fs_write"]["s"]
+                ),
+                2,
+            )
+            if "fs_write" in async_phases and "fs_write" in save_phases
+            else None,
             "restore_s": round(restore_s, 2),
             "restore_worst_s": round(max(restore_attempts_s), 2),
             "restore_drift_ratio": round(

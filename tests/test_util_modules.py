@@ -5,6 +5,7 @@ profiler, loop helpers)."""
 import time
 
 import numpy as np
+import pytest
 
 
 def test_manifest_predicates():
@@ -70,6 +71,25 @@ def test_memoryview_stream_read_seek():
     assert stream.read() == data[-5:]
     assert stream.readable() and stream.seekable()
     assert stream.tell() == 100
+
+
+def test_phase_stats_compaction_keeps_wall_exact():
+    """Evenly spaced disjoint intervals (a periodic-snapshot trainer) must
+    stay bounded in memory WITHOUT inflating the wall union: retired
+    intervals move into a per-phase base, never into closed gaps."""
+    from torchsnapshot_tpu import phase_stats
+
+    phase_stats.reset()
+    # 1s of work every 601s, 600 occurrences — far past the compaction
+    # threshold, zero overlaps for the exact merge to collapse.
+    for i in range(600):
+        phase_stats.add("periodic", 1.0, 10, end=i * 601.0 + 1.0)
+    with phase_stats._lock:
+        live = len(phase_stats._intervals["periodic"])
+    assert live < 600  # compaction actually ran
+    wall = phase_stats.snapshot()["periodic"]["wall"]
+    assert wall == pytest.approx(600.0)  # exact: no gap ever closed
+    phase_stats.reset()
 
 
 def test_phase_stats_accumulate_delta_format():

@@ -262,21 +262,24 @@ class H2DBatcher:
     accumulate up to ``flush_bytes`` (bounding the extra host-memory
     residency beyond the scheduler's budget), then flush incrementally.
 
-    Dispatched batches stay **in flight** until their transfers land on
-    device; a bounded in-flight-bytes window (default 2× ``flush_bytes``)
-    paces dispatches so batch N's landing overlaps the reads feeding batch
-    N+1 instead of every transfer piling up behind the caller's final
+    Dispatched batches land EAGERLY on a dedicated lander thread; a bounded
+    unlanded-bytes window (default 2× ``flush_bytes``) backpressures new
+    dispatches so batch N's landing overlaps the reads feeding batch N+1
+    instead of every transfer piling up behind the caller's final
     ``block_until_ready`` (r04 bench: 159 s of unattributed restore wall —
     the reference's read scheduler overlaps read and consume end-to-end,
     /root/reference/torchsnapshot/scheduler.py:386-447).  Landings are
     attributed to the byte-carrying ``h2d_land`` phase; dispatch CPU time to
     ``h2d_dispatch``.  The owner calls :meth:`drain` after the read pipeline
-    finishes: on return every submitted array is ON DEVICE, not in flight.
+    finishes: on return every submitted array is ON DEVICE, not in flight,
+    and the lander thread has exited.
 
-    Thread-safety: ``submit``/``flush`` run on the read pipeline's loop or
-    executor threads, ``drain`` on the caller thread — one lock guards the
-    pending list and the in-flight queue; landings block outside the lock
-    (concurrent landers each pop their own batch).
+    Thread-safety: ``submit``/``flush`` may run on the read pipeline's loop
+    or executor threads, ``drain`` on the caller thread.  Because landings
+    run on the lander (never on the flushing thread), a backpressure wait
+    in ``flush`` lasts only until the lander frees window room — and the
+    window bounds unlanded host-buffer residency, which the scheduler's
+    read budget stops tracking the moment a consume completes.
     """
 
     _DEFAULT_FLUSH_BYTES = 256 << 20
@@ -295,8 +298,12 @@ class H2DBatcher:
             inflight_cap_bytes if inflight_cap_bytes is not None else 2 * flush_bytes
         )
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
         self._inflight: "deque[Tuple[List[Any], int]]" = deque()
-        self._inflight_bytes = 0
+        self._unlanded_bytes = 0  # dispatched, not yet landed
+        self._lander: Optional[Any] = None
+        self._lander_stop = False
+        self._lander_error: Optional[BaseException] = None
 
     def submit(self, host: np.ndarray, like: Any, fut: Future) -> None:
         with self._lock:
@@ -312,54 +319,137 @@ class H2DBatcher:
         if not items:
             return
         batch_bytes = sum(host.nbytes for host, _, _ in items)
-        # Pace: land the oldest in-flight batches until this one fits the
-        # window.  Blocking HERE (a consumer/executor thread) leaves the
-        # read pipeline's loop free, so storage reads proceed underneath
-        # the landing.
-        self._land_until(self._inflight_cap - batch_bytes)
+        # Backpressure: wait for the lander to free window room, and RESERVE
+        # this batch's bytes in the same critical section — otherwise N
+        # concurrent flushers all pass the check against the
+        # still-unincremented counter and overshoot the window by N batches.
+        # The wait lasts only for the EXCESS over the window (landing of
+        # older batches started the moment they were dispatched), and a full
+        # window stalling the producer is the point — reads must not run
+        # unboundedly ahead of a slow H2D link.
+        with self._cond:
+            self._raise_lander_error()
+            while (
+                self._unlanded_bytes > 0
+                and self._unlanded_bytes + batch_bytes > self._inflight_cap
+            ):
+                self._cond.wait(timeout=1.0)
+                self._raise_lander_error()
+            self._unlanded_bytes += batch_bytes  # reserved
         try:
-            outs = self._dispatch(items, batch_bytes)
-        except Exception:
-            # One bad item (dtype/sharding mismatch) must not sink the whole
-            # batch with misattributed blame: retry per item so the good
-            # arrays restore and the bad one fails alone.
-            self._dispatch_per_item(items)
-            return
+            outs, failed = self._dispatch(items, batch_bytes)
+        except BaseException:
+            with self._cond:
+                self._unlanded_bytes -= batch_bytes
+                self._cond.notify_all()
+            raise
+        landed_bytes = sum(
+            host.nbytes for (host, _, _), out in zip(items, outs) if out is not None
+        )
+        good = [out for out in outs if out is not None]
         for out, (_, _, fut) in zip(outs, items):
-            fut.obj = out
-        with self._lock:
-            self._inflight.append((outs, batch_bytes))
-            self._inflight_bytes += batch_bytes
+            if out is not None:
+                fut.obj = out
+        with self._cond:
+            # Release the reservation for items whose group failed (they
+            # land synchronously in the per-item retry below, outside the
+            # window).
+            self._unlanded_bytes -= batch_bytes - landed_bytes
+            if good:
+                self._inflight.append((good, landed_bytes))
+                self._ensure_lander()
+            self._cond.notify_all()
+        if failed:
+            # A failed GROUP retries per item so one bad array (dtype/
+            # sharding mismatch) fails alone with correct blame and its
+            # group-mates still restore; successfully dispatched groups are
+            # never re-uploaded.
+            self._dispatch_per_item(failed)
 
     def drain(self) -> None:
         """Flush the tail and block until every dispatched transfer LANDS
         (attributed to ``h2d_land``).  After this, restored arrays are
         device-resident — the caller's own block_until_ready sees ~0 s."""
         self.flush()
-        self._land_until(0)
+        with self._cond:
+            self._raise_lander_error()
+            while self._unlanded_bytes > 0 or self._inflight:
+                self._cond.wait(timeout=1.0)
+                self._raise_lander_error()
+        self.shutdown()
+        self._raise_lander_error()
 
-    def _land_until(self, cap_bytes: int) -> None:
+    def shutdown(self) -> None:
+        """Stop and join the lander thread (idempotent; never raises the
+        landing error — callers check via drain).  Owners call this from a
+        ``finally`` so an aborted read pipeline doesn't leak a parked
+        thread per restore in a long-lived trainer."""
+        with self._cond:
+            self._lander_stop = True
+            self._cond.notify_all()
+            lander = self._lander
+            self._lander = None
+        if lander is not None:
+            lander.join()
+        self._lander_stop = False  # reusable after drain/shutdown
+
+    def _raise_lander_error(self) -> None:
+        # Sticky: a batcher with a failed landing keeps raising (it is
+        # per-restore and discarded after; clearing would let a drain
+        # following a flush-consumed error report clean).
+        if self._lander_error is not None:
+            raise self._lander_error
+
+    def _ensure_lander(self) -> None:
+        # Called under the lock.
+        if self._lander is None:
+            import threading
+
+            self._lander = threading.Thread(
+                target=self._land_loop, name="tpusnap-h2d-lander", daemon=True
+            )
+            self._lander.start()
+
+    def _land_loop(self) -> None:
         import jax
 
         from .. import phase_stats
 
         while True:
-            with self._lock:
-                if self._inflight_bytes <= max(cap_bytes, 0) or not self._inflight:
+            with self._cond:
+                while not self._inflight and not self._lander_stop:
+                    self._cond.wait()
+                if not self._inflight:  # stop requested and queue empty
                     return
                 outs, nbytes = self._inflight.popleft()
-                self._inflight_bytes -= nbytes
-            with phase_stats.timed("h2d_land", nbytes):
-                jax.block_until_ready(outs)
+            # A landing failure must not wedge the batcher: record the first
+            # error, keep the byte accounting exact, and KEEP LANDING the
+            # remaining batches so backpressure waiters and drain() always
+            # make progress (the error surfaces at the next flush/drain).
+            err: Optional[BaseException] = None
+            try:
+                with phase_stats.timed("h2d_land", nbytes):
+                    jax.block_until_ready(outs)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+            with self._cond:
+                self._unlanded_bytes -= nbytes
+                if err is not None and self._lander_error is None:
+                    self._lander_error = err
+                self._cond.notify_all()
 
     def _dispatch(
         self, items: List[Tuple[np.ndarray, Any, Future]], batch_bytes: int
-    ) -> List[Any]:
-        # Same target policy as _device_put_like, batched: plain
-        # single-device HBM targets go through device_put_fast_batch (which
-        # owns the u8-bitcast-for-sub-word-dtypes decision); anything with a
-        # sharding or a non-default memory kind goes in one batched
-        # device_put that preserves it exactly.
+    ) -> Tuple[List[Any], List[Tuple[np.ndarray, Any, Future]]]:
+        """Dispatch the batch grouped by target kind; returns (outs, failed)
+        where ``outs[i]`` is None for items whose GROUP failed and ``failed``
+        lists exactly those items for the caller's per-item retry.
+
+        Same target policy as _device_put_like, batched: plain single-device
+        HBM targets go through device_put_fast_batch (which owns the
+        u8-bitcast-for-sub-word-dtypes decision); anything with a sharding
+        or a non-default memory kind goes in one batched device_put that
+        preserves it exactly."""
         from .. import phase_stats
 
         plain_idx: List[int] = []
@@ -368,12 +458,21 @@ class H2DBatcher:
         other_idx: List[int] = []
         other_bufs: List[np.ndarray] = []
         other_shardings: List[Any] = []
+        classify_failed: List[int] = []
         for i, (host, like, _) in enumerate(items):
-            if host.dtype != np.dtype(like.dtype):
-                host = host.astype(np.dtype(like.dtype))
+            # Classification must never sink the batch: an item whose dtype
+            # cast raises goes straight to the per-item retry (correct
+            # blame), the rest dispatch normally.
             try:
-                devices = like.sharding.device_set
-                memory_kind = getattr(like.sharding, "memory_kind", None)
+                if host.dtype != np.dtype(like.dtype):
+                    host = host.astype(np.dtype(like.dtype))
+            except Exception:
+                classify_failed.append(i)
+                continue
+            sharding = getattr(like, "sharding", None)
+            try:
+                devices = sharding.device_set
+                memory_kind = getattr(sharding, "memory_kind", None)
                 if len(devices) == 1 and memory_kind in (None, "device"):
                     plain_idx.append(i)
                     plain_bufs.append(host)
@@ -383,33 +482,69 @@ class H2DBatcher:
                 pass
             other_idx.append(i)
             other_bufs.append(host)
-            other_shardings.append(like.sharding)
+            other_shardings.append(sharding)
         outs: List[Any] = [None] * len(items)
-        with phase_stats.timed("h2d_dispatch", batch_bytes):
-            if plain_bufs:
+        failed: List[Tuple[np.ndarray, Any, Future]] = [
+            items[i] for i in classify_failed
+        ]
+        # Manual phase accounting, recorded only for DISPATCHED bytes:
+        # timed() commits in its finally, so a failed group would charge its
+        # bytes to h2d_dispatch and the per-item retry would charge again.
+        import time as _time
+
+        begin = _time.monotonic()
+        dispatched_bytes = 0
+        if plain_bufs:
+            try:
                 for i, out in zip(
-                    plain_idx, staging.device_put_fast_batch(plain_bufs, plain_devs)
+                    plain_idx,
+                    staging.device_put_fast_batch(plain_bufs, plain_devs),
                 ):
                     outs[i] = out
-            if other_bufs:
-                import jax
+                dispatched_bytes += sum(b.nbytes for b in plain_bufs)
+            except Exception:
+                failed.extend(items[i] for i in plain_idx)
+        if other_bufs:
+            import jax
 
+            try:
                 for i, out in zip(
                     other_idx, jax.device_put(other_bufs, other_shardings)
                 ):
                     outs[i] = out
-        return outs
+                dispatched_bytes += sum(b.nbytes for b in other_bufs)
+            except Exception:
+                failed.extend(items[i] for i in other_idx)
+        if dispatched_bytes:
+            phase_stats.add(
+                "h2d_dispatch", _time.monotonic() - begin, dispatched_bytes
+            )
+        return outs, failed
 
     def _dispatch_per_item(
         self, items: List[Tuple[np.ndarray, Any, Future]]
     ) -> None:
+        import jax
+
+        from .. import phase_stats
+
         first_exc: Optional[BaseException] = None
+        outs: List[Any] = []
+        nbytes = 0
         for host, like, fut in items:
             try:
                 fut.obj = _device_put_like(host, like)
+                outs.append(fut.obj)
+                nbytes += host.nbytes
             except Exception as e:
                 if first_exc is None:
                     first_exc = e
+        # These transfers bypass the in-flight window (error path): land them
+        # here so drain()'s "on device on return" contract still holds and
+        # the landing wall stays attributed.
+        if outs:
+            with phase_stats.timed("h2d_land", nbytes):
+                jax.block_until_ready(outs)
         if first_exc is not None:
             raise first_exc
 

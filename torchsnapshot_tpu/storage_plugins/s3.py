@@ -461,7 +461,12 @@ class S3StoragePlugin(StoragePlugin):
                             f"(status {resp.status_code})"
                         )
                     filled = 0
-                    for piece in resp.iter_content(chunk_size=1 << 20):
+                    # 8 MB pieces: each iter_content piece is a GIL bounce
+                    # plus a memcpy into the view; 1 MB pieces measurably
+                    # bottlenecked the restore path at ~1/16 of the
+                    # transport's line rate (benchmarks/cloud).  Cancel
+                    # latency stays bounded at one piece.
+                    for piece in resp.iter_content(chunk_size=8 << 20):
                         if cancel is not None and cancel.is_set():
                             # Mirror the GCS between-chunk check: a
                             # sibling's hard failure must not wait out
