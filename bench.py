@@ -684,6 +684,18 @@ def main() -> None:
             "pipeline_efficiency_vs_disk": round(save_gbps / disk_gbps, 3)
             if disk_gbps
             else None,
+            # Which hardware ceiling the save is actually limited by: on a
+            # tunneled link the D2H rate binds and efficiency_vs_disk is
+            # noise; on a real TPU host (PCIe D2H) disk binds and THAT
+            # number is the north star (r4 verdict: the record could not
+            # distinguish the two regimes).
+            "binding_constraint": (
+                None
+                if not disk_gbps
+                else "d2h_link"
+                if link_ceiling_gbps < disk_gbps
+                else "disk"
+            ),
             "device": str(devices[0]),
             "fallback_reason": _BACKEND["fallback_reason"],
             "save_phases": _phases_brief(save_phases),

@@ -39,6 +39,11 @@ def main() -> int:
     args = parser.parse_args()
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # A site hook may pre-import jax with the TPU platform; the env var
+        # alone is ignored after that — force it.
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 

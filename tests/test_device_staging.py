@@ -78,6 +78,27 @@ def test_resolve_mode_collective_agreement():
     assert mode == "host"
 
 
+def test_resolve_mode_empty_rank_is_wildcard():
+    """A rank holding no device arrays (eval/coordinator) joins no
+    collective staging program; its vote must not drag device-holding peers
+    into blocking host staging."""
+    device_staging.reset_pinned_host_health()
+
+    class FakePG:
+        def get_world_size(self):
+            return 2
+
+        def all_gather_object(self, obj):
+            return [
+                obj,
+                {"mode": "host", "device_fits": True, "any_ok": True},
+            ]
+
+    with knobs.override_async_staging("auto"):
+        mode = device_staging.resolve_mode({"m/w": jnp.ones(4)}, pg=FakePG())
+    assert mode in ("pinned_host", "device")
+
+
 def test_resolve_mode_agreement_respects_device_capability(monkeypatch):
     """A rank that prefers pinned_host (and so never needed HBM headroom)
     must not be agreement-downgraded into a device copy it cannot hold:
@@ -98,6 +119,36 @@ def test_resolve_mode_agreement_respects_device_capability(monkeypatch):
     with knobs.override_async_staging("auto"):
         mode = device_staging.resolve_mode({"m/w": jnp.ones(4)}, pg=FakePG())
     assert mode == "host"
+
+
+def test_agreement_downgrade_emits_event():
+    """A cross-rank agreement forcing a rank off its preferred mode is a
+    stall regression; it must land in the event stream like every other
+    downgrade, not only in per-rank logs."""
+    from torchsnapshot_tpu import event_handlers
+
+    events = []
+    handler = events.append
+    event_handlers.register_event_handler(handler)
+    try:
+        device_staging.reset_pinned_host_health()
+
+        class FakePG:
+            def get_world_size(self):
+                return 2
+
+            def all_gather_object(self, obj):
+                return [obj, {"mode": "host", "device_fits": True}]
+
+        with knobs.override_async_staging("auto"):
+            mode = device_staging.resolve_mode({"m/w": jnp.ones(4)}, pg=FakePG())
+        assert mode == "host"
+        downgrades = [
+            e for e in events if e.name == "async_take.staging_downgrade"
+        ]
+        assert downgrades and "agreement" in downgrades[-1].metadata["reason"]
+    finally:
+        event_handlers.unregister_event_handler(handler)
 
 
 def test_pinned_host_health_retry_cycle(monkeypatch):

@@ -142,8 +142,11 @@ def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
         return {"mode": "host", "device_fits": True}
     arrays = _device_resident_arrays(flattened)
     if not arrays:
-        # Nothing needs a D2H DMA; host staging is already instant.
-        return {"mode": "host", "device_fits": True}
+        # Nothing needs a D2H DMA; host staging is already instant for THIS
+        # rank — but it joins no collective staging program, so it must not
+        # drag peers off their preferred mode: any_ok marks the vote as
+        # compatible-with-anything in the cross-rank agreement.
+        return {"mode": "host", "device_fits": True, "any_ok": True}
     probe = next(iter(arrays.values()))
     pinned_ok = _supports_pinned_host(probe) and _pinned_host_usable(
         _platform_of(probe)
@@ -174,10 +177,6 @@ def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
     return {"mode": "pinned_host", "device_fits": device_fits}
 
 
-def _resolve_mode_local(flattened: Dict[str, Any]) -> str:
-    return _local_staging_signals(flattened)["mode"]
-
-
 def resolve_mode(flattened: Dict[str, Any], pg: Any = None) -> str:
     """Resolve the configured mode against this app state and backend.
     Returns the placement that will actually be used.
@@ -202,10 +201,16 @@ def resolve_mode(flattened: Dict[str, Any], pg: Any = None) -> str:
     mode = signals["mode"]
     if pg is not None and pg.get_world_size() > 1:
         gathered = pg.all_gather_object(signals)
-        modes = [s["mode"] for s in gathered]
+        # Ranks with nothing to stage vote "compatible with anything" —
+        # they join no collective staging program, so they must not force
+        # the fleet into blocking host staging.
+        votes = [s for s in gathered if not s.get("any_ok")]
+        if not votes:
+            return mode  # nobody stages device state anywhere
+        modes = [s["mode"] for s in votes]
         agreed = min(modes, key=lambda m: _MODE_RANK.get(m, 0))
         if agreed == "device" and not all(
-            s.get("device_fits", True) for s in gathered
+            s.get("device_fits", True) for s in votes
         ):
             # A peer forced the fleet off pinned_host, but some rank
             # (possibly one that preferred pinned_host and so never needed

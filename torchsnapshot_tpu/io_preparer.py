@@ -114,15 +114,18 @@ def prepare_read(
     h2d_batch: Optional[Any] = None,
 ) -> Tuple[List[ReadReq], Future]:
     """Read dispatch by entry type (reference io_preparer.py:150-182).
-    ``h2d_batch``: optional cross-array H2D upload batcher (dense-array
-    restores only; the caller flushes it after the read pipeline drains)."""
+    ``h2d_batch``: optional cross-array H2D upload batcher (dense and
+    chunked arrays; the caller drains it after the read pipeline finishes).
+    Sharded arrays keep their own per-device dispatch: their uploads are
+    byte-attributed at dispatch and deliberately left in flight so a
+    multichip restore can overlap the next stateful's reads."""
     if isinstance(entry, PrimitiveEntry):
         return [], Future(obj=entry.get_value())
     if isinstance(entry, ShardedArrayEntry):
         return ShardedArrayIOPreparer.prepare_read(entry, obj_out)
     if isinstance(entry, ChunkedTensorEntry):
         return ChunkedArrayIOPreparer.prepare_read(
-            entry, obj_out, buffer_size_limit_bytes
+            entry, obj_out, buffer_size_limit_bytes, h2d_batch=h2d_batch
         )
     if isinstance(entry, TensorEntry):
         return ArrayIOPreparer.prepare_read(

@@ -134,10 +134,15 @@ class ChunkedArrayIOPreparer:
         entry: ChunkedTensorEntry,
         obj_out: Optional[Any] = None,
         buffer_size_limit_bytes: Optional[int] = None,
+        h2d_batch: Optional[Any] = None,
     ) -> Tuple[List[ReadReq], Future]:
         """Assemble all chunks into one host buffer / in-place target, then
         finalize (device_put for jax targets) once — mirrors reference
-        chunked_tensor.py:111-128 with the jax H2D finalize added."""
+        chunked_tensor.py:111-128 with the jax H2D finalize added.
+        ``h2d_batch``: the upload joins the cross-array batcher so its
+        landing is paced and attributed like dense arrays' (without it, a
+        chunked array's H2D landed outside every phase — the r4 blind spot,
+        reintroduced via this path)."""
         pseudo_entry = TensorEntry(
             location="<chunked>",
             serializer=serialization.Serializer.BUFFER_PROTOCOL.value,
@@ -145,7 +150,9 @@ class ChunkedArrayIOPreparer:
             shape=entry.shape,
             replicated=entry.replicated,
         )
-        assembly = ArrayAssembly(entry=pseudo_entry, obj_out=obj_out)
+        assembly = ArrayAssembly(
+            entry=pseudo_entry, obj_out=obj_out, h2d_batch=h2d_batch
+        )
         itemsize = serialization.per_element_nbytes(entry.dtype)
         row_elems = int(np.prod(entry.shape[1:])) if len(entry.shape) > 1 else 1
         read_reqs: List[ReadReq] = []
