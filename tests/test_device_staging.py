@@ -151,6 +151,25 @@ def test_agreement_downgrade_emits_event():
         event_handlers.unregister_event_handler(handler)
 
 
+def test_resolve_mode_mixed_platform_probe(monkeypatch):
+    """A mixed-platform state must consult pinned_host support/health for
+    EVERY platform present, not whichever array iterates first."""
+    a, b = jnp.ones(4), jnp.ones(8)
+    plat = {id(a): "cpu", id(b): "exotic"}
+    monkeypatch.setattr(
+        device_staging, "_platform_of", lambda arr: plat.get(id(arr), "cpu")
+    )
+    device_staging.reset_pinned_host_health()
+    device_staging.record_pinned_host_failure("exotic")
+    with knobs.override_async_staging("auto"):
+        mode = device_staging.resolve_mode({"m/a": a, "m/b": b})
+    assert mode != "pinned_host"  # the unhealthy second platform vetoes
+    device_staging.reset_pinned_host_health()
+    with knobs.override_async_staging("auto"):
+        mode = device_staging.resolve_mode({"m/a": a, "m/b": b})
+    assert mode in ("pinned_host", "device")  # healthy again after reset
+
+
 def test_pinned_host_health_retry_cycle(monkeypatch):
     """A pinned_host failure skips the mode for a backoff window then
     retries — never a permanent downgrade (r4 verdict: old flag was sticky

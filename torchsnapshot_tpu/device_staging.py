@@ -147,9 +147,15 @@ def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
         # drag peers off their preferred mode: any_ok marks the vote as
         # compatible-with-anything in the cross-rank agreement.
         return {"mode": "host", "device_fits": True, "any_ok": True}
-    probe = next(iter(arrays.values()))
-    pinned_ok = _supports_pinned_host(probe) and _pinned_host_usable(
-        _platform_of(probe)
+    # Probe one representative per distinct platform: a mixed state (TPU
+    # params + CPU-backend singletons) must not decide pinned_host support
+    # from whichever array iterates first (r4 verdict, weak #5).
+    probes: Dict[str, Any] = {}
+    for arr in arrays.values():
+        probes.setdefault(_platform_of(arr), arr)
+    pinned_ok = all(
+        _supports_pinned_host(arr) and _pinned_host_usable(platform)
+        for platform, arr in probes.items()
     )
     device_fits = _hbm_headroom_fits(arrays)
     if mode == "pinned_host" and not pinned_ok:
@@ -355,14 +361,23 @@ def stage_app_state(
             # the same donation contract; record the failure so the next
             # resolve_mode agreement skips the doomed attempt (with a
             # periodic retry — see _pinned_host_usable).
-            platform = _platform_of(arrays[paths[0]]) if paths else "unknown"
-            record_pinned_host_failure(platform)
-            failures = int(
-                _PINNED_HOST_HEALTH.get(platform, {}).get("failures", 1)
+            # The batched device_put spans every platform in the state and
+            # the exception doesn't say which one broke: quarantine them
+            # all (attributing to the first-iterated array would misdirect
+            # the per-platform health the resolve probe consults).
+            platforms = sorted(
+                {_platform_of(a) for a in arrays.values()}
+            ) or ["unknown"]
+            for platform in platforms:
+                record_pinned_host_failure(platform)
+            failures = max(
+                int(_PINNED_HOST_HEALTH.get(p, {}).get("failures", 1))
+                for p in platforms
             )
             downgraded_from = "pinned_host"
             downgrade_reason = (
-                f"{type(e).__name__}: {e} (failure #{failures} on {platform})"
+                f"{type(e).__name__}: {e} "
+                f"(failure #{failures} on {'/'.join(platforms)})"
             )
             # The device-copy fallback is safe only when (a) this process
             # alone can execute it — multi-process sharded arrays need every
