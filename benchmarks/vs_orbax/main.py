@@ -59,19 +59,54 @@ def main() -> None:
     print(f"pytree: {args.n_arrays} sharded arrays, {gb:.2f} GB")
     shutil.rmtree(args.work_dir, ignore_errors=True)
 
+    def _settle():
+        # Page-cache writeback swings this box's I/O 10x run to run; start
+        # every timed measurement with the dirty set drained (same
+        # discipline as bench.py).
+        try:
+            os.sync()
+        except OSError:
+            pass
+
+    def _best_of(fn, n=2):
+        times = []
+        for _ in range(n):
+            _settle()
+            t0 = time.monotonic()
+            fn()
+            times.append(time.monotonic() - t0)
+        return min(times)
+
     # --- torchsnapshot_tpu ---
-    t = time.monotonic()
-    snap = Snapshot.take(os.path.join(args.work_dir, "tpusnap"), {"m": StateDict(tree)})
-    ours_save = time.monotonic() - t
+    snaps = {}
+
+    def _save(attempt=[0]):
+        attempt[0] += 1
+        path = os.path.join(args.work_dir, f"tpusnap{attempt[0]}")
+        shutil.rmtree(path, ignore_errors=True)
+        snaps["snap"] = Snapshot.take(path, {"m": StateDict(tree)})
+
+    ours_save = _best_of(_save)
+    snap = snaps["snap"]
     dst = {"m": StateDict({k: jnp.zeros_like(v) for k, v in tree.items()})}
-    t = time.monotonic()
-    snap.restore(dst)
-    jax.block_until_ready(dst["m"].data)
-    ours_load = time.monotonic() - t
+
+    def _load():
+        snap.restore(dst)
+        jax.block_until_ready(dst["m"].data)
+
+    ours_load = _best_of(_load)
     ok = np.array_equal(np.asarray(dst["m"]["w0"]), np.asarray(tree["w0"]))
+    # Apples-to-apples load: our default restore VERIFIES every payload's
+    # xxh64 against the manifest; orbax's does not verify payload bytes.
+    os.environ["TPUSNAP_CHECKSUM"] = "0"
+    ours_load_noverify = _best_of(_load)
+    os.environ.pop("TPUSNAP_CHECKSUM", None)
     print(
         f"torchsnapshot_tpu: save {ours_save:.2f}s ({gb / ours_save:.2f} GB/s), "
-        f"load {ours_load:.2f}s ({gb / ours_load:.2f} GB/s), verified={ok}"
+        f"load {ours_load:.2f}s ({gb / ours_load:.2f} GB/s) "
+        f"[verifies checksums; verified={ok}], "
+        f"load w/o verify {ours_load_noverify:.2f}s "
+        f"({gb / ours_load_noverify:.2f} GB/s) [best of 2 each, saves too]"
     )
 
     # --- orbax ---
@@ -79,27 +114,38 @@ def main() -> None:
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        orbax_dir = os.path.join(args.work_dir, "orbax")
-        t = time.monotonic()
-        ckptr.save(orbax_dir, tree)
-        orbax_save = time.monotonic() - t
+        orbax_dirs = {}
+
+        def _orbax_save(attempt=[0]):
+            attempt[0] += 1
+            path = os.path.join(args.work_dir, f"orbax{attempt[0]}")
+            shutil.rmtree(path, ignore_errors=True)
+            ckptr.save(path, tree)
+            orbax_dirs["dir"] = path
+
+        orbax_save = _best_of(_orbax_save)
+        orbax_dir = orbax_dirs["dir"]
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             tree,
         )
-        t = time.monotonic()
-        restored = ckptr.restore(orbax_dir, args=ocp.args.PyTreeRestore(
-            restore_args=ocp.checkpoint_utils.construct_restore_args(abstract)
-        ))
-        jax.block_until_ready(restored)
-        orbax_load = time.monotonic() - t
+
+        def _orbax_load():
+            restored = ckptr.restore(orbax_dir, args=ocp.args.PyTreeRestore(
+                restore_args=ocp.checkpoint_utils.construct_restore_args(abstract)
+            ))
+            jax.block_until_ready(restored)
+
+        orbax_load = _best_of(_orbax_load)
         print(
             f"orbax:             save {orbax_save:.2f}s ({gb / orbax_save:.2f} GB/s), "
             f"load {orbax_load:.2f}s ({gb / orbax_load:.2f} GB/s)"
         )
         print(
             f"speedup: save {orbax_save / ours_save:.2f}x, "
-            f"load {orbax_load / ours_load:.2f}x"
+            f"load {orbax_load / ours_load:.2f}x (with payload verification "
+            f"orbax does not do), {orbax_load / ours_load_noverify:.2f}x "
+            f"(equal work)"
         )
     except Exception as e:  # noqa: BLE001
         print(f"orbax comparison unavailable: {e}")
