@@ -83,6 +83,8 @@ def main() -> None:
     def _save(attempt=[0]):
         attempt[0] += 1
         path = os.path.join(args.work_dir, f"tpusnap{attempt[0]}")
+        prev = os.path.join(args.work_dir, f"tpusnap{attempt[0] - 1}")
+        shutil.rmtree(prev, ignore_errors=True)  # keep peak disk ~1 state
         shutil.rmtree(path, ignore_errors=True)
         snaps["snap"] = Snapshot.take(path, {"m": StateDict(tree)})
 
@@ -96,15 +98,37 @@ def main() -> None:
 
     ours_load = _best_of(_load)
     ok = np.array_equal(np.asarray(dst["m"]["w0"]), np.asarray(tree["w0"]))
+    # The "verifying" label must be true: the save above ran under the
+    # caller's environment, so confirm digests were actually recorded.
+    # Sharded/chunked entries carry their checksums on per-piece tensor
+    # records, not the top-level entry.
+    def _has_digest(e):
+        if getattr(e, "checksum", None):
+            return True
+        for piece in list(getattr(e, "shards", None) or []) + list(
+            getattr(e, "chunks", None) or []
+        ):
+            if getattr(getattr(piece, "tensor", None), "checksum", None):
+                return True
+        return False
+
+    n_digests = sum(1 for e in snap.get_manifest().values() if _has_digest(e))
+    verifying = n_digests > 0
     # Apples-to-apples load: our default restore VERIFIES every payload's
     # xxh64 against the manifest; orbax's does not verify payload bytes.
+    # Preserve any pre-existing user setting.
+    prior_checksum = os.environ.get("TPUSNAP_CHECKSUM")
     os.environ["TPUSNAP_CHECKSUM"] = "0"
     ours_load_noverify = _best_of(_load)
-    os.environ.pop("TPUSNAP_CHECKSUM", None)
+    if prior_checksum is None:
+        os.environ.pop("TPUSNAP_CHECKSUM", None)
+    else:
+        os.environ["TPUSNAP_CHECKSUM"] = prior_checksum
     print(
         f"torchsnapshot_tpu: save {ours_save:.2f}s ({gb / ours_save:.2f} GB/s), "
         f"load {ours_load:.2f}s ({gb / ours_load:.2f} GB/s) "
-        f"[verifies checksums; verified={ok}], "
+        f"[{'verifies ' + str(n_digests) + ' payload checksums' if verifying else 'NO digests recorded (TPUSNAP_CHECKSUM off?)'}; "
+        f"values_equal={ok}], "
         f"load w/o verify {ours_load_noverify:.2f}s "
         f"({gb / ours_load_noverify:.2f} GB/s) [best of 2 each, saves too]"
     )
@@ -119,6 +143,8 @@ def main() -> None:
         def _orbax_save(attempt=[0]):
             attempt[0] += 1
             path = os.path.join(args.work_dir, f"orbax{attempt[0]}")
+            prev = os.path.join(args.work_dir, f"orbax{attempt[0] - 1}")
+            shutil.rmtree(prev, ignore_errors=True)
             shutil.rmtree(path, ignore_errors=True)
             ckptr.save(path, tree)
             orbax_dirs["dir"] = path
@@ -141,11 +167,15 @@ def main() -> None:
             f"orbax:             save {orbax_save:.2f}s ({gb / orbax_save:.2f} GB/s), "
             f"load {orbax_load:.2f}s ({gb / orbax_load:.2f} GB/s)"
         )
+        verify_note = (
+            "with payload verification orbax does not do"
+            if verifying
+            else "NO verification either side"
+        )
         print(
             f"speedup: save {orbax_save / ours_save:.2f}x, "
-            f"load {orbax_load / ours_load:.2f}x (with payload verification "
-            f"orbax does not do), {orbax_load / ours_load_noverify:.2f}x "
-            f"(equal work)"
+            f"load {orbax_load / ours_load:.2f}x ({verify_note}), "
+            f"{orbax_load / ours_load_noverify:.2f}x (equal work)"
         )
     except Exception as e:  # noqa: BLE001
         print(f"orbax comparison unavailable: {e}")
