@@ -194,6 +194,19 @@ def _drift_dominant_phase(attempt_phases: list, attempts_s: list):
     return {"phase": ph, "delta_s": round(deltas[ph], 2)}
 
 
+def _dir_bytes(path: str) -> int:
+    """Bytes actually on disk under ``path`` — with compression on this is
+    smaller than the logical state size, and the delta is the codec's win."""
+    total = 0
+    for root, _, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
 def _phases_brief(stats: dict) -> dict:
     """Per-phase {wall_s, cpu_s, gb, gbps} with throughput over WALL time
     (thread-seconds would understate concurrent phases' rates)."""
@@ -485,13 +498,110 @@ def main() -> None:
         _PARTIAL["save_gbps"] = actual_bytes / 1e9 / best_save_s
     save_s = min(save_attempts_s)
     save_gbps = actual_bytes / 1e9 / save_s
+    bytes_written = _dir_bytes(os.path.join(workdir, "snap"))
     log(f"sync save: {save_s:.2f}s -> {save_gbps:.2f} GB/s (runs: {save_attempts_s})")
     log(f"  save phases (best attempt): {phase_stats.format_line(save_phases)}")
+    log(f"  bytes written: {bytes_written / 1e9:.3f} GB for {actual_bytes / 1e9:.3f} GB of state")
     _PARTIAL.setdefault("banked", {})["sync"] = {
         "state_gib": round(gib, 2),
         "save_attempts_s": save_attempts_s,
         "save_phases": _phases_brief(save_phases),
+        "bytes_written": bytes_written,
     }
+
+    # --- compression probe: one save with the best available codec ---
+    # The default save path ships bytes raw; this measures what the codec
+    # tier (TPUSNAP_COMPRESSION, compression.py) buys on the same state:
+    # bytes written, wall time, and effective GB/s (logical bytes over
+    # wall — the number that beats the raw save when storage, not the
+    # codec, is the bottleneck).  Skipped when the operator already set
+    # TPUSNAP_COMPRESSION (the main save measured it), when no codec
+    # library is available, or when the watchdog budget can't cover an
+    # extra save pass.  BENCH_COMPRESSION=<codec> forces, =0 disables.
+    compression_probe = None
+    from torchsnapshot_tpu import compression as _compression
+
+    from torchsnapshot_tpu import knobs as _knobs
+
+    requested = os.environ.get("BENCH_COMPRESSION", "zstd")
+    # Resolve the configured codec through availability: an env spelling of
+    # zstd on a host without the wheel stored RAW bytes, and must take the
+    # fallback probe below, not claim the main save measured compression.
+    if _compression.resolve(_knobs.get_compression()[0]) != "raw":
+        compression_probe = {
+            "codec": os.environ["TPUSNAP_COMPRESSION"],
+            "note": "main save ran compressed (TPUSNAP_COMPRESSION set)",
+            "bytes_written": bytes_written,
+            "logical_bytes": actual_bytes,
+            "ratio": round(actual_bytes / bytes_written, 3) if bytes_written else None,
+        }
+    elif requested.lower() not in ("0", "off", "none", "raw", "false"):
+        # Same codec[:level] syntax as TPUSNAP_COMPRESSION (zstd:6, zlib:1);
+        # only the codec name goes through availability resolution.
+        req_name, _, req_level = requested.strip().lower().partition(":")
+        try:
+            if req_level and not req_level.lstrip("-").isdigit():
+                raise ValueError(
+                    f"BENCH_COMPRESSION={requested!r}: level {req_level!r} "
+                    "is not an integer"
+                )
+            codec = (
+                req_name
+                if _compression.resolve(req_name) != "raw"
+                else next(iter(_compression.available_codecs()), None)
+            )
+        except ValueError as e:
+            # A typo'd BENCH_COMPRESSION must not abort the whole bench
+            # after the sync-save section already ran.
+            codec = None
+            skip_reason = str(e)
+        else:
+            skip_reason = f"no codec library available (requested {requested})"
+        # Extra pass ≈ one save + one codec pass.  30 MB/s floor: measured
+        # zlib on a 1-vCPU box runs ~40 MB/s (docs/performance.md), and an
+        # undershot estimate runs the watchdog out mid-probe, losing the
+        # async/restore sections the bench exists to collect.
+        est_s = save_s + actual_bytes / 30e6
+        if codec is not None and _watchdog_remaining_s() > est_s + 60:
+            _PARTIAL["phase"] = "compression_probe"
+            comp_path = os.path.join(workdir, "snap_comp")
+            shutil.rmtree(comp_path, ignore_errors=True)
+            _drain_writeback()
+            # Carry the requested level through only when the requested
+            # codec itself is the one running (a fallback codec has its
+            # own level scale).
+            setting = (
+                f"{codec}:{req_level}"
+                if codec == req_name and req_level
+                else codec
+            )
+            with _knobs.override_compression(setting):
+                phase_stats.reset()
+                t0 = time.monotonic()
+                Snapshot.take(comp_path, app_state)
+                comp_save_s = time.monotonic() - t0
+            comp_bytes = _dir_bytes(comp_path)
+            shutil.rmtree(comp_path, ignore_errors=True)
+            compression_probe = {
+                "codec": codec,
+                "requested": requested,
+                "save_s": round(comp_save_s, 2),
+                "bytes_written": comp_bytes,
+                "raw_bytes_written": bytes_written,
+                "ratio": round(bytes_written / comp_bytes, 3) if comp_bytes else None,
+                "effective_gbps": round(actual_bytes / 1e9 / comp_save_s, 3),
+                "phases": _phases_brief(phase_stats.snapshot()),
+            }
+            log(
+                f"compression probe ({codec}): {comp_save_s:.2f}s, "
+                f"{comp_bytes / 1e9:.3f} GB written vs {bytes_written / 1e9:.3f} raw "
+                f"(ratio {compression_probe['ratio']}x)"
+            )
+        elif codec is None:
+            log(f"compression probe skipped: {skip_reason}")
+        else:
+            log("compression probe skipped: insufficient watchdog budget")
+    _PARTIAL["banked"]["sync"]["compression_probe"] = compression_probe
 
     # --- async save: training-blocked time, best of N ---
     # Round-2 verdict: a single async run recorded 11.87 s total vs 0.23 s
@@ -631,6 +741,8 @@ def main() -> None:
         "aux": {
             "state_gib": round(gib, 2),
             "attempts": attempts,
+            "bytes_written": bytes_written,
+            "compression_probe": compression_probe,
             "sync_save_s": round(save_s, 2),
             "sync_save_worst_s": round(max(save_attempts_s), 2),
             "save_attempts_s": save_attempts_s,

@@ -75,11 +75,19 @@ def _roundtrip(url: str, nbytes: int):
 
 
 def bench_gcs(nbytes: int) -> dict:
+    from contextlib import ExitStack
+
     from fake_gcs import FakeGCSServer
+    from torchsnapshot_tpu.knobs import override_env
 
     server = FakeGCSServer()
-    os.environ["TPUSNAP_GCS_ENDPOINT"] = server.endpoint
-    try:
+    # ExitStack-managed env: a raising run restores any pre-existing user
+    # value instead of leaking the fake endpoint into the process env.
+    with ExitStack() as stack:
+        stack.callback(server.stop)
+        stack.enter_context(
+            override_env("TPUSNAP_GCS_ENDPOINT", server.endpoint)
+        )
         actual, save_s, restore_s = _roundtrip("gs://bench-bkt/clean", nbytes)
         out = {
             "bytes": actual,
@@ -126,24 +134,37 @@ def bench_gcs(nbytes: int) -> dict:
             "bit_exact_after_recovery": True,
         }
         return out
-    finally:
-        server.stop()
-        os.environ.pop("TPUSNAP_GCS_ENDPOINT", None)
 
 
 def bench_s3(nbytes: int) -> dict:
+    from contextlib import ExitStack
+
     from fake_s3 import FakeS3Server
+    from torchsnapshot_tpu.knobs import override_env
 
     server = FakeS3Server()
-    os.environ["TPUSNAP_S3_ENDPOINT"] = server.endpoint
-    os.environ.setdefault("AWS_ACCESS_KEY_ID", "bench-access-key")
-    os.environ.setdefault("AWS_SECRET_ACCESS_KEY", "bench-secret-key")
-    # The default 5 GB multipart threshold (AWS's single-PUT limit) would
-    # leave the multipart path idle at bench scale; lower it so the
+    # ExitStack-managed env: a raising run restores any pre-existing user
+    # values (endpoint + multipart tuning) instead of popping them, and the
+    # fake credentials (installed only when the user has none) are removed
+    # on exit rather than left for later real-S3 code to pick up.  The
+    # default 5 GB multipart threshold (AWS's single-PUT limit) would leave
+    # the multipart path idle at bench scale; lower it so the
     # initiate/part/complete protocol — the piece worth measuring — engages.
-    os.environ["TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES"] = str(64 << 20)
-    os.environ["TPUSNAP_S3_MULTIPART_PART_BYTES"] = str(16 << 20)
-    try:
+    with ExitStack() as stack:
+        stack.callback(server.stop)
+        overrides = [
+            ("TPUSNAP_S3_ENDPOINT", server.endpoint),
+            ("TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES", str(64 << 20)),
+            ("TPUSNAP_S3_MULTIPART_PART_BYTES", str(16 << 20)),
+        ]
+        for var, value in (
+            ("AWS_ACCESS_KEY_ID", "bench-access-key"),
+            ("AWS_SECRET_ACCESS_KEY", "bench-secret-key"),
+        ):
+            if var not in os.environ:
+                overrides.append((var, value))
+        for var, value in overrides:
+            stack.enter_context(override_env(var, value))
         actual, save_s, restore_s = _roundtrip("s3://bench-bkt/clean", nbytes)
         out = {
             "bytes": actual,
@@ -185,14 +206,6 @@ def bench_s3(nbytes: int) -> dict:
             "bit_exact_after_recovery": True,
         }
         return out
-    finally:
-        server.stop()
-        for var in (
-            "TPUSNAP_S3_ENDPOINT",
-            "TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES",
-            "TPUSNAP_S3_MULTIPART_PART_BYTES",
-        ):
-            os.environ.pop(var, None)
 
 
 def raw_loopback_ceiling(nbytes: int = 256 << 20) -> dict:

@@ -116,14 +116,12 @@ def main() -> None:
     verifying = n_digests > 0
     # Apples-to-apples load: our default restore VERIFIES every payload's
     # xxh64 against the manifest; orbax's does not verify payload bytes.
-    # Preserve any pre-existing user setting.
-    prior_checksum = os.environ.get("TPUSNAP_CHECKSUM")
-    os.environ["TPUSNAP_CHECKSUM"] = "0"
-    ours_load_noverify = _best_of(_load)
-    if prior_checksum is None:
-        os.environ.pop("TPUSNAP_CHECKSUM", None)
-    else:
-        os.environ["TPUSNAP_CHECKSUM"] = prior_checksum
+    # The context manager restores any pre-existing user setting even when
+    # the no-verify load raises — a failed run must not leak mutated env.
+    from torchsnapshot_tpu.knobs import override_env
+
+    with override_env("TPUSNAP_CHECKSUM", "0"):
+        ours_load_noverify = _best_of(_load)
     print(
         f"torchsnapshot_tpu: save {ours_save:.2f}s ({gb / ours_save:.2f} GB/s), "
         f"load {ours_load:.2f}s ({gb / ours_load:.2f} GB/s) "

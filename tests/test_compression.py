@@ -1,0 +1,332 @@
+"""Chunk-compression codec subsystem: frame codec properties, pipeline
+integration across entry types, knob behavior, and legacy compat."""
+
+import json
+import random
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, compression, knobs
+from torchsnapshot_tpu.compression import FrameError
+from torchsnapshot_tpu.manifest import SnapshotMetadata, TensorEntry
+
+# Codecs under test: every name the registry knows.  Missing optional
+# libraries (zstd/lz4 in minimal images) resolve to raw — the frame must
+# still round-trip bit-exactly either way; zlib is stdlib and always
+# exercises a real compression path.
+ALL_CODEC_NAMES = ["raw", "zstd", "lz4", "zlib"]
+
+_DTYPES = [
+    np.float32,
+    np.float64,
+    np.int16,
+    np.uint8,
+    np.bool_,
+    ml_dtypes.bfloat16,
+    ml_dtypes.float8_e4m3fn,
+]
+
+
+@pytest.mark.parametrize("codec", ALL_CODEC_NAMES)
+@pytest.mark.parametrize("seed", range(4))
+def test_frame_roundtrip_property(codec, seed):
+    """Random dtypes/shapes × every codec: encode→decode is bit-exact, the
+    inner codec honestly records fallbacks, and compressible data shrinks."""
+    rng = random.Random(seed * 31 + hash(codec) % 1000)
+    np_rng = np.random.RandomState(seed)
+    dtype = rng.choice(_DTYPES)
+    shape = tuple(rng.randrange(1, 40) for _ in range(rng.randrange(0, 4)))
+    arr = (np_rng.uniform(-4, 4, size=shape) if rng.random() < 0.5
+           else np.zeros(shape)).astype(dtype)
+    raw = arr.tobytes()
+
+    frame, inner = compression.encode(raw, compression.resolve(codec))
+    assert inner in ("raw", "zstd", "lz4", "zlib")
+    if compression.resolve(codec) == "raw":
+        assert inner == "raw"
+    out = compression.decode(frame, expected_nbytes=len(raw))
+    assert bytes(out) == raw
+
+
+def test_zlib_actually_compresses():
+    data = bytes(1 << 20)  # a MiB of zeros
+    frame, inner = compression.encode(data, "zlib")
+    assert inner == "zlib"
+    assert len(frame) < len(data) // 100
+    assert bytes(compression.decode(frame, expected_nbytes=len(data))) == data
+
+
+def test_incompressible_falls_back_to_raw_in_frame():
+    data = np.random.RandomState(0).bytes(1 << 16)
+    frame, inner = compression.encode(data, "zlib")
+    assert inner == "raw"  # zlib output >= input on random bytes
+    assert len(frame) == len(data) + compression.HEADER_BYTES
+    assert bytes(compression.decode(frame)) == data
+
+
+def test_missing_codec_resolves_to_raw():
+    # zstd/lz4 may or may not be installed; resolve() must return the name
+    # itself or "raw", never raise.
+    for name in ("zstd", "lz4"):
+        assert compression.resolve(name) in (name, "raw")
+    with pytest.raises(ValueError, match="Unknown compression codec"):
+        compression.get_codec("snappy")
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    ["truncate_header", "truncate_body", "bad_magic", "bad_length", "bad_codec_id", "flip_body"],
+)
+def test_corrupted_frame_clean_error(mutate):
+    """Every corruption mode surfaces as FrameError, never garbage data or
+    an unrelated exception type."""
+    data = bytes(range(256)) * 64
+    frame, inner = compression.encode(data, "zlib")
+    assert inner == "zlib"
+    frame = bytearray(frame)
+    if mutate == "truncate_header":
+        frame = frame[:8]
+    elif mutate == "truncate_body":
+        frame = frame[: compression.HEADER_BYTES + 3]
+    elif mutate == "bad_magic":
+        frame[0] ^= 0xFF
+    elif mutate == "bad_length":
+        frame[8] ^= 0xFF  # u64 uncompressed length, low byte
+    elif mutate == "bad_codec_id":
+        frame[4] = 250
+    elif mutate == "flip_body":
+        frame[compression.HEADER_BYTES + 1] ^= 0xFF
+    with pytest.raises(FrameError):
+        compression.decode(bytes(frame), expected_nbytes=len(data))
+
+
+def test_decode_length_mismatch_vs_manifest():
+    data = bytes(64)
+    frame, _ = compression.encode(data, "raw")
+    with pytest.raises(FrameError, match="manifest implies"):
+        compression.decode(frame, expected_nbytes=65)
+
+
+@pytest.mark.parametrize("codec", ["zstd", "zlib"])
+def test_snapshot_roundtrip_all_entry_types(tmp_path, codec, monkeypatch):
+    """TPUSNAP_COMPRESSION save→restore is bit-exact for every entry type:
+    dense tensors, chunked tensors, sharded arrays, objects, primitives.
+    (zstd degrades to raw where the library is missing — the roundtrip
+    must hold identically.)"""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", codec)
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x", None))
+    sharded = jax.device_put(
+        jnp.arange(8 * 64, dtype=jnp.float32).reshape(8, 64), sharding
+    )
+    state = {
+        "dense": np.arange(4096, dtype=np.float32).reshape(64, 64),
+        "bf16": np.arange(256, dtype=np.float32).astype(ml_dtypes.bfloat16),
+        "big": np.arange(32 * 256, dtype=np.float32).reshape(32, 256),
+        "sharded": sharded,
+        "obj": {"nested": [1, 2, 3]},
+        "prim": 42,
+    }
+    # Chunk cap of 16 KiB: "big" (32 KiB) splits into chunks, "dense"
+    # (16 KiB) stays a plain TensorEntry.
+    with knobs.override_max_chunk_size_bytes(16 * 1024):
+        snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(dict(state))})
+
+    man = snapshot.get_manifest()
+    resolved = compression.resolve(codec)
+    if resolved != "raw":
+        assert man["0/m/dense"].codec == resolved
+        assert man["0/m/dense"].compressed_nbytes is not None
+        assert man["0/m/big"].type == "ChunkedTensor"
+        assert all(c.tensor.codec == resolved for c in man["0/m/big"].chunks)
+        assert all(s.tensor.codec == resolved for s in man["0/m/sharded"].shards)
+
+    # Restore under a DIFFERENT env (compression is save-time only; the
+    # frame header drives decoding).
+    monkeypatch.delenv("TPUSNAP_COMPRESSION")
+    dst = {
+        "m": StateDict(
+            {
+                "dense": np.zeros((64, 64), np.float32),
+                "bf16": np.zeros(256, ml_dtypes.bfloat16),
+                "big": np.zeros((32, 256), np.float32),
+                "sharded": jax.device_put(jnp.zeros((8, 64), jnp.float32), sharding),
+                "obj": None,
+                "prim": 0,
+            }
+        )
+    }
+    Snapshot(str(tmp_path / "snap")).restore(dst)
+    sd = dst["m"].state_dict()
+    np.testing.assert_array_equal(sd["dense"], state["dense"])
+    np.testing.assert_array_equal(
+        sd["bf16"].view(np.uint8), state["bf16"].view(np.uint8)
+    )
+    np.testing.assert_array_equal(sd["big"], state["big"])
+    np.testing.assert_array_equal(np.asarray(sd["sharded"]), np.asarray(sharded))
+    assert sd["obj"] == {"nested": [1, 2, 3]}
+    assert sd["prim"] == 42
+
+
+def test_compression_min_bytes_floor(tmp_path, monkeypatch):
+    """Payloads under the floor stay raw (codec=None → still slab-batchable);
+    above it they carry the codec."""
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", str(1 << 12))
+    state = {
+        "small": np.zeros(16, np.float32),  # 64 B < 4 KiB floor
+        "large": np.zeros(4096, np.float32),  # 16 KiB >= floor
+    }
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    man = snapshot.get_manifest()
+    assert man["0/m/small"].codec is None
+    assert man["0/m/large"].codec == "zlib"
+    assert man["0/m/large"].compressed_nbytes < 4096 * 4
+
+
+def test_compressed_entries_not_slab_batched(tmp_path, monkeypatch):
+    """Framed payloads must not join slabs (their stored size is unknown at
+    plan time); raw payloads under the floor still batch."""
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", str(1 << 10))
+    state = {f"w{i}": np.zeros(512, np.float32) for i in range(8)}  # 2 KiB each
+    state.update({f"t{i}": np.zeros(16, np.float32) for i in range(8)})  # 64 B each
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    man = snapshot.get_manifest()
+    for i in range(8):
+        large = man[f"0/m/w{i}"]
+        assert large.codec == "zlib"
+        assert large.byte_range is None  # whole file, not a slab member
+        small = man[f"0/m/t{i}"]
+        assert small.codec is None
+        assert small.byte_range is not None  # slab-batched as before
+
+    dst = {"m": StateDict({k: np.ones_like(v) for k, v in state.items()})}
+    Snapshot(str(tmp_path / "snap")).restore(dst)
+    for k, v in state.items():
+        np.testing.assert_array_equal(dst["m"][k], v)
+
+
+def test_old_manifest_without_codec_field_loads():
+    """Manifests written before the codec subsystem (no codec /
+    compressed_nbytes keys) must parse to codec=None — bare-bytes
+    semantics — and re-serialize without inventing the fields."""
+    old_json = json.dumps(
+        {
+            "version": "0.1.0",
+            "world_size": 1,
+            "manifest": {
+                "0/m/w": {
+                    "type": "Tensor",
+                    "location": "0/m/w",
+                    "serializer": "buffer_protocol",
+                    "dtype": "float32",
+                    "shape": [4, 4],
+                    "replicated": False,
+                    "checksum": "xxh64:0123456789abcdef",
+                }
+            },
+        }
+    )
+    md = SnapshotMetadata.from_json(old_json)
+    entry = md.manifest["0/m/w"]
+    assert isinstance(entry, TensorEntry)
+    assert entry.codec is None
+    assert entry.compressed_nbytes is None
+    assert not compression.is_framed(entry)
+    round_tripped = json.loads(md.to_json())
+    assert "codec" not in round_tripped["manifest"]["0/m/w"]
+    assert "compressed_nbytes" not in round_tripped["manifest"]["0/m/w"]
+
+
+def test_uncompressed_snapshot_restores_with_compression_configured(
+    tmp_path, monkeypatch
+):
+    """A snapshot written before/without compression restores unchanged even
+    when the restoring process has TPUSNAP_COMPRESSION set (the env is
+    save-time only)."""
+    state = {"w": np.arange(8192, dtype=np.float32)}
+    Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    dst = {"m": StateDict({"w": np.zeros(8192, np.float32)})}
+    Snapshot(str(tmp_path / "snap")).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], state["w"])
+
+
+def test_manifest_version_gates_framed_snapshots(tmp_path, monkeypatch):
+    """Compressed snapshots declare the framed manifest version (0.2.0) so
+    a future reader can refuse formats it predates; uncompressed snapshots
+    keep declaring 0.1.0 — byte-identical to the pre-codec format.  A
+    manifest newer than this reader supports fails with a clear upgrade
+    error, not silent misdecoding."""
+    from torchsnapshot_tpu.manifest import (
+        FRAMED_MANIFEST_VERSION,
+        MANIFEST_VERSION,
+        SnapshotMetadata,
+    )
+
+    state = {"w": np.zeros(8192, np.float32)}
+    raw_snap = Snapshot.take(str(tmp_path / "raw"), {"m": StateDict(dict(state))})
+    assert raw_snap.metadata.version == MANIFEST_VERSION
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    comp_snap = Snapshot.take(str(tmp_path / "comp"), {"m": StateDict(dict(state))})
+    assert comp_snap.metadata.version == FRAMED_MANIFEST_VERSION
+    # A 0.2.0 manifest still loads here, of course.
+    dst = {"m": StateDict({"w": np.ones(8192, np.float32)})}
+    Snapshot(str(tmp_path / "comp")).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["w"], state["w"])
+
+    future = json.dumps({"version": "0.3.0", "world_size": 1, "manifest": {}})
+    with pytest.raises(ValueError, match="upgrade torchsnapshot_tpu"):
+        SnapshotMetadata.from_json(future)
+
+
+def test_compression_knob_parsing(monkeypatch):
+    monkeypatch.delenv("TPUSNAP_COMPRESSION", raising=False)
+    assert knobs.get_compression() == ("raw", None)
+    for off in ("raw", "none", "off", "0", " off ", "raw "):
+        monkeypatch.setenv("TPUSNAP_COMPRESSION", off)
+        assert knobs.get_compression() == ("raw", None)
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zstd")
+    assert knobs.get_compression() == ("zstd", None)
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zstd:6")
+    assert knobs.get_compression() == ("zstd", 6)
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "ZLIB:1")
+    assert knobs.get_compression() == ("zlib", 1)
+    with knobs.override_compression("lz4:9"):
+        assert knobs.get_compression() == ("lz4", 9)
+    with knobs.override_compression_min_bytes(123):
+        assert knobs.get_compression_min_bytes() == 123
+
+
+def test_cli_info_reports_compression(tmp_path, capsys, monkeypatch):
+    from torchsnapshot_tpu.__main__ import main as cli_main
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    Snapshot.take(
+        str(tmp_path / "snap"),
+        {"m": StateDict({"w": np.zeros((256, 256), np.float32)})},
+    )
+    assert cli_main(["info", str(tmp_path / "snap")]) == 0
+    out = capsys.readouterr().out
+    assert "compression: zlib" in out
+    assert "ratio" in out
+
+    monkeypatch.delenv("TPUSNAP_COMPRESSION")
+    Snapshot.take(
+        str(tmp_path / "raw_snap"),
+        {"m": StateDict({"w": np.zeros(64, np.float32)})},
+    )
+    assert cli_main(["info", str(tmp_path / "raw_snap")]) == 0
+    assert "compression: none" in capsys.readouterr().out

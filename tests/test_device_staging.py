@@ -124,7 +124,10 @@ def test_resolve_mode_agreement_respects_device_capability(monkeypatch):
 def test_agreement_downgrade_emits_event():
     """A cross-rank agreement forcing a rank off its preferred mode is a
     stall regression; it must land in the event stream like every other
-    downgrade, not only in per-rank logs."""
+    downgrade — but ONLY when the resolution feeds an actual staging
+    (emit_events=True, what async_take passes).  Pure probes/diagnostics
+    resolve silently, so a 300 s backoff window doesn't spray one event
+    per query (r5 advisor finding)."""
     from torchsnapshot_tpu import event_handlers
 
     events = []
@@ -140,8 +143,19 @@ def test_agreement_downgrade_emits_event():
             def all_gather_object(self, obj):
                 return [obj, {"mode": "host", "device_fits": True}]
 
+        # Pure probe: no event.
         with knobs.override_async_staging("auto"):
             mode = device_staging.resolve_mode({"m/w": jnp.ones(4)}, pg=FakePG())
+        assert mode == "host"
+        assert not [
+            e for e in events if e.name == "async_take.staging_downgrade"
+        ]
+
+        # Staging-bound resolution: the event fires.
+        with knobs.override_async_staging("auto"):
+            mode = device_staging.resolve_mode(
+                {"m/w": jnp.ones(4)}, pg=FakePG(), emit_events=True
+            )
         assert mode == "host"
         downgrades = [
             e for e in events if e.name == "async_take.staging_downgrade"

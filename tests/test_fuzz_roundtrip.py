@@ -48,8 +48,23 @@ def _random_state(rng: random.Random, depth: int = 0):
     return tuple(_random_state(rng, depth + 1) for _ in range(rng.randrange(1, 3)))
 
 
+@pytest.mark.parametrize(
+    "compression_env",
+    [
+        None,
+        # zstd degrades gracefully to raw where the library is missing;
+        # zlib (stdlib) always exercises real compress/decompress.  Floor 0
+        # so even tiny fuzz leaves take the framed path.
+        "zstd",
+        "zlib",
+    ],
+    ids=["raw", "zstd", "zlib"],
+)
 @pytest.mark.parametrize("seed", range(5))
-def test_fuzz_roundtrip(tmp_path, seed):
+def test_fuzz_roundtrip(tmp_path, seed, compression_env, monkeypatch):
+    if compression_env is not None:
+        monkeypatch.setenv("TPUSNAP_COMPRESSION", compression_env)
+        monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
     rng = random.Random(seed)
     state = {f"top{i}": _random_state(rng) for i in range(4)}
     app_state = {"s": StateDict(state)}

@@ -62,6 +62,78 @@ def test_checksum_disabled(tmp_path, monkeypatch):
     assert snapshot.get_manifest()["0/m/w"].checksum is None
 
 
+def test_compressed_frame_checksum_covers_stored_bytes(tmp_path, monkeypatch):
+    """For compressed entries the digest covers the FRAME (the bytes on
+    disk): flipping one stored byte fails as ChecksumError before the
+    decoder runs, and with checksums off the frame decoder still catches
+    the corruption as a clean typed FrameError."""
+    import os
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    state = {"w": np.arange(4096, dtype=np.float32)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    entry = snapshot.get_manifest()["0/m/w"]
+    assert entry.codec == "zlib"
+    payload = os.path.join(str(tmp_path / "snap"), entry.location)
+    assert os.path.getsize(payload) == entry.compressed_nbytes
+
+    with open(payload, "r+b") as f:
+        f.seek(20)  # inside the compressed body, past the 16-byte header
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    dst = {"m": StateDict({"w": np.zeros(4096, np.float32)})}
+    with pytest.raises(ChecksumError):
+        Snapshot(str(tmp_path / "snap")).restore(dst)
+
+    # Same corruption with verification off: the frame layer reports it.
+    from torchsnapshot_tpu.compression import FrameError
+
+    monkeypatch.setenv("TPUSNAP_CHECKSUM", "0")
+    with pytest.raises(FrameError):
+        Snapshot(str(tmp_path / "snap")).restore(dst)
+
+
+def test_truncated_compressed_frame_clean_error(tmp_path, monkeypatch):
+    """A torn write that truncates a frame fails with a typed error, not
+    garbage data (checksums off so the frame layer itself is under test)."""
+    import os
+
+    from torchsnapshot_tpu.compression import FrameError
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    monkeypatch.setenv("TPUSNAP_CHECKSUM", "0")
+    state = {"w": np.arange(4096, dtype=np.float32)}
+    snapshot = Snapshot.take(str(tmp_path / "snap"), {"m": StateDict(state)})
+    entry = snapshot.get_manifest()["0/m/w"]
+    payload = os.path.join(str(tmp_path / "snap"), entry.location)
+    with open(payload, "r+b") as f:
+        f.truncate(10)  # shorter than the 16-byte frame header
+    dst = {"m": StateDict({"w": np.zeros(4096, np.float32)})}
+    with pytest.raises(FrameError, match="Truncated"):
+        Snapshot(str(tmp_path / "snap")).restore(dst)
+
+
+def test_verify_cli_audits_compressed_payloads(tmp_path, capsys, monkeypatch):
+    """`verify` audits compressed frames without decompressing (digests
+    cover stored bytes) and reports the codec + ratio."""
+    from torchsnapshot_tpu.__main__ import main as cli_main
+
+    monkeypatch.setenv("TPUSNAP_COMPRESSION", "zlib")
+    monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    Snapshot.take(
+        str(tmp_path / "snap"),
+        {"m": StateDict({"w": np.zeros((128, 128), np.float32)})},
+    )
+    assert cli_main(["verify", str(tmp_path / "snap")]) == 0
+    out = capsys.readouterr().out
+    assert "0 corrupt" in out
+    assert "compression: zlib" in out
+
+
 def test_save_checksums_disabled_restore_still_verifies(tmp_path, monkeypatch):
     """TPUSNAP_CHECKSUM_ON_SAVE=0 skips recording digests (for hosts whose
     link rate outruns the hash) WITHOUT disabling restore-side verification

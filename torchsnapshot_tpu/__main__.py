@@ -52,6 +52,54 @@ def _entry_size(entry) -> int:
     return 0
 
 
+def _compression_stats(md) -> tuple:
+    """``(logical_bytes, stored_bytes, {codec: payload_count})`` over every
+    distinct array payload in a manifest.  ``stored`` uses the recorded
+    frame size for compressed entries and the logical size otherwise, so
+    ``logical / stored`` is the snapshot's effective compression ratio
+    (legacy manifests without codec fields report ratio 1.0)."""
+    from .compression import is_framed
+    from .manifest import TensorEntry
+
+    seen = set()
+    logical = stored = 0
+    codecs: dict = {}
+
+    def _add(t) -> None:
+        nonlocal logical, stored
+        key = (t.location, tuple(t.byte_range) if t.byte_range else None)
+        if key in seen:
+            return
+        seen.add(key)
+        nbytes = _entry_size(t)
+        logical += nbytes
+        if is_framed(t):
+            codecs[t.codec] = codecs.get(t.codec, 0) + 1
+            stored += t.compressed_nbytes if t.compressed_nbytes else nbytes
+        else:
+            stored += nbytes
+
+    for entry in md.manifest.values():
+        if isinstance(entry, TensorEntry):
+            _add(entry)
+        else:
+            for shard in _shards(entry) or []:
+                _add(shard.tensor)
+    return logical, stored, codecs
+
+
+def _compression_line(md) -> str:
+    logical, stored, codecs = _compression_stats(md)
+    if not codecs:
+        return "compression: none"
+    ratio = logical / stored if stored else 1.0
+    by_codec = ", ".join(f"{c}×{n}" for c, n in sorted(codecs.items()))
+    return (
+        f"compression: {by_codec}; stored {_human(stored)} of "
+        f"{_human(logical)} (ratio {ratio:.2f}x)"
+    )
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     from .manifest import ShardedArrayEntry
     from .snapshot import Snapshot
@@ -78,6 +126,7 @@ def cmd_info(args: argparse.Namespace) -> int:
     print(f"world_size:  {md.world_size}")
     print(f"entries:     {len(md.manifest)}")
     print(f"array bytes: {_human(total)}")
+    print(_compression_line(md))
     return 0
 
 
@@ -172,6 +221,9 @@ def cmd_verify(args: argparse.Namespace) -> int:
     for line in problems:
         print(line)
     skipped = "" if ok or corrupt or unreadable else " (no checksums recorded)"
+    # Digests cover the stored (compressed) bytes, so the audit above
+    # verified frames as-is; surface what the codec layer did to them.
+    print(_compression_line(md))
     print(
         f"verified {ok} payloads, {corrupt} corrupt, "
         f"{unreadable} unreadable{skipped}"

@@ -31,6 +31,7 @@ from concurrent.futures import Executor
 from typing import Dict, List, Optional, Tuple
 
 from . import knobs, serialization
+from .compression import is_framed
 from .io_preparers.array import ArrayBufferStager
 from .io_types import (
     BufferConsumer,
@@ -72,6 +73,13 @@ def is_batchable(write_req: WriteReq, entry_index: Dict[str, TensorEntry]) -> bo
         return False
     entry = entry_index.get(write_req.path)
     if entry is None or entry.serializer != Serializer.BUFFER_PROTOCOL.value:
+        return False
+    if is_framed(entry):
+        # Compressed (framed) payloads can't join slabs: slab byte_ranges
+        # are pre-assigned from dtype×shape at plan time, and a frame's
+        # size isn't known until it is staged.  The compression size floor
+        # (TPUSNAP_COMPRESSION_MIN_BYTES) keeps tiny payloads — the ones
+        # slabs exist for — raw and batchable.
         return False
     return True
 

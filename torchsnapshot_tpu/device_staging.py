@@ -132,11 +132,19 @@ def _hbm_headroom_fits(arrays: Dict[str, Any]) -> bool:
 _MODE_RANK = {"host": 0, "device": 1, "pinned_host": 2}
 
 
-def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
+def _local_staging_signals(
+    flattened: Dict[str, Any], emit_events: bool = False
+) -> Dict[str, Any]:
     """This process's preferred placement AND what it could execute — the
     cross-rank agreement needs both: a rank preferring pinned_host may be
     downgraded to device by a peer, and must not be assumed to have HBM
-    headroom it never checked."""
+    headroom it never checked.
+
+    ``emit_events=False`` (the default) keeps this pure: probes,
+    diagnostics, and benches call resolve_mode without an
+    ``async_take.staging_downgrade`` event firing for every call during a
+    backoff window — the event stream must carry actual staging
+    downgrades, not mode queries (r5 advisor finding)."""
     mode = configured_mode()
     if mode == "host":
         return {"mode": "host", "device_fits": True}
@@ -164,9 +172,10 @@ def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
             "(healthy) pinned_host memory space; falling back to "
             "device-copy staging"
         )
-        _log_downgrade_event(
-            "pinned_host", "device", "no healthy pinned_host memory space"
-        )
+        if emit_events:
+            _log_downgrade_event(
+                "pinned_host", "device", "no healthy pinned_host memory space"
+            )
         mode = "device"
     if mode == "device" or (mode == "auto" and not pinned_ok):
         if device_fits:
@@ -175,17 +184,24 @@ def _local_staging_signals(flattened: Dict[str, Any]) -> Dict[str, Any]:
             "Insufficient HBM headroom for device-copy async staging; "
             "falling back to host staging"
         )
-        _log_downgrade_event(
-            "device", "host", "insufficient HBM headroom for device copy"
-        )
+        if emit_events:
+            _log_downgrade_event(
+                "device", "host", "insufficient HBM headroom for device copy"
+            )
         return {"mode": "host", "device_fits": False}
     # auto with pinned_host available, or explicit pinned_host
     return {"mode": "pinned_host", "device_fits": device_fits}
 
 
-def resolve_mode(flattened: Dict[str, Any], pg: Any = None) -> str:
+def resolve_mode(
+    flattened: Dict[str, Any], pg: Any = None, emit_events: bool = False
+) -> str:
     """Resolve the configured mode against this app state and backend.
     Returns the placement that will actually be used.
+
+    Pure by default: ``emit_events=True`` is passed only by the caller
+    that will actually stage (async_take), so downgrade events track real
+    staging decisions rather than every probe/diagnostic query.
 
     For multi-process globally-sharded arrays both the jitted device copy
     and the pinned_host ``device_put`` are LOCKSTEP executions: every
@@ -203,7 +219,7 @@ def resolve_mode(flattened: Dict[str, Any], pg: Any = None) -> str:
     rendezvous; the observed trace-time failure class raises uniformly on
     all ranks anyway, and the per-backend health state feeds the NEXT
     snapshot's agreement so the fleet re-aligns."""
-    signals = _local_staging_signals(flattened)
+    signals = _local_staging_signals(flattened, emit_events=emit_events)
     mode = signals["mode"]
     if pg is not None and pg.get_world_size() > 1:
         gathered = pg.all_gather_object(signals)
@@ -233,10 +249,12 @@ def resolve_mode(flattened: Dict[str, Any], pg: Any = None) -> str:
             )
             # Same operator visibility as every other downgrade: a rank
             # persistently forced off its preferred mode by a peer is a
-            # stall-time regression the event stream must carry.
-            _log_downgrade_event(
-                mode, agreed, f"cross-rank agreement (gathered: {modes})"
-            )
+            # stall-time regression the event stream must carry — but only
+            # when this resolution feeds an actual staging.
+            if emit_events:
+                _log_downgrade_event(
+                    mode, agreed, f"cross-rank agreement (gathered: {modes})"
+                )
         mode = agreed
     return mode
 

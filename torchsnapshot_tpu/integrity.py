@@ -8,6 +8,13 @@ consumer receives a payload in full (whole-file reads, slab byte-ranges,
 sharded pieces).  Tiled partial reads skip verification.  Disable with
 ``TPUSNAP_CHECKSUM=0``.  Checksums are silently skipped when the native
 library is unavailable; restore only verifies entries that carry a digest.
+
+Digests cover the bytes **as stored**: for compressed entries
+(compression.py) that is the framed compressed payload — exactly what is
+on disk — so ``verify``/``audit``, the read-fused xxh64 path, and
+incremental dedup's comparisons all work without decompressing anything,
+and corruption inside a frame surfaces as :class:`ChecksumError` before
+the decoder ever runs.
 """
 
 from __future__ import annotations

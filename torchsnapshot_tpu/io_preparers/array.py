@@ -26,13 +26,34 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from .. import serialization, staging
+from .. import knobs, serialization, staging
+from ..compression import is_framed
 from ..io_types import BufferConsumer, BufferStager, BufferType, Future, ReadReq, WriteReq
 from ..manifest import TensorEntry
 from ..serialization import Serializer
 
 
 _INTO_PLACE_MIN_BYTES = 1 << 20
+
+
+def _plan_codec(nbytes: int) -> Optional[str]:
+    """The codec this payload will be framed with, decided at PLAN time
+    (``TPUSNAP_COMPRESSION``), or None for legacy bare bytes.
+
+    Plan time matters: the batcher needs to know a payload's stored size
+    to pre-assign slab offsets, so codec-tagged entries are excluded from
+    slab batching — the decision must exist before batch_write_requests
+    runs.  Payloads under the size floor stay raw (and batchable); a
+    configured codec whose library is missing resolves to raw here, so
+    the whole save degrades to the legacy format, not to framed-raw
+    overhead."""
+    codec, _ = knobs.get_compression()
+    if codec == "raw" or nbytes < knobs.get_compression_min_bytes():
+        return None
+    from .. import compression
+
+    resolved = compression.resolve(codec)
+    return None if resolved == "raw" else resolved
 
 
 class ArrayIOPreparer:
@@ -66,6 +87,14 @@ class ArrayIOPreparer:
             shape=shape,
             replicated=False,
         )
+        if serializer is Serializer.BUFFER_PROTOCOL:
+            # Compression applies only to raw-bytes payloads whose size is
+            # knowable here (dtype×shape); the stager frames at stage time
+            # and may downgrade entry.codec to "raw" (framed, uncompressed)
+            # if the payload turns out incompressible.
+            entry.codec = _plan_codec(
+                serialization.array_nbytes(shape, entry.dtype)
+            )
         write_reqs = [
             WriteReq(
                 path=storage_path,
@@ -131,6 +160,28 @@ class ArrayIOPreparer:
         # Read-into-place: hand storage the assembly's own memory so fs
         # preads land the bytes directly (no allocation, no consume memcpy).
         _into_view = assembly.into_view
+
+        if is_framed(entry):
+            # Framed payloads: byte offsets inside the compressed stream
+            # are meaningless, so neither tiled reads nor read-into-place
+            # apply — one whole-frame read, decompressed by the consumer.
+            read_reqs = [
+                ReadReq(
+                    path=entry.location,
+                    byte_range=entry.byte_range,
+                    buffer_consumer=ArrayBufferConsumer(
+                        assembly=assembly,
+                        flat_offset=0,
+                        nbytes=total_bytes,
+                        checksum=entry.checksum,
+                        location=entry.location,
+                        codec=entry.codec,
+                        frame_nbytes=entry.compressed_nbytes,
+                    ),
+                )
+            ]
+            assembly.expect(1)
+            return read_reqs, assembly.fut
 
         if (
             buffer_size_limit_bytes is None
@@ -224,8 +275,25 @@ class ArrayBufferStager(BufferStager):
                 host = host.copy()
         self._obj = None  # drop the device reference promptly
         mv = serialization.array_as_memoryview(host)
+        if is_framed(self._entry):
+            # Frame (compress) on the scheduler's worker pool so the codec
+            # pass overlaps other stagers' D2H and in-flight storage I/O.
+            # The checksum covers the FRAME — exactly the bytes on disk —
+            # so verify/audit and read-fused hashing need no decompression.
+            frame, inner = await serialization.compress_staged(
+                mv, self._entry.codec, self._level(), executor
+            )
+            del mv, host  # the uncompressed copy is no longer needed
+            self._entry.codec = inner
+            self._entry.compressed_nbytes = len(frame)
+            self._entry.checksum = await integrity.compute_on(frame, executor)
+            return frame
         self._entry.checksum = await integrity.compute_on(mv, executor)
         return mv
+
+    @staticmethod
+    def _level():
+        return knobs.get_compression()[1]
 
     def get_staging_cost_bytes(self) -> int:
         nbytes = serialization.array_nbytes(
@@ -240,6 +308,19 @@ class ArrayBufferStager(BufferStager):
             # time — real memory the budget must see.
             or isinstance(self._obj, _LazyHostSlice)
         ):
+            return nbytes
+        if is_framed(self._entry):
+            # Framing allocates the compressed copy; budget against
+            # max(compressed, uncompressed) = the uncompressed bound (the
+            # incompressible fallback stores raw-in-frame, so the stored
+            # size never exceeds nbytes + the 16-byte header; the scheduler
+            # re-credits down to the actual frame size once staged).  The
+            # compress pass itself transiently holds input + output — up
+            # to ~2x nbytes for an incompressible payload — which the
+            # budget deliberately does not double-charge: the window is
+            # one codec pass per in-flight stager, bounded by the worker
+            # pool width, and double-charging would halve admission for
+            # the common well-compressing case.
             return nbytes
         return 0  # zero-copy view of an existing host array
 
@@ -369,14 +450,22 @@ class H2DBatcher:
     def drain(self) -> None:
         """Flush the tail and block until every dispatched transfer LANDS
         (attributed to ``h2d_land``).  After this, restored arrays are
-        device-resident — the caller's own block_until_ready sees ~0 s."""
-        self.flush()
-        with self._cond:
-            self._raise_lander_error()
-            while self._unlanded_bytes > 0 or self._inflight:
-                self._cond.wait(timeout=1.0)
-                self._raise_lander_error()
-        self.shutdown()
+        device-resident — the caller's own block_until_ready sees ~0 s.
+
+        On a landing failure the error still surfaces here, but only after
+        the remaining dispatched batches finish their landing attempts:
+        drain exits quiescent (byte accounting settled, lander joined)
+        whether it raises or not, so callers never observe mid-landing
+        counters or a still-running lander thread after an error."""
+        try:
+            self.flush()
+        finally:
+            # The lander decrements unlanded bytes even for failed
+            # landings, so this loop terminates regardless of errors.
+            with self._cond:
+                while self._unlanded_bytes > 0 or self._inflight:
+                    self._cond.wait(timeout=1.0)
+            self.shutdown()
         self._raise_lander_error()
 
     def shutdown(self) -> None:
@@ -662,6 +751,8 @@ class ArrayBufferConsumer(BufferConsumer):
         checksum: Optional[str] = None,
         location: str = "",
         into: Optional[memoryview] = None,
+        codec: Optional[str] = None,
+        frame_nbytes: Optional[int] = None,
     ) -> None:
         self._assembly = assembly
         self._flat_offset = flat_offset
@@ -669,6 +760,8 @@ class ArrayBufferConsumer(BufferConsumer):
         self._checksum = checksum
         self._location = location
         self._into = into
+        self._codec = codec
+        self._frame_nbytes = frame_nbytes
         self.precomputed_hash64: Optional[int] = None
         # Tiled reads carry checksum=None (partial payloads are never
         # verified) — don't ask the plugin to hash them.
@@ -682,6 +775,9 @@ class ArrayBufferConsumer(BufferConsumer):
         def _copy() -> None:
             from .. import integrity, phase_stats
 
+            # The checksum covers the stored bytes — for framed payloads,
+            # the compressed frame — so verification precedes decoding and
+            # a corrupt frame fails as ChecksumError before FrameError.
             integrity.verify(
                 buf,
                 self._checksum,
@@ -690,9 +786,14 @@ class ArrayBufferConsumer(BufferConsumer):
             )
             if in_place:
                 return  # storage already read the bytes into the assembly
+            src_buf = buf
+            if self._codec is not None:
+                src_buf = serialization.decompress_staged(
+                    buf, self._nbytes, self._location
+                )
             with phase_stats.timed("consume_copy", self._nbytes):
                 view = self._assembly.flat_u8()
-                src = np.frombuffer(buf, dtype=np.uint8, count=self._nbytes)
+                src = np.frombuffer(src_buf, dtype=np.uint8, count=self._nbytes)
                 view[self._flat_offset : self._flat_offset + self._nbytes] = src
 
         if executor is not None and self._nbytes > 1 << 20:
@@ -702,6 +803,11 @@ class ArrayBufferConsumer(BufferConsumer):
         self._assembly.piece_done()
 
     def get_consuming_cost_bytes(self) -> int:
+        if self._codec is not None:
+            # While decoding, the read frame and the decompressed payload
+            # coexist — charge both (the frame size is recorded in the
+            # manifest; fall back to the uncompressed bound without it).
+            return self._nbytes + (self._frame_nbytes or self._nbytes)
         return self._nbytes
 
 

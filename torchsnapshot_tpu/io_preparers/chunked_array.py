@@ -21,6 +21,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from .. import serialization
+from ..compression import is_framed
 from ..io_types import Future, ReadReq, WriteReq
 from ..manifest import Chunk, ChunkedTensorEntry, Shard, TensorEntry
 from .array import ArrayAssembly, ArrayBufferConsumer, ArrayIOPreparer
@@ -168,7 +169,13 @@ class ChunkedArrayIOPreparer:
             # Read-into-place: dim-0 chunks map to contiguous slices of the
             # assembly, so storage can land the bytes directly (assembly
             # owns the policy — small chunks keep the slab merge path).
-            into = assembly.into_view(flat_offset, nbytes)
+            # Framed (compressed) chunks can't: the stored frame is not the
+            # payload bytes, so they read whole and decompress on consume.
+            into = (
+                None
+                if is_framed(tensor_entry)
+                else assembly.into_view(flat_offset, nbytes)
+            )
             read_reqs.append(
                 ReadReq(
                     path=tensor_entry.location,
@@ -180,6 +187,8 @@ class ChunkedArrayIOPreparer:
                         checksum=tensor_entry.checksum,
                         location=tensor_entry.location,
                         into=into,
+                        codec=tensor_entry.codec,
+                        frame_nbytes=tensor_entry.compressed_nbytes,
                     ),
                     into=into,
                 )

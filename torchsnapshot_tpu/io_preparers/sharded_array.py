@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import knobs, serialization, staging
+from ..compression import is_framed
 from ..io_types import (
     BufferConsumer,
     BufferType,
@@ -225,6 +226,10 @@ class ShardedArrayIOPreparer:
             return None
         if shard.tensor.serializer != Serializer.BUFFER_PROTOCOL.value:
             return None
+        if is_framed(shard.tensor):
+            # Framed piece: the stored bytes are a compression frame, not
+            # the payload — it must be read whole and decoded on consume.
+            return None
         nbytes = serialization.array_nbytes(
             list(shard.sizes), shard.tensor.dtype
         )
@@ -399,8 +404,19 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
             )
             if in_place:
                 return  # storage already read the bytes into the target
+            payload = memoryview(buf)
+            if is_framed(self._piece_entry):
+                # Checksum verified the frame (the stored bytes); decode it
+                # back to the piece's payload before the overlap scatter.
+                payload = serialization.decompress_staged(
+                    buf,
+                    serialization.array_nbytes(
+                        self._piece_sizes, self._piece_entry.dtype
+                    ),
+                    self._piece_entry.location,
+                )
             piece = serialization.array_from_memoryview(
-                memoryview(buf), self._piece_entry.dtype, self._piece_sizes
+                payload, self._piece_entry.dtype, self._piece_sizes
             )
             with phase_stats.timed(
                 "scatter_copy",
@@ -420,4 +436,10 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         self._restore.piece_done()
 
     def get_consuming_cost_bytes(self) -> int:
-        return serialization.array_nbytes(self._piece_sizes, self._piece_entry.dtype)
+        nbytes = serialization.array_nbytes(
+            self._piece_sizes, self._piece_entry.dtype
+        )
+        if is_framed(self._piece_entry):
+            # Frame + decompressed payload coexist during decode.
+            return nbytes + (self._piece_entry.compressed_nbytes or nbytes)
+        return nbytes

@@ -30,6 +30,8 @@ PROGRESS_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "PROGRESS_INTERVAL_S"
 CLOUD_PARALLEL_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "CLOUD_PARALLEL_MIN_BYTES"
 ASYNC_STAGING_ENV_VAR = _ENV_PREFIX + "ASYNC_STAGING"
 PINNED_HOST_RETRY_S_ENV_VAR = _ENV_PREFIX + "PINNED_HOST_RETRY_S"
+COMPRESSION_ENV_VAR = _ENV_PREFIX + "COMPRESSION"
+COMPRESSION_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "COMPRESSION_MIN_BYTES"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -37,6 +39,11 @@ _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
 _DEFAULT_MAX_PER_RANK_IO_CONCURRENCY = 16
 _DEFAULT_MAX_READ_MERGE_GAP_BYTES = 8 * 1024 * 1024
 _DEFAULT_CLOUD_PARALLEL_MIN_BYTES = 64 * 1024 * 1024
+# Payloads below this stay raw even with compression on: tiny leaves keep
+# their slab batching (compressed payloads can't pre-assign slab offsets —
+# their size is unknown at plan time) and skip per-chunk codec overhead
+# that dwarfs any saving at that scale.
+_DEFAULT_COMPRESSION_MIN_BYTES = 64 * 1024
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -130,7 +137,12 @@ def is_sharded_elasticity_root_only_enabled() -> bool:
 
 
 @contextmanager
-def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
+def override_env(name: str, value: Optional[str]) -> Generator[None, None, None]:
+    """Set (or, with ``value=None``, unset) one env var for the block,
+    restoring any pre-existing value on exit — even when the block raises.
+    The primitive under every ``override_*`` knob above; public because
+    benchmarks and test harnesses need the same leak-proof discipline for
+    vars without a dedicated knob."""
     prev = os.environ.get(name)
     try:
         if value is None:
@@ -143,6 +155,10 @@ def _override_env(name: str, value: Optional[str]) -> Generator[None, None, None
             os.environ.pop(name, None)
         else:
             os.environ[name] = prev
+
+
+# Backward-compat alias for the pre-public name.
+_override_env = override_env
 
 
 @contextmanager
@@ -208,11 +224,55 @@ def override_cloud_parallel_min_bytes(value: int) -> Generator[None, None, None]
 
 
 @contextmanager
+def override_compression(value: Optional[str]) -> Generator[None, None, None]:
+    """``codec[:level]`` (``"zstd"``, ``"zlib:6"``) or None to disable."""
+    with _override_env(COMPRESSION_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_compression_min_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(COMPRESSION_MIN_BYTES_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
 def override_async_staging(mode: str) -> Generator[None, None, None]:
     """auto / device / pinned_host / host — where async_take makes the app
     state snapshot-stable before returning (device_staging.py)."""
     with _override_env(ASYNC_STAGING_ENV_VAR, mode):
         yield
+
+
+def get_compression() -> "tuple[str, Optional[int]]":
+    """``(codec_name, level_or_None)`` from ``TPUSNAP_COMPRESSION``.
+
+    Accepts ``<codec>`` or ``<codec>:<level>`` (e.g. ``zstd``, ``zstd:6``,
+    ``zlib:1``).  Unset / empty / ``raw`` / ``none`` / ``0`` all mean "no
+    compression".  The codec name is validated and availability-resolved by
+    ``compression.resolve`` at the point of use, not here — a missing
+    optional library degrades to raw with a warning rather than failing
+    the save."""
+    val = os.environ.get(COMPRESSION_ENV_VAR, "").strip()
+    if not val or val.lower() in ("raw", "none", "off", "0", "false"):
+        return "raw", None
+    codec, _, level = val.partition(":")
+    try:
+        parsed_level = int(level) if level else None
+    except ValueError:
+        raise ValueError(
+            f"{COMPRESSION_ENV_VAR}={val!r}: level {level!r} is not an "
+            "integer (expected <codec> or <codec>:<int level>, e.g. zstd:6)"
+        ) from None
+    return codec.strip().lower(), parsed_level
+
+
+def get_compression_min_bytes() -> int:
+    """Smallest payload the configured codec applies to; smaller chunks
+    stay raw (and slab-batchable)."""
+    return _get_int_env(
+        COMPRESSION_MIN_BYTES_ENV_VAR, _DEFAULT_COMPRESSION_MIN_BYTES
+    )
 
 
 def get_pinned_host_retry_s() -> float:

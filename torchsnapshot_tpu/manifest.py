@@ -40,6 +40,14 @@ class TensorEntry(Entry):
     ``buffer_protocol`` (zero-copy raw bytes) or ``pickle`` (fallback).
     ``byte_range`` is [start, end) within the file at ``location`` when the
     entry was batched into a slab; None means the whole file.
+
+    ``codec`` (compression.py): None = legacy bare bytes (the
+    pre-compression format — old manifests without the field load
+    unchanged); a name (``"zstd"``/``"lz4"``/``"zlib"``/``"raw"``) = the
+    payload is a self-describing compression frame whose header carries
+    the codec actually used.  ``compressed_nbytes`` records the stored
+    frame size (the uncompressed size is already implied by dtype×shape);
+    checksums cover the frame — exactly the bytes on disk.
     """
 
     location: str
@@ -49,6 +57,8 @@ class TensorEntry(Entry):
     replicated: bool
     byte_range: Optional[List[int]] = None
     checksum: Optional[str] = None  # "xxh64:<hex>" of the payload bytes
+    codec: Optional[str] = None
+    compressed_nbytes: Optional[int] = None
 
     def __init__(
         self,
@@ -59,6 +69,8 @@ class TensorEntry(Entry):
         replicated: bool,
         byte_range: Optional[List[int]] = None,
         checksum: Optional[str] = None,
+        codec: Optional[str] = None,
+        compressed_nbytes: Optional[int] = None,
     ) -> None:
         super().__init__(type="Tensor")
         self.location = location
@@ -68,6 +80,8 @@ class TensorEntry(Entry):
         self.replicated = replicated
         self.byte_range = byte_range
         self.checksum = checksum
+        self.codec = codec
+        self.compressed_nbytes = compressed_nbytes
 
     @property
     def byte_range_tuple(self) -> Optional[tuple]:
@@ -345,6 +359,12 @@ def _entry_to_dict(entry: Entry) -> Dict[str, Any]:
             d["byte_range"] = entry.byte_range
         if entry.checksum is not None:
             d["checksum"] = entry.checksum
+        # Emitted only when set: snapshots without compression serialize
+        # byte-identically to the pre-codec format.
+        if entry.codec is not None:
+            d["codec"] = entry.codec
+        if entry.compressed_nbytes is not None:
+            d["compressed_nbytes"] = entry.compressed_nbytes
     elif isinstance(entry, ShardedArrayEntry):
         d.update(
             dtype=entry.dtype,
@@ -404,6 +424,9 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
             replicated=bool(d["replicated"]),
             byte_range=list(d["byte_range"]) if d.get("byte_range") else None,
             checksum=d.get("checksum"),
+            # Absent in pre-compression manifests: None means bare bytes.
+            codec=d.get("codec"),
+            compressed_nbytes=d.get("compressed_nbytes"),
         )
     if typ == "ShardedArray":
         return ShardedArrayEntry(
@@ -454,6 +477,38 @@ def _entry_from_dict(d: Dict[str, Any]) -> Any:
 
 
 MANIFEST_VERSION = "0.1.0"
+# Snapshots containing framed (compressed) payloads declare 0.2.0: a reader
+# that predates the codec subsystem would interpret the stored frame bytes as
+# the array payload — for the raw-in-frame incompressible fallback that is
+# silent corruption shifted by the 16-byte header.  Readers that already
+# shipped can't be retrofitted, but from 0.2.0 on ``from_json`` validates the
+# version, so every FUTURE format change fails old readers with a clear
+# "upgrade to restore" error instead.  Uncompressed snapshots keep declaring
+# 0.1.0 — their on-disk format is byte-identical to the pre-codec one.
+FRAMED_MANIFEST_VERSION = "0.2.0"
+SUPPORTED_MANIFEST_VERSIONS = (MANIFEST_VERSION, FRAMED_MANIFEST_VERSION)
+
+
+def _iter_tensor_entries(manifest: "Manifest"):
+    for entry in manifest.values():
+        if isinstance(entry, TensorEntry):
+            yield entry
+        elif isinstance(entry, ShardedArrayEntry):
+            for shard in entry.shards:
+                yield shard.tensor
+        elif isinstance(entry, ChunkedTensorEntry):
+            for chunk in entry.chunks:
+                yield chunk.tensor
+
+
+def manifest_version_for(manifest: "Manifest") -> str:
+    """The version a manifest must declare: ``FRAMED_MANIFEST_VERSION`` when
+    any payload is frame-encoded, else the base ``MANIFEST_VERSION``."""
+    from .compression import is_framed
+
+    if any(is_framed(t) for t in _iter_tensor_entries(manifest)):
+        return FRAMED_MANIFEST_VERSION
+    return MANIFEST_VERSION
 
 
 @dataclass
@@ -480,8 +535,15 @@ class SnapshotMetadata:
     @classmethod
     def from_json(cls, s: str) -> "SnapshotMetadata":
         d = json.loads(s)
+        version = d["version"]
+        if version not in SUPPORTED_MANIFEST_VERSIONS:
+            raise ValueError(
+                f"Snapshot manifest version {version!r} is newer than this "
+                f"reader supports ({', '.join(SUPPORTED_MANIFEST_VERSIONS)}); "
+                "upgrade torchsnapshot_tpu to restore this snapshot"
+            )
         return cls(
-            version=d["version"],
+            version=version,
             world_size=int(d["world_size"]),
             manifest={
                 path: _entry_from_dict(ed) for path, ed in d["manifest"].items()

@@ -25,9 +25,21 @@ _lock = threading.Lock()
 _stats: Dict[str, Dict[str, float]] = {}
 _intervals: Dict[str, List[Tuple[float, float]]] = {}
 # Wall-union seconds of intervals retired from _intervals by compaction
-# (see add): the retired prefix is disjoint from everything newer, so
-# wall = base + union(live list) stays EXACT while the list stays bounded.
+# (see add).  Only intervals ending BEFORE the low-water mark of in-flight
+# timed() begins are retired, so no timed() block still running can later
+# append an interval overlapping the retired region — wall = base +
+# union(live list) stays exact for timed() blocks.  Raw add() callers
+# construct their interval retroactively (begin = end - seconds) without
+# registering a begin; a long raw-add interval recorded after a compaction
+# can still overlap the retired base and overstate wall slightly — the
+# known raw-add sites (h2d dispatch accounting) are short.
 _wall_base: Dict[str, float] = {}
+# begin timestamps of in-flight timed() blocks, keyed per phase
+# (phase -> {token -> begin}): each phase's compaction low-water mark.
+# Per-phase so one long-running block (a multi-minute fs_write on a huge
+# payload) only stalls retirement for ITS phase — unrelated phases keep
+# compacting and their lists stay bounded.
+_active_begins: Dict[str, Dict[object, float]] = {}
 
 
 # Compact a phase's interval list (exact union-merge) when it grows past
@@ -44,13 +56,22 @@ def add(
     seconds: float,
     nbytes: int = 0,
     end: Optional[float] = None,
+    _release_token: Optional[object] = None,
 ) -> None:
     """Record one occurrence of ``phase``.  ``end`` (a ``time.monotonic``
     stamp; defaults to now) anchors the occurrence's interval for the
-    wall-union computation."""
+    wall-union computation.  ``_release_token`` (timed() internal) retires
+    the block's active-begin registration in the same critical section as
+    the append, so compaction can never observe the gap between them."""
     if end is None:
         end = time.monotonic()
     with _lock:
+        if _release_token is not None:
+            actives = _active_begins.get(phase)
+            if actives is not None:
+                actives.pop(_release_token, None)
+                if not actives:
+                    del _active_begins[phase]
         slot = _stats.setdefault(phase, {"s": 0.0, "bytes": 0, "n": 0})
         slot["s"] += seconds
         slot["bytes"] += nbytes
@@ -62,29 +83,41 @@ def add(
             if len(merged) >= _COMPACT_THRESHOLD // 2:
                 # Exact merge couldn't shrink (disjoint intervals — e.g.
                 # periodic snapshots in a week-long trainer): retire the
-                # oldest intervals into the phase's wall base.  They are
-                # disjoint from everything newer (sorted, disjoint list),
-                # so the reported wall stays exact while the list — and
-                # every snapshot()'s sort under the global lock — stays
-                # bounded.  (Closing gaps instead would overstate the wall
-                # by the closed gaps: ~the whole run for evenly spaced
-                # checkpoints.)
+                # oldest intervals into the phase's wall base, but only
+                # those ending before the earliest still-running timed()
+                # begin — a long concurrent block that started before the
+                # retired region will eventually append an interval
+                # reaching back there, and retiring past its begin would
+                # double-count that wall.  (Closing gaps instead would
+                # overstate the wall by the closed gaps: ~the whole run
+                # for evenly spaced checkpoints.)
                 keep = _COMPACT_THRESHOLD // 4
-                retired, merged = merged[:-keep], merged[-keep:]
-                _wall_base[phase] = _wall_base.get(phase, 0.0) + sum(
-                    e - b for b, e in retired
+                low_water = min(
+                    _active_begins.get(phase, {}).values(), default=float("inf")
                 )
+                retire_n = min(
+                    len(merged) - keep,
+                    sum(1 for _, e in merged if e <= low_water),
+                )
+                if retire_n > 0:
+                    retired, merged = merged[:retire_n], merged[retire_n:]
+                    _wall_base[phase] = _wall_base.get(phase, 0.0) + sum(
+                        e - b for b, e in retired
+                    )
             _intervals[phase] = merged
 
 
 @contextmanager
 def timed(phase: str, nbytes: int = 0) -> Generator[None, None, None]:
     begin = time.monotonic()
+    token = object()
+    with _lock:
+        _active_begins.setdefault(phase, {})[token] = begin
     try:
         yield
     finally:
         end = time.monotonic()
-        add(phase, end - begin, nbytes, end=end)
+        add(phase, end - begin, nbytes, end=end, _release_token=token)
 
 
 def _merge(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:

@@ -4,11 +4,19 @@ TPU-native analogue of the reference's ``torchsnapshot/scheduler.py``
 (/root/reference/torchsnapshot/scheduler.py:222-463) — the performance core.
 
 Write path: each request moves ready_for_staging → staging → ready_for_io →
-io.  Staging (HBM→host DMA + serialization) is admitted while its declared
-cost fits the remaining memory budget, with an always-admit-one starvation
-guard (reference scheduler.py:266-277).  The budget is debited by staging
-cost, re-credited down to the actual buffer size once staged, and fully
-re-credited after the write lands (reference scheduler.py:303-320).  Storage
+io.  Staging (HBM→host DMA + serialization + optional chunk compression,
+compression.py) is admitted while its declared cost fits the remaining
+memory budget, with an always-admit-one starvation guard (reference
+scheduler.py:266-277).  The budget is debited by staging cost — for
+compressed payloads max(compressed, uncompressed), i.e. the uncompressed
+bound, since the frame never exceeds it beyond the 16-byte header —
+re-credited down to the actual buffer size once staged (which is where a
+good compression ratio hands budget back to waiting stagers), and fully
+re-credited after the write lands (reference scheduler.py:303-320).
+Compression runs inside ``stage_buffer`` on this pipeline's worker pool
+(the executor below): the C codecs release the GIL, so one payload's
+compress pass overlaps other payloads' D2H DMAs and in-flight storage
+writes.  Storage
 I/O concurrency is capped (16 by default, knobs).  ``execute_write_reqs``
 returns a :class:`PendingIOWork` as soon as **staging** is complete — the
 async-snapshot early-return point (reference scheduler.py:332-339): training
@@ -313,7 +321,10 @@ async def execute_write_reqs(
     def on_staged(pipeline: _WritePipeline) -> None:
         # Re-credit the delta between declared cost and actual buffer size
         # (reference scheduler.py:303-312); the buffer itself stays debited
-        # until its write completes.
+        # until its write completes.  Compressed payloads declare their
+        # uncompressed bound and stage down to the frame size, so the
+        # ratio is returned to the budget here (an incompressible frame's
+        # 16-byte header makes the delta fractionally negative — harmless).
         nonlocal staged_bytes
         budget.remaining += pipeline.staging_cost - pipeline.buf_sz_bytes
         budget.inflight -= 1

@@ -9,6 +9,10 @@ dtypes backed by ``ml_dtypes``, and the escape hatch is a zero-copy
 
 All functions operate on **host** numpy arrays; device arrays are staged to
 host by the io_preparer layer (the D2H boundary) before reaching these codecs.
+
+Buffer staging is compression-aware: :func:`compress_staged` /
+:func:`decompress_staged` bridge the array codecs to the chunk-compression
+frame layer (compression.py) for entries whose manifest records a codec.
 """
 
 from __future__ import annotations
@@ -109,6 +113,10 @@ def array_as_memoryview(arr: np.ndarray) -> memoryview:
     The array must be C-contiguous; callers stage device arrays into fresh
     host buffers, which are always contiguous.
     """
+    if arr.size == 0:
+        # memoryview.cast rejects views with zeros in shape/strides; an
+        # empty array's payload is simply no bytes.
+        return memoryview(b"")
     if not arr.flags["C_CONTIGUOUS"]:
         arr = np.ascontiguousarray(arr)
     if arr.dtype in _EXTENSION_DTYPES:
@@ -125,6 +133,43 @@ def array_from_memoryview(
     serialization.py:254-266).  The returned array aliases ``mv``."""
     np_dtype = string_to_dtype(dtype)
     return np.frombuffer(mv, dtype=np_dtype).reshape(shape)
+
+
+async def compress_staged(
+    buf, codec: str, level: Any = None, executor: Any = None
+):
+    """Compression-aware buffer staging: frame ``buf`` with ``codec``
+    (compression.py), returning ``(frame_bytes, inner_codec_name)``.
+
+    Large payloads compress on the scheduler's worker pool (the C codecs
+    release the GIL) so compression overlaps concurrent stagers' D2H DMAs
+    and in-flight storage writes instead of serializing on the event loop —
+    the same discipline as the checksum (integrity.compute_on)."""
+    from . import compression
+
+    mv = memoryview(buf)
+    if executor is not None and mv.nbytes > _INLINE_COMPRESS_MAX_BYTES:
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            executor, compression.encode, buf, codec, level
+        )
+    return compression.encode(buf, codec, level)
+
+
+# Below this the executor round-trip costs more than the codec pass itself
+# (same rationale as integrity._INLINE_DIGEST_MAX_BYTES).
+_INLINE_COMPRESS_MAX_BYTES = 1 << 20
+
+
+def decompress_staged(buf, expected_nbytes: int, location: str = "") -> memoryview:
+    """Decode one compression frame back to payload bytes, validating the
+    recorded uncompressed length against what the manifest implies.  The
+    inverse of :func:`compress_staged`; raises ``compression.FrameError``
+    on truncation/corruption — a clean, typed restore failure."""
+    from . import compression
+
+    return compression.decode(buf, expected_nbytes=expected_nbytes, location=location)
 
 
 def pickle_save_as_bytes(obj: Any) -> bytes:

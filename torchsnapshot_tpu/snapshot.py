@@ -47,7 +47,7 @@ from .manifest import (
     Manifest,
     PrimitiveEntry,
     SnapshotMetadata,
-    MANIFEST_VERSION,
+    manifest_version_for,
 )
 from .manifest_ops import get_manifest_for_rank, handle_sharded_array_elasticity
 from .manifest_utils import is_container_entry
@@ -286,7 +286,11 @@ class Snapshot:
             # collective executions for globally-sharded arrays, so every
             # rank must pick the SAME mode (most conservative wins).
             staging_mode = device_staging.resolve_mode(
-                flattened, pg=pg if world_size > 1 else None
+                flattened,
+                pg=pg if world_size > 1 else None,
+                # This resolution feeds an actual staging: downgrade events
+                # fire here (and only here — probes resolve silently).
+                emit_events=True,
             )
             if staging_mode != "host":
                 try:
@@ -382,7 +386,7 @@ class Snapshot:
         # the main thread — collectives are forbidden off it.
         global_manifest = cls._gather_manifest(entries, pg)
         metadata = SnapshotMetadata(
-            version=MANIFEST_VERSION,
+            version=manifest_version_for(global_manifest),
             world_size=world_size,
             manifest=global_manifest,
         )
@@ -395,7 +399,16 @@ class Snapshot:
 
         ``strict=False`` is forwarded to any stateful whose
         ``load_state_dict`` accepts it (reference :775-778) — useful for
-        partial restores into modules with extra/missing keys."""
+        partial restores into modules with extra/missing keys.
+
+        On-device contract: dense and chunked array uploads are drained
+        before return (H2DBatcher.drain — their bytes are ON DEVICE, with
+        the landing wall attributed to ``h2d_land``).  **Sharded-array
+        entries are excluded**: their per-device uploads are dispatched and
+        deliberately left in flight so a multichip restore overlaps the
+        next stateful's reads; callers that need sharded state resident
+        before proceeding should ``jax.block_until_ready`` it (the usual
+        first collective does this implicitly)."""
         self._validate_app_state(app_state)
         pg = self._pg
         rank = pg.get_rank()
@@ -523,11 +536,13 @@ class Snapshot:
                 rank=rank,
             )
             # Flush the tail AND wait for every H2D transfer to land:
-            # restore's contract is "state is on device when we return", and
-            # the landing time belongs to restore's own phase record
-            # (h2d_land), not to whatever the caller happens to block on
-            # next (r04 verdict: 159 s of restore wall invisible to every
-            # phase).
+            # restore's contract is "dense/chunked state is on device when
+            # we return", and the landing time belongs to restore's own
+            # phase record (h2d_land), not to whatever the caller happens
+            # to block on next (r04 verdict: 159 s of restore wall
+            # invisible to every phase).  Sharded-array uploads do NOT go
+            # through this batcher (io_preparer.prepare_read) and stay in
+            # flight by design — see restore()'s docstring.
             h2d_batch.drain()
         finally:
             # Idempotent after drain; on a pipeline abort it stops the
@@ -846,7 +861,7 @@ class _ManifestFinalizer:
         from .io_types import WriteIO
 
         payload = SnapshotMetadata(
-            version=MANIFEST_VERSION,
+            version=manifest_version_for(self._entries),
             world_size=self._world_size,
             manifest=self._entries,
         ).to_json()
@@ -877,7 +892,7 @@ class _ManifestFinalizer:
             for logical_path, entry in rank_entries.items():
                 global_manifest[f"{rank}/{logical_path}"] = entry
         return SnapshotMetadata(
-            version=MANIFEST_VERSION,
+            version=manifest_version_for(global_manifest),
             world_size=self._world_size,
             manifest=global_manifest,
         )
