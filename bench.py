@@ -137,7 +137,9 @@ def _maybe_rerun_on_tpu(cpu_result: dict) -> dict:
     env["BENCH_DEVICE_TIMEOUT_S"] = "60"
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
+            # Forward flags (--telemetry) so the re-run measures the same
+            # configuration the CPU pass did.
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
             timeout=max(remaining - 5, 60),
             capture_output=True,
             text=True,
@@ -258,6 +260,11 @@ def _install_watchdog() -> None:
 
 def main() -> None:
     import jax
+
+    # --telemetry: assert the save produced a telemetry sidecar
+    # (telemetry/sidecar.py) and embed its summary in the result aux — the
+    # CI hook that keeps the observability path exercised end to end.
+    telemetry_enabled = "--telemetry" in sys.argv[1:]
 
     _install_watchdog()
     devices = _init_devices()
@@ -499,6 +506,38 @@ def main() -> None:
     save_s = min(save_attempts_s)
     save_gbps = actual_bytes / 1e9 / save_s
     bytes_written = _dir_bytes(os.path.join(workdir, "snap"))
+
+    telemetry_sidecar = None
+    if telemetry_enabled:
+        from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+        from torchsnapshot_tpu.telemetry import sidecar as _sidecar
+
+        _storage = url_to_storage_plugin(os.path.join(workdir, "snap"))
+        try:
+            _docs = [
+                d
+                for d in _sidecar.read_all(_storage)
+                if d.get("action") == "take"
+            ]
+        finally:
+            _storage.sync_close()
+        if not _docs:
+            raise RuntimeError(
+                "--telemetry: the save produced no telemetry sidecar "
+                "(is TPUSNAP_SIDECAR=0 set?)"
+            )
+        doc = _docs[0]  # newest (last attempt's) take
+        telemetry_sidecar = {
+            "path": _sidecar.sidecar_path(
+                doc["action"], doc["op_id"], doc["rank"]
+            ),
+            "duration_s": doc.get("duration_s"),
+            "bytes": doc.get("bytes"),
+            "throughput_gbps": doc.get("throughput_gbps"),
+            "phases": doc.get("phases"),
+            "knobs": doc.get("knobs"),
+        }
+        log(f"telemetry sidecar: {telemetry_sidecar['path']}")
     log(f"sync save: {save_s:.2f}s -> {save_gbps:.2f} GB/s (runs: {save_attempts_s})")
     log(f"  save phases (best attempt): {phase_stats.format_line(save_phases)}")
     log(f"  bytes written: {bytes_written / 1e9:.3f} GB for {actual_bytes / 1e9:.3f} GB of state")
@@ -742,6 +781,7 @@ def main() -> None:
             "state_gib": round(gib, 2),
             "attempts": attempts,
             "bytes_written": bytes_written,
+            "telemetry_sidecar": telemetry_sidecar,
             "compression_probe": compression_probe,
             "sync_save_s": round(save_s, 2),
             "sync_save_worst_s": round(max(save_attempts_s), 2),

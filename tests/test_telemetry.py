@@ -1,0 +1,397 @@
+"""Telemetry subsystem tests: the event pipeline (entry-point discovery,
+in-process registration, failure isolation), the metrics bridge, the span
+tracer (schema-validated trace-event JSON), per-snapshot sidecars, the
+stats/trace CLI, and the phase_stats raw-add wall clamp."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, event_handlers, knobs, phase_stats
+from torchsnapshot_tpu.event import Event
+from torchsnapshot_tpu.telemetry import metrics, sidecar, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts and ends with a pristine registry/bridge/cache,
+    and in-process handlers registered inside a test never leak out."""
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+    event_handlers.reset_handlers_cache()
+    saved_handlers = list(event_handlers._INPROCESS_HANDLERS)
+    yield
+    event_handlers._INPROCESS_HANDLERS[:] = saved_handlers
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+    event_handlers.reset_handlers_cache()
+
+
+def _capture_events():
+    events = []
+    event_handlers.register_event_handler(events.append)
+    return events
+
+
+# ------------------------------------------------------------ event pipeline
+
+
+def test_register_unregister_inprocess_handler():
+    events = _capture_events()
+    event_handlers.log_event(Event(name="unit.one"))
+    event_handlers.unregister_event_handler(events.append)
+    event_handlers.log_event(Event(name="unit.two"))
+    assert [e.name for e in events] == ["unit.one"]
+
+
+def test_raising_handler_does_not_starve_others():
+    seen = []
+
+    def bad(_event):
+        raise RuntimeError("boom")
+
+    event_handlers.register_event_handler(bad)
+    event_handlers.register_event_handler(seen.append)
+    try:
+        event_handlers.log_event(Event(name="unit.isolated"))
+    finally:
+        event_handlers.unregister_event_handler(bad)
+        event_handlers.unregister_event_handler(seen.append)
+    assert [e.name for e in seen] == ["unit.isolated"]
+
+
+def test_entry_point_discovery_and_cache_reset(monkeypatch):
+    """Entry-point handlers register lazily; handlers installed after the
+    first log_event are invisible until reset_handlers_cache()."""
+    calls = []
+
+    class _FakeEP:
+        name = "fake"
+
+        @staticmethod
+        def load():
+            return calls.append
+
+    eps = []
+
+    def fake_entry_points(group=None):
+        assert group == "torchsnapshot_tpu.event_handlers"
+        return list(eps)
+
+    monkeypatch.setattr(event_handlers, "entry_points", fake_entry_points)
+    event_handlers.log_event(Event(name="ep.before"))  # caches empty set
+    eps.append(_FakeEP)
+    event_handlers.log_event(Event(name="ep.ignored"))
+    assert calls == []  # cached: late entry point silently ignored...
+    event_handlers.reset_handlers_cache()
+    event_handlers.log_event(Event(name="ep.seen"))  # ...until the reset
+    assert [e.name for e in calls] == ["ep.seen"]
+
+    class _BrokenEP:
+        name = "broken"
+
+        @staticmethod
+        def load():
+            raise ImportError("missing dep")
+
+    eps.append(_BrokenEP)
+    event_handlers.reset_handlers_cache()
+    # A broken entry point is isolated; the good one still fires.
+    event_handlers.log_event(Event(name="ep.resilient"))
+    assert [e.name for e in calls] == ["ep.seen", "ep.resilient"]
+
+
+# ------------------------------------------------------------ metrics bridge
+
+
+def test_metrics_bridge_counts_operations(tmp_path):
+    with knobs.override_metrics(True):
+        state = {"m": StateDict({"w": jnp.ones((32, 16), jnp.float32)})}
+        snap = Snapshot.take(str(tmp_path / "snap"), state)
+        snap.restore({"m": StateDict({"w": jnp.zeros((32, 16), jnp.float32)})})
+        snap.read_object("0/m/w")
+        ops = metrics.counter("tpusnap_operations_total")
+        assert ops.get(action="take", outcome="success") == 1
+        assert ops.get(action="restore", outcome="success") == 1
+        assert ops.get(action="read_object", outcome="success") == 1
+        open_ops = metrics.gauge("tpusnap_open_operations")
+        for action in ("take", "restore", "read_object"):
+            assert open_ops.get(action=action) == 0, f"leaked span: {action}"
+        # Duration histograms saw every op; bytes flowed through storage.
+        dur = metrics.histogram("tpusnap_operation_duration_seconds")
+        assert dur.get(action="take") == 1
+        written = metrics.counter("tpusnap_storage_bytes_written_total")
+        assert written.get() >= 32 * 16 * 4
+
+
+def test_metrics_bridge_failed_op_has_terminal_event(tmp_path):
+    events = _capture_events()
+    with knobs.override_metrics(True):
+        with pytest.raises(RuntimeError):
+            Snapshot(str(tmp_path / "nonexistent")).restore(
+                {"m": StateDict({"w": np.zeros(4)})}
+            )
+        assert metrics.counter("tpusnap_operations_total").get(
+            action="restore", outcome="error"
+        ) == 1
+        assert metrics.gauge("tpusnap_open_operations").get(action="restore") == 0
+    ends = [e for e in events if e.name == "restore.end"]
+    assert len(ends) == 1
+    assert ends[0].metadata["is_success"] is False
+    assert "duration_s" in ends[0].metadata
+
+
+def test_async_take_early_raise_emits_terminal_event(tmp_path):
+    """async_take.start must get its matching .end even when validation
+    raises before a background thread exists (the old leak)."""
+    events = _capture_events()
+    with pytest.raises(TypeError):
+        Snapshot.async_take(str(tmp_path / "s"), {"bad": object()})
+    names = [e.name for e in events]
+    assert "async_take.start" in names
+    ends = [e for e in events if e.name == "async_take.end"]
+    assert len(ends) == 1
+    assert ends[0].metadata["is_success"] is False
+    assert "duration_s" in ends[0].metadata
+
+
+def test_read_object_end_carries_bytes_and_duration(tmp_path):
+    events = _capture_events()
+    state = {"m": StateDict({"w": np.arange(64, dtype=np.float32)})}
+    snap = Snapshot.take(str(tmp_path / "snap"), state)
+    snap.read_object("0/m/w")
+    ends = [e for e in events if e.name == "read_object.end"]
+    assert len(ends) == 1
+    assert ends[0].metadata["bytes"] == 64 * 4
+    assert "duration_s" in ends[0].metadata
+
+
+def test_prometheus_exposition_format():
+    with knobs.override_metrics(True):
+        metrics.counter("t_total", "help text").inc(3, kind="a")
+        metrics.gauge("t_gauge").set(1.5)
+        hist = metrics.histogram("t_seconds", buckets=(1.0, 10.0, 100.0))
+        hist.observe(2.0)
+        # A value under EVERY bucket bound must still count once per
+        # bucket cumulatively (le=1 ⊆ le=10 ⊆ le=100 ⊆ +Inf), never
+        # double-accumulate.
+        hist.observe(0.5)
+        text = metrics.render_prometheus()
+    assert '# TYPE t_total counter' in text
+    assert 't_total{kind="a"} 3' in text
+    assert "t_gauge 1.5" in text
+    assert 't_seconds_bucket{le="1.0"} 1' in text
+    assert 't_seconds_bucket{le="10.0"} 2' in text
+    assert 't_seconds_bucket{le="100.0"} 2' in text
+    assert 't_seconds_bucket{le="+Inf"} 2' in text
+    assert "t_seconds_sum 2.5" in text
+    assert "t_seconds_count 2" in text
+
+
+# -------------------------------------------------------------- span tracer
+
+
+def test_traced_take_on_memory_plugin_emits_valid_trace(tmp_path):
+    """The fast smoke test: a traced take on the memory storage plugin
+    produces schema-valid trace-event JSON whose span tree covers the
+    pipeline phases (validated structurally, not by string matching)."""
+    from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+
+    trace_dir = tmp_path / "traces"
+    state = {
+        "m": StateDict(
+            {
+                # jax array => d2h; a set is no flatten container and no
+                # primitive, so it pickles => serialize; zlib (stdlib,
+                # always present) => compress.
+                "w": jnp.ones((64, 1024), jnp.float32),
+                "obj": set(range(100)),
+            }
+        )
+    }
+    try:
+        with knobs.override_trace_dir(str(trace_dir)), knobs.override_compression(
+            "zlib:1"
+        ), knobs.override_compression_min_bytes(1024):
+            Snapshot.take("memory://trace_smoke", state)
+        files = sorted(trace_dir.glob("take-*" + trace.TRACE_FILE_SUFFIX))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert trace.validate_trace(doc) == []
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in spans}
+        # The acceptance span set: device transfer, serialization,
+        # checksum, compression, storage write, plus the op skeleton.
+        for required in (
+            "take",
+            "flatten",
+            "plan",
+            "d2h",
+            "serialize",
+            "checksum",
+            "compress",
+            "mem_write",
+            "write_staging",
+        ):
+            assert required in names, f"missing span {required!r}: {sorted(names)}"
+        # Spans carry op + byte metadata; the op root is the take span.
+        op_ids = {e["args"].get("op") for e in spans if "args" in e}
+        assert len(op_ids) == 1
+        compress_spans = [e for e in spans if e["name"] == "compress"]
+        assert any(e["args"].get("bytes", 0) > 0 for e in compress_spans)
+    finally:
+        MemoryStoragePlugin.reset("trace_smoke")
+
+
+def test_trace_disabled_records_nothing(tmp_path):
+    assert trace.begin_op("take", "abc", 0) is None
+    with trace.span("unit"):  # no active op: shared no-op
+        pass
+    state = {"m": StateDict({"w": np.ones(8, np.float32)})}
+    Snapshot.take(str(tmp_path / "snap"), state)
+    # No trace dir was configured, so nothing was written anywhere under
+    # the snapshot either.
+    assert not list(tmp_path.glob("**/*" + trace.TRACE_FILE_SUFFIX))
+
+
+def test_trace_validate_rejects_malformed():
+    assert trace.validate_trace([]) != []
+    assert trace.validate_trace({"traceEvents": "nope"}) != []
+    bad_event = {"traceEvents": [{"name": "x", "ph": "X", "ts": 1}]}
+    assert any("pid" in p for p in trace.validate_trace(bad_event))
+    ok = {
+        "traceEvents": [
+            {"name": "x", "ph": "X", "ts": 1.0, "dur": 2.0, "pid": 0, "tid": 0}
+        ]
+    }
+    assert trace.validate_trace(ok) == []
+
+
+def test_trace_cli_merges_and_validates(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main as cli_main
+
+    trace_dir = tmp_path / "traces"
+    state = {"m": StateDict({"w": np.ones((16, 16), np.float32)})}
+    with knobs.override_trace_dir(str(trace_dir)):
+        snap = Snapshot.take(str(tmp_path / "snap"), state)
+        snap.restore({"m": StateDict({"w": np.zeros((16, 16), np.float32)})})
+    out = tmp_path / "merged.json"
+    rc = cli_main(["trace", str(trace_dir), "--out", str(out)])
+    assert rc == 0
+    merged = json.loads(out.read_text())
+    assert trace.validate_trace(merged) == []
+    kinds = {s.get("kind") for s in merged["otherData"]["merged_from"]}
+    assert kinds == {"take", "restore"}
+
+
+# ----------------------------------------------------------------- sidecars
+
+
+def test_take_restore_write_sidecars_matching_phase_stats(tmp_path):
+    state = {"m": StateDict({"w": jnp.ones((128, 256), jnp.float32)})}
+    snap_path = tmp_path / "snap"
+    snap = Snapshot.take(str(snap_path), state)
+    snap.restore({"m": StateDict({"w": jnp.zeros((128, 256), jnp.float32)})})
+
+    sidecar_dir = snap_path / sidecar.SIDECAR_DIR
+    docs = {p.name: json.loads(p.read_text()) for p in sidecar_dir.glob("*.json")}
+    takes = [d for d in docs.values() if d["action"] == "take"]
+    restores = [d for d in docs.values() if d["action"] == "restore"]
+    assert len(takes) == 1 and len(restores) == 1
+
+    take_doc = takes[0]
+    assert take_doc["schema_version"] == sidecar.SCHEMA_VERSION
+    assert take_doc["success"] is True
+    assert take_doc["rank"] == 0
+    assert take_doc["bytes"] == 128 * 256 * 4
+    assert take_doc["duration_s"] > 0
+    # Sidecar phases ARE a phase_stats delta: the storage write phase must
+    # account for at least the payload bytes, within rounding.
+    fs_write = take_doc["phases"].get("fs_write")
+    assert fs_write is not None
+    assert fs_write["bytes"] >= 128 * 256 * 4
+    assert 0 < fs_write["wall"] <= take_doc["duration_s"]
+    # Knob values captured for longitudinal diffs.
+    assert take_doc["knobs"]["compression"] == "raw"
+    assert take_doc["knobs"]["max_per_rank_io_concurrency"] == 16
+
+    restore_doc = restores[0]
+    read_phases = [
+        p for p in restore_doc["phases"] if p in ("fs_read", "consume_copy")
+    ]
+    assert read_phases, restore_doc["phases"]
+
+
+def test_sidecar_opt_out(tmp_path):
+    state = {"m": StateDict({"w": np.ones(16, np.float32)})}
+    with knobs.override_sidecar(False):
+        Snapshot.take(str(tmp_path / "snap"), state)
+    assert not (tmp_path / "snap" / sidecar.SIDECAR_DIR).exists()
+
+
+def test_stats_cli_renders_sidecars(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main as cli_main
+
+    state = {"m": StateDict({"w": np.ones((64, 64), np.float32)})}
+    Snapshot.take(str(tmp_path / "snap"), state)
+    rc = cli_main(["stats", str(tmp_path / "snap")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "take" in out and "1 operation(s) recorded" in out
+    rc = cli_main(["stats", str(tmp_path / "snap"), "--json"])
+    assert rc == 0
+    docs = json.loads(capsys.readouterr().out)
+    assert docs and docs[0]["action"] == "take"
+
+
+# ----------------------------------------------- phase_stats raw-add clamp
+
+
+def test_raw_add_cannot_overstate_wall_past_compaction():
+    """A retroactive raw add() reaching back into the compaction-retired
+    region is clamped at the retired high-water mark (the phase_stats.py
+    known limitation this PR closes)."""
+    phase_stats.reset()
+    try:
+        # Disjoint intervals force retire-don't-merge compaction (same
+        # construction as the periodic-snapshot test in
+        # test_util_modules.py).
+        n = phase_stats._COMPACT_THRESHOLD
+        for i in range(n):
+            phase_stats.add("clamp_phase", 1.0, 10, end=i * 601.0 + 1.0)
+        snap = phase_stats.snapshot()["clamp_phase"]
+        wall_after_compaction = snap["wall"]
+        assert wall_after_compaction == pytest.approx(n * 1.0)
+        # Raw add whose retroactive interval spans the ENTIRE retired
+        # region: pre-fix this double-counted most of the retired base.
+        phase_stats.add("clamp_phase", n * 601.0, 10, end=n * 601.0)
+        wall = phase_stats.snapshot()["clamp_phase"]["wall"]
+        # Exact accounting would be <= n + the unretired tail + the new
+        # interval's unclamped part; the invariant under test is "no
+        # double count": wall can never exceed the true union (n*601).
+        assert wall <= n * 601.0 + 1.0
+        # And the clamp actually bit: without it wall would be near
+        # n + n*601 (the retired base PLUS the whole overlapping span).
+        assert wall < n * 1.0 + n * 601.0 - 100.0
+        # Thread-seconds are untouched by the clamp.
+        assert phase_stats.snapshot()["clamp_phase"]["s"] == pytest.approx(
+            n * 1.0 + n * 601.0
+        )
+    finally:
+        phase_stats.reset()
+
+
+def test_timed_blocks_unaffected_by_clamp():
+    phase_stats.reset()
+    try:
+        with phase_stats.timed("clamp_timed", 100):
+            pass
+        phase_stats.add("clamp_timed", 0.5, 50)
+        stats = phase_stats.snapshot()["clamp_timed"]
+        assert stats["n"] == 2
+        assert stats["bytes"] == 150
+    finally:
+        phase_stats.reset()

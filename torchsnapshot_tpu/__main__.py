@@ -7,6 +7,8 @@
     python -m torchsnapshot_tpu verify <snapshot-url>
     python -m torchsnapshot_tpu diff <snapshot-url-a> <snapshot-url-b>
     python -m torchsnapshot_tpu cp <src-url> <dst-url> [--verify]
+    python -m torchsnapshot_tpu stats <snapshot-url> [--json] [--metrics]
+    python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
 
 Read-only except ``cp``; works against any storage backend URL.  (Beyond
 reference parity: the reference ships no CLI.)
@@ -351,6 +353,78 @@ def cmd_cp(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_stats(args: argparse.Namespace) -> int:
+    """Render a snapshot's telemetry sidecars (telemetry/sidecar.py):
+    per-operation duration, bytes, throughput, and the dominant phases —
+    the longitudinal "where did this save go" record, read back from the
+    snapshot itself."""
+    import json
+
+    from .storage_plugin import url_to_storage_plugin
+    from .telemetry import metrics, sidecar
+
+    storage = url_to_storage_plugin(args.path)
+    try:
+        docs = sidecar.read_all(storage)
+    finally:
+        storage.sync_close()
+    if args.json:
+        print(json.dumps(docs, indent=1))
+    elif not docs:
+        print(
+            "no telemetry sidecars (snapshot predates telemetry, or "
+            "TPUSNAP_SIDECAR=0 at take/restore time)"
+        )
+    else:
+        for doc in docs:
+            print(sidecar.summarize(doc))
+        print(f"{len(docs)} operation(s) recorded")
+    if args.metrics:
+        # The in-process registry (populated if this CLI run itself took
+        # metrics-enabled operations); mostly useful for embedding checks.
+        print(metrics.render_prometheus(), end="")
+    return 0
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """Validate and merge per-rank/per-op trace files from a
+    TPUSNAP_TRACE_DIR into one Perfetto-loadable JSON."""
+    import glob
+    import json
+    import os as _os
+
+    from .telemetry import trace
+
+    paths = sorted(
+        glob.glob(_os.path.join(args.trace_dir, f"*{trace.TRACE_FILE_SUFFIX}"))
+    )
+    if not paths:
+        print(f"no *{trace.TRACE_FILE_SUFFIX} files under {args.trace_dir}")
+        return 2
+    try:
+        merged = trace.merge_trace_files(paths)
+    except ValueError as e:
+        print(f"invalid trace input: {e}")
+        return 1
+    n_spans = sum(1 for ev in merged["traceEvents"] if ev.get("ph") == "X")
+    ops = {}
+    for src in merged["otherData"]["merged_from"]:
+        ops.setdefault(src.get("kind", "?"), 0)
+        ops[src.get("kind", "?")] += 1
+    for path in paths:
+        print(f"  {_os.path.basename(path)}")
+    print(
+        f"merged {len(paths)} trace file(s): "
+        + ", ".join(f"{n}x {k}" for k, n in sorted(ops.items()))
+        + f", {n_spans} spans"
+    )
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+        print(f"wrote {args.out} (open in ui.perfetto.dev or chrome://tracing)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -406,6 +480,27 @@ def main(argv=None) -> int:
         "--concurrency", type=int, default=4, help="concurrent payload copies"
     )
     p.set_defaults(fn=cmd_cp)
+
+    p = sub.add_parser(
+        "stats", help="render a snapshot's telemetry sidecars"
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true", help="dump raw sidecar JSON")
+    p.add_argument(
+        "--metrics",
+        action="store_true",
+        help="also print the in-process Prometheus registry",
+    )
+    p.set_defaults(fn=cmd_stats)
+
+    p = sub.add_parser(
+        "trace", help="validate + merge per-rank Perfetto trace files"
+    )
+    p.add_argument("trace_dir")
+    p.add_argument(
+        "--out", default=None, help="write the merged trace-event JSON here"
+    )
+    p.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -51,7 +51,7 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
-from . import staging
+from . import phase_stats, staging
 from .serialization import PrePickled
 
 logger = logging.getLogger(__name__)
@@ -444,6 +444,9 @@ def stage_app_state(
         "copy_s": time.monotonic() - begin,
         "n_arrays": len(paths),
     }
+    # The on-device copy is the async stall the caller pays — attribute it
+    # like every other pipeline phase so bench/trace/sidecar all see it.
+    phase_stats.add("device_stage", stats["copy_s"], copy_bytes)
     if downgraded_from is not None:
         stats["downgraded_from"] = downgraded_from
         stats["downgrade_reason"] = downgrade_reason

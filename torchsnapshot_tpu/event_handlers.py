@@ -33,6 +33,16 @@ def _get_handlers() -> List[Callable[[Event], None]]:
     return _HANDLERS_CACHE
 
 
+def reset_handlers_cache() -> None:
+    """Drop the entry-point handler cache so the next ``log_event``
+    re-discovers.  Two callers need this: tests isolating the cache, and
+    processes that install entry points after the first event fired —
+    without the reset those handlers would be silently ignored for the
+    process lifetime (the cache is populated exactly once)."""
+    global _HANDLERS_CACHE
+    _HANDLERS_CACHE = None
+
+
 def register_event_handler(handler: Callable[[Event], None]) -> None:
     _INPROCESS_HANDLERS.append(handler)
 

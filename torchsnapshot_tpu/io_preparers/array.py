@@ -245,11 +245,13 @@ class ArrayBufferStager(BufferStager):
         self._is_async_snapshot = is_async_snapshot
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        from .. import integrity
+        from .. import integrity, phase_stats
 
         obj = self._obj
         if self._entry.serializer == Serializer.PICKLE.value:
-            data = serialization.pickle_save_as_bytes(staging.to_host(obj))
+            host = staging.to_host(obj)
+            with phase_stats.timed("serialize", getattr(host, "nbytes", 0)):
+                data = serialization.pickle_save_as_bytes(host)
             self._obj = None
             self._entry.checksum = await integrity.compute_on(data, executor)
             return data
@@ -280,12 +282,16 @@ class ArrayBufferStager(BufferStager):
             # pass overlaps other stagers' D2H and in-flight storage I/O.
             # The checksum covers the FRAME — exactly the bytes on disk —
             # so verify/audit and read-fused hashing need no decompression.
+            uncompressed_nbytes = mv.nbytes
             frame, inner = await serialization.compress_staged(
                 mv, self._entry.codec, self._level(), executor
             )
             del mv, host  # the uncompressed copy is no longer needed
             self._entry.codec = inner
             self._entry.compressed_nbytes = len(frame)
+            from ..telemetry import metrics as tmetrics
+
+            tmetrics.record_codec(inner, uncompressed_nbytes, len(frame))
             self._entry.checksum = await integrity.compute_on(frame, executor)
             return frame
         self._entry.checksum = await integrity.compute_on(mv, executor)

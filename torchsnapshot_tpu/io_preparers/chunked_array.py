@@ -46,7 +46,18 @@ class _LazyHostSlice:
         return (self._stop - self._start,) + tuple(self._base.shape[1:])
 
     def __array__(self, dtype=None, copy=None):
+        import time
+
+        from .. import phase_stats
+
+        begin = time.monotonic()
         out = np.asarray(self._base)[self._start : self._stop]
+        # Attributed as d2h: materializing the cached host copy is where a
+        # host-offloaded chunked array's transfer cost actually lands (the
+        # stager's np.asarray path has no other attribution point).  The
+        # first chunk pays the base array's full read; byte counts are per
+        # slice, so the totals reconcile across all chunks.
+        phase_stats.add("d2h", time.monotonic() - begin, out.nbytes)
         if dtype is not None and out.dtype != np.dtype(dtype):
             out = out.astype(dtype)
         return out

@@ -61,12 +61,19 @@ class ObjectBufferStager(BufferStager):
         self._entry = entry
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        from .. import integrity
+        from .. import integrity, phase_stats
 
         if isinstance(self._obj, serialization.PrePickled):
             data = self._obj.data
         else:
+            import time
+
+            begin = time.monotonic()
             data = serialization.pickle_save_as_bytes(self._obj)
+            # Raw add so the byte count (unknowable before pickling) rides
+            # along; the phase_stats clamp keeps its retroactive interval
+            # honest.
+            phase_stats.add("serialize", time.monotonic() - begin, len(data))
         self._entry.checksum = await integrity.compute_on(data, executor)
         return data
 

@@ -114,25 +114,32 @@ class ShardedArrayIOPreparer:
         obj: Any,
         is_async_snapshot: bool = False,
     ) -> Tuple[ShardedArrayEntry, List[WriteReq]]:
+        from ..telemetry import trace as ttrace
+
         dtype_str = serialization.dtype_to_string(np.dtype(obj.dtype))
         max_shard_sz = knobs.get_max_shard_size_bytes()
         shards: List[Shard] = []
         write_reqs: List[WriteReq] = []
-        for offsets, data in staging.local_shards(obj):
-            sizes = list(data.shape)
-            for p_off, p_sz in _subdivide(offsets, sizes, dtype_str, max_shard_sz):
-                if list(p_off) == list(offsets) and p_sz == sizes:
-                    piece = data  # whole shard: no device slice dispatch
-                else:
-                    piece = data[_box_slices(p_off, p_sz, offsets)]
-                location = cls.storage_path_for_piece(storage_path, p_off)
-                tensor_entry, piece_reqs = ArrayIOPreparer.prepare_write(
-                    storage_path=location,
-                    obj=piece,
-                    is_async_snapshot=is_async_snapshot,
-                )
-                shards.append(Shard(offsets=p_off, sizes=p_sz, tensor=tensor_entry))
-                write_reqs += piece_reqs
+        with ttrace.span("plan_sharded", path=storage_path):
+            for offsets, data in staging.local_shards(obj):
+                sizes = list(data.shape)
+                for p_off, p_sz in _subdivide(
+                    offsets, sizes, dtype_str, max_shard_sz
+                ):
+                    if list(p_off) == list(offsets) and p_sz == sizes:
+                        piece = data  # whole shard: no device slice dispatch
+                    else:
+                        piece = data[_box_slices(p_off, p_sz, offsets)]
+                    location = cls.storage_path_for_piece(storage_path, p_off)
+                    tensor_entry, piece_reqs = ArrayIOPreparer.prepare_write(
+                        storage_path=location,
+                        obj=piece,
+                        is_async_snapshot=is_async_snapshot,
+                    )
+                    shards.append(
+                        Shard(offsets=p_off, sizes=p_sz, tensor=tensor_entry)
+                    )
+                    write_reqs += piece_reqs
 
         spec = staging.partition_spec_of(obj)
         mesh_shape, axis_names, partition_spec = spec if spec else (None, None, None)

@@ -32,6 +32,9 @@ ASYNC_STAGING_ENV_VAR = _ENV_PREFIX + "ASYNC_STAGING"
 PINNED_HOST_RETRY_S_ENV_VAR = _ENV_PREFIX + "PINNED_HOST_RETRY_S"
 COMPRESSION_ENV_VAR = _ENV_PREFIX + "COMPRESSION"
 COMPRESSION_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "COMPRESSION_MIN_BYTES"
+TRACE_DIR_ENV_VAR = _ENV_PREFIX + "TRACE_DIR"
+METRICS_ENV_VAR = _ENV_PREFIX + "METRICS"
+SIDECAR_ENV_VAR = _ENV_PREFIX + "SIDECAR"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -273,6 +276,49 @@ def get_compression_min_bytes() -> int:
     return _get_int_env(
         COMPRESSION_MIN_BYTES_ENV_VAR, _DEFAULT_COMPRESSION_MIN_BYTES
     )
+
+
+def get_trace_dir() -> Optional[str]:
+    """Directory for per-operation Chrome/Perfetto trace files
+    (``telemetry/trace.py``), or None — tracing disabled (the default).
+    Each take/async_take/restore/read_object writes one
+    ``<kind>-<op>-rank<r>.trace.json`` under it."""
+    val = os.environ.get(TRACE_DIR_ENV_VAR, "").strip()
+    return val or None
+
+
+def metrics_enabled() -> bool:
+    """Whether the in-process metrics registry (``telemetry/metrics.py``)
+    records counters/gauges/histograms and the event→metrics bridge is
+    installed.  Off by default — every instrumentation site bails on this
+    check before touching the registry."""
+    return _get_bool_env(METRICS_ENV_VAR)
+
+
+def sidecar_enabled() -> bool:
+    """Whether each take/restore writes a small ``telemetry/<op>.json``
+    summary next to ``.snapshot_metadata`` (``telemetry/sidecar.py``).  On
+    by default (one tiny JSON write per operation); ``TPUSNAP_SIDECAR=0``
+    opts out."""
+    return os.environ.get(SIDECAR_ENV_VAR, "1") not in ("0", "", "false", "False")
+
+
+@contextmanager
+def override_trace_dir(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(TRACE_DIR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_metrics(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(METRICS_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_sidecar(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(SIDECAR_ENV_VAR, "1" if enabled else "0"):
+        yield
 
 
 def get_pinned_host_retry_s() -> float:

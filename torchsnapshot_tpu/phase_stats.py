@@ -30,10 +30,16 @@ _intervals: Dict[str, List[Tuple[float, float]]] = {}
 # append an interval overlapping the retired region — wall = base +
 # union(live list) stays exact for timed() blocks.  Raw add() callers
 # construct their interval retroactively (begin = end - seconds) without
-# registering a begin; a long raw-add interval recorded after a compaction
-# can still overlap the retired base and overstate wall slightly — the
-# known raw-add sites (h2d dispatch accounting) are short.
+# registering a begin; their intervals are clamped at the phase's retired
+# high-water mark (_retired_hwm) so they can never overlap the retired
+# base and overstate wall.  (The clamp can slightly UNDERstate when a raw
+# interval falls into a gap between retired intervals — acceptable: the
+# overstatement was the bug, and the known raw-add sites (h2d dispatch
+# accounting) are short.)
 _wall_base: Dict[str, float] = {}
+# Per-phase end stamp of the newest retired interval: the clamp floor for
+# retroactive raw-add intervals.
+_retired_hwm: Dict[str, float] = {}
 # begin timestamps of in-flight timed() blocks, keyed per phase
 # (phase -> {token -> begin}): each phase's compaction low-water mark.
 # Per-phase so one long-running block (a multi-minute fs_write on a huge
@@ -50,6 +56,18 @@ _active_begins: Dict[str, Dict[object, float]] = {}
 # genuinely has that many disjoint active periods.
 _COMPACT_THRESHOLD = 512
 
+# Telemetry tracer hook (telemetry/trace.py): while a traced operation is
+# collecting, every recorded interval is forwarded as
+# hook(phase, begin_monotonic, end_monotonic, nbytes) and becomes a leaf
+# span.  None (the default) keeps this module telemetry-free: one local
+# read per add().  Installed/removed under the tracer's own lock.
+_trace_hook: Optional[object] = None
+
+
+def set_trace_hook(hook) -> None:
+    global _trace_hook
+    _trace_hook = hook
+
 
 def add(
     phase: str,
@@ -65,6 +83,7 @@ def add(
     the append, so compaction can never observe the gap between them."""
     if end is None:
         end = time.monotonic()
+    begin = end - seconds
     with _lock:
         if _release_token is not None:
             actives = _active_begins.get(phase)
@@ -72,12 +91,24 @@ def add(
                 actives.pop(_release_token, None)
                 if not actives:
                     del _active_begins[phase]
+        else:
+            # Raw add: the retroactive interval may reach back past a
+            # compaction's retired region (whose wall already landed in
+            # _wall_base) — clamp at the retired high-water mark so the
+            # union can't double-count.  timed() blocks are exempt: their
+            # registered begin IS the compaction low-water mark, so their
+            # intervals provably never overlap the retired base.
+            hwm = _retired_hwm.get(phase)
+            if hwm is not None and begin < hwm:
+                begin = min(hwm, end)
         slot = _stats.setdefault(phase, {"s": 0.0, "bytes": 0, "n": 0})
         slot["s"] += seconds
         slot["bytes"] += nbytes
         slot["n"] += 1
         ivs = _intervals.setdefault(phase, [])
-        ivs.append((end - seconds, end))
+        # A fully-clamped interval (begin == end) union-sums to zero and
+        # is appended anyway to keep "n" and interval counts aligned.
+        ivs.append((begin, end))
         if len(ivs) >= _COMPACT_THRESHOLD:
             merged = _merge(ivs)
             if len(merged) >= _COMPACT_THRESHOLD // 2:
@@ -104,7 +135,14 @@ def add(
                     _wall_base[phase] = _wall_base.get(phase, 0.0) + sum(
                         e - b for b, e in retired
                     )
+                    _retired_hwm[phase] = retired[-1][1]
             _intervals[phase] = merged
+    hook = _trace_hook
+    if hook is not None:
+        try:
+            hook(phase, begin, end, nbytes)
+        except Exception:
+            pass  # telemetry must never break the pipeline
 
 
 @contextmanager
@@ -162,6 +200,7 @@ def reset() -> None:
         _stats.clear()
         _intervals.clear()
         _wall_base.clear()
+        _retired_hwm.clear()
 
 
 def delta(before: Dict[str, Dict[str, float]]) -> Dict[str, Dict[str, float]]:

@@ -45,6 +45,8 @@ from typing import Awaitable, Callable, List, Optional
 import psutil
 
 from . import knobs, phase_stats
+from .telemetry import metrics as tmetrics
+from .telemetry import trace as ttrace
 from .io_types import (
     ReadIO,
     ReadReq,
@@ -189,7 +191,10 @@ class PendingIOWork:
         begin = time.monotonic()
         try:
             if self._io_tasks:
-                self._loop.run_until_complete(self._drain())
+                with ttrace.span(
+                    "io_drain", cat="scheduler", n_tasks=len(self._io_tasks)
+                ):
+                    self._loop.run_until_complete(self._drain())
         except BaseException:
             # First failure propagates; cancel and drain the rest so the loop
             # closes clean and staged host buffers release promptly.
@@ -217,8 +222,13 @@ class PendingIOWork:
 
 class _BudgetTracker:
     def __init__(self, budget_bytes: int) -> None:
+        self.total = budget_bytes
         self.remaining = budget_bytes
         self.inflight = 0
+
+    @property
+    def in_use(self) -> int:
+        return self.total - self.remaining
 
 
 class DeferredIOWork:
@@ -292,6 +302,7 @@ async def execute_write_reqs(
                 await pipeline.write_buffer()
             reporter.io_done += 1
             reporter.bytes_done += pipeline.buf_sz_bytes
+            tmetrics.record_io_bytes("written", pipeline.buf_sz_bytes)
         finally:
             # Credit (and release the buffer) on every outcome — success,
             # storage failure, or cancellation during a pipeline teardown —
@@ -337,6 +348,10 @@ async def execute_write_reqs(
         io_pipelines[io_task] = pipeline
         io_task.add_done_callback(io_tasks.discard)
 
+    staging_span = ttrace.span(
+        "write_staging", cat="scheduler", n_reqs=len(write_reqs)
+    )
+    staging_span.__enter__()
     try:
         dispatch_staging()
         # Loop until staging fully drains.  With the io-aware starvation
@@ -367,6 +382,9 @@ async def execute_write_reqs(
                 inflight_io=len(io_tasks),
             )
     except BaseException:
+        import sys
+
+        staging_span.__exit__(*sys.exc_info())
         # Cancel-and-drain every outstanding task before re-raising
         # (reference scheduler.py:299-331 fails clean): no
         # destroyed-pending-task warnings, host buffers released, budget
@@ -397,6 +415,7 @@ async def execute_write_reqs(
             executor.shutdown(wait=False)
         raise
 
+    staging_span.__exit__(None, None, None)
     elapsed = time.monotonic() - reporter._begin
     if staged_bytes and elapsed > 0:
         # End-of-phase throughput line (reference _WriteReporter,
@@ -558,6 +577,8 @@ async def execute_read_reqs(
             else:
                 break
 
+    read_span = ttrace.span("read_pipeline", cat="scheduler", n_reqs=len(read_reqs))
+    read_span.__enter__()
     try:
         dispatch_io()
         while io_tasks or consume_tasks:
@@ -584,6 +605,7 @@ async def execute_read_reqs(
                     budget.inflight -= 1
                     reporter.io_done += 1
                     reporter.bytes_done += pipeline.consuming_cost
+                    tmetrics.record_io_bytes("read", pipeline.consuming_cost)
             dispatch_io()
             reporter.maybe_report(
                 budget,
@@ -591,7 +613,11 @@ async def execute_read_reqs(
                 staging=len(io_tasks),
                 inflight_io=len(consume_tasks),
             )
+        read_span.__exit__(None, None, None)
     except BaseException:
+        import sys
+
+        read_span.__exit__(*sys.exc_info())
         # Mirror the write path: cancel-and-drain outstanding reads/consumes
         # before re-raising, releasing buffers and re-crediting the budget.
         for t in io_tasks | consume_tasks:
@@ -669,6 +695,16 @@ class _ProgressReporter:
         staging: int = 0,
         inflight_io: int = 0,
     ) -> None:
+        # Gauges refresh on every scheduler loop turn, not just on the log
+        # interval — short operations would otherwise never register.  One
+        # env lookup when metrics are off.
+        tmetrics.record_scheduler_state(
+            verb=self.verb,
+            pending=pending,
+            staging=staging,
+            inflight_io=inflight_io,
+            budget_in_use=budget.in_use,
+        )
         if not self._interval_s:
             return
         now = time.monotonic()

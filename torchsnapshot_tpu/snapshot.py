@@ -35,7 +35,10 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from . import io_preparer, knobs, staging
+from . import io_preparer, knobs, phase_stats, staging
+from .telemetry import metrics as tmetrics
+from .telemetry import sidecar as tsidecar
+from .telemetry import trace as ttrace
 from .batcher import batch_read_requests, batch_write_requests
 from .dist_store import LinearBarrier, StorePeerError
 from .event import Event
@@ -106,6 +109,9 @@ class Snapshot:
         configuration overriding env vars (reference snapshot.py:697)."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
+        tmetrics.maybe_install_bridge()
+        trace_op = ttrace.begin_op("take", unique_id, pg.get_rank())
+        phases_before = phase_stats.snapshot()
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
         log_event(Event(name="take.start", metadata=dict(event_metadata)))
         begin = time.monotonic()
@@ -137,17 +143,37 @@ class Snapshot:
                 if pg.get_rank() == 0:
                     cls._write_snapshot_metadata(metadata, storage)
                 pg.barrier()
+                # Committed: persist this rank's telemetry summary next to
+                # the payloads it describes (best-effort, opt-out via
+                # TPUSNAP_SIDECAR=0).
+                if tsidecar.enabled():
+                    tsidecar.write(
+                        storage,
+                        tsidecar.build(
+                            action="take",
+                            unique_id=unique_id,
+                            rank=pg.get_rank(),
+                            duration_s=time.monotonic() - begin,
+                            phases=phase_stats.delta(phases_before),
+                            nbytes=pending_io_work.bytes_total,
+                            extra={"world_size": pg.get_world_size()},
+                        ),
+                    )
             finally:
                 storage.sync_close()
             snapshot = cls(path=path, pg=pg, storage_options=storage_options)
             snapshot._metadata = metadata
             event_metadata["duration_s"] = time.monotonic() - begin
+            event_metadata["bytes"] = pending_io_work.bytes_total
             event_metadata["is_success"] = True
             log_event(Event(name="take.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=True)
             return snapshot
         except Exception:
+            event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="take.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=False)
             raise
 
     @classmethod
@@ -181,6 +207,9 @@ class Snapshot:
         device-resident is donation-safe the moment this returns."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
+        tmetrics.maybe_install_bridge()
+        trace_op = ttrace.begin_op("async_take", unique_id, pg.get_rank())
+        phases_before = phase_stats.snapshot()
         event_metadata = {
             "unique_id": unique_id,
             "rank": pg.get_rank(),
@@ -188,28 +217,39 @@ class Snapshot:
         }
         log_event(Event(name="async_take.start", metadata=dict(event_metadata)))
         begin = time.monotonic()
-        cls._validate_app_state(app_state)
-        path, replicated_patterns = cls._coalesce_path_and_replicated(
-            path, pg, replicated or []
-        )
-        storage = url_to_storage_plugin(path, storage_options)
-        if incremental_from is not None:
-            from .incremental import maybe_wrap_incremental
-
-            storage = maybe_wrap_incremental(
-                storage, incremental_from, target_path=path
-            )
         try:
-            pending_io_work, _, finalizer = cls._take_impl(
-                path=path,
-                app_state=app_state,
-                replicated_patterns=replicated_patterns,
-                storage=storage,
-                pg=pg,
-                is_async_snapshot=True,
+            cls._validate_app_state(app_state)
+            path, replicated_patterns = cls._coalesce_path_and_replicated(
+                path, pg, replicated or []
             )
+            storage = url_to_storage_plugin(path, storage_options)
+            if incremental_from is not None:
+                from .incremental import maybe_wrap_incremental
+
+                storage = maybe_wrap_incremental(
+                    storage, incremental_from, target_path=path
+                )
+            try:
+                pending_io_work, _, finalizer = cls._take_impl(
+                    path=path,
+                    app_state=app_state,
+                    replicated_patterns=replicated_patterns,
+                    storage=storage,
+                    pg=pg,
+                    is_async_snapshot=True,
+                )
+            except BaseException:
+                storage.sync_close()
+                raise
         except BaseException:
-            storage.sync_close()
+            # Every async_take.start must reach a terminal async_take.end,
+            # even when planning/staging raises before the background thread
+            # exists — otherwise the metrics bridge (and any operator
+            # alerting on the event stream) leaks an open operation.
+            event_metadata["duration_s"] = time.monotonic() - begin
+            event_metadata["is_success"] = False
+            log_event(Event(name="async_take.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=False)
             raise
         return PendingSnapshot(
             path=path,
@@ -220,6 +260,8 @@ class Snapshot:
             unique_id=unique_id,
             storage_options=storage_options,
             stall_s=time.monotonic() - begin,
+            trace_op=trace_op,
+            phases_before=phases_before,
         )
 
     @classmethod
@@ -244,19 +286,20 @@ class Snapshot:
         manifest: Manifest = {}
         flattened: Dict[str, Any] = {}
         global_keys = cls._gather_keys(app_state, pg)
-        for key in global_keys:
-            if key not in app_state:
-                raise RuntimeError(
-                    f"Rank {rank} is missing app_state key {key!r} present on "
-                    "other ranks; all ranks must snapshot the same keys"
-                )
-            # Ordered loop + barrier: the application's state_dict() may
-            # itself run collectives (reference :562-568).
-            state_dict = app_state[key].state_dict()
-            key_manifest, key_flattened = flatten(state_dict, prefix=key)
-            manifest.update(key_manifest)
-            flattened.update(key_flattened)
-            pg.barrier()
+        with ttrace.span("flatten", n_keys=len(global_keys)):
+            for key in global_keys:
+                if key not in app_state:
+                    raise RuntimeError(
+                        f"Rank {rank} is missing app_state key {key!r} present on "
+                        "other ranks; all ranks must snapshot the same keys"
+                    )
+                # Ordered loop + barrier: the application's state_dict() may
+                # itself run collectives (reference :562-568).
+                state_dict = app_state[key].state_dict()
+                key_manifest, key_flattened = flatten(state_dict, prefix=key)
+                manifest.update(key_manifest)
+                flattened.update(key_flattened)
+                pg.barrier()
 
         if rng_state_item is not None:
             key, stateful = rng_state_item
@@ -294,9 +337,10 @@ class Snapshot:
             )
             if staging_mode != "host":
                 try:
-                    flattened, staging_stats = device_staging.stage_app_state(
-                        flattened, staging_mode
-                    )
+                    with ttrace.span("device_stage", mode=staging_mode):
+                        flattened, staging_stats = device_staging.stage_app_state(
+                            flattened, staging_mode
+                        )
                 except Exception as staging_exc:
                     logger.warning(
                         "Device-side async staging failed; falling back to "
@@ -320,20 +364,24 @@ class Snapshot:
 
         entries: Manifest = dict(manifest)
         write_reqs: List[WriteReq] = []
-        for logical_path, obj in flattened.items():
-            entry, obj_write_reqs = io_preparer.prepare_write(
-                obj=obj,
-                logical_path=logical_path,
-                rank=rank,
-                replicated=logical_path in replicated_paths,
-                # Device-staged state needs no staging-time defensive copies:
-                # every mutation-exposed leaf was already copied above.
-                is_async_snapshot=is_async_snapshot and staging_mode == "host",
-            )
-            entries[logical_path] = entry
-            write_reqs += obj_write_reqs
+        with ttrace.span("plan", n_leaves=len(flattened)):
+            for logical_path, obj in flattened.items():
+                entry, obj_write_reqs = io_preparer.prepare_write(
+                    obj=obj,
+                    logical_path=logical_path,
+                    rank=rank,
+                    replicated=logical_path in replicated_paths,
+                    # Device-staged state needs no staging-time defensive
+                    # copies: every mutation-exposed leaf was already copied
+                    # above.
+                    is_async_snapshot=is_async_snapshot
+                    and staging_mode == "host",
+                )
+                entries[logical_path] = entry
+                write_reqs += obj_write_reqs
 
-        entries, write_reqs = partition_write_reqs(entries, write_reqs, pg)
+        with ttrace.span("partition", n_write_reqs=len(write_reqs)):
+            entries, write_reqs = partition_write_reqs(entries, write_reqs, pg)
 
         if not knobs.is_batching_disabled():
             entries, write_reqs = batch_write_requests(
@@ -341,6 +389,7 @@ class Snapshot:
                 write_reqs,
                 scatter_ok=getattr(storage, "supports_scatter", False),
             )
+        tmetrics.record_entries("take", len(entries))
 
         memory_budget_bytes = get_process_memory_budget_bytes(pg)
 
@@ -412,8 +461,12 @@ class Snapshot:
         self._validate_app_state(app_state)
         pg = self._pg
         rank = pg.get_rank()
+        unique_id = _gen_unique_id(pg)
+        tmetrics.maybe_install_bridge()
+        trace_op = ttrace.begin_op("restore", unique_id, rank)
+        phases_before = phase_stats.snapshot()
         event_metadata = {
-            "unique_id": _gen_unique_id(pg),
+            "unique_id": unique_id,
             "rank": rank,
             "action": "restore",
         }
@@ -432,15 +485,16 @@ class Snapshot:
                         raise RuntimeError(
                             f"Rank {rank} is missing app_state key {key!r}"
                         )
-                    self._load_stateful(
-                        stateful_key=key,
-                        stateful=app_state[key],
-                        metadata=metadata,
-                        storage=storage,
-                        memory_budget_bytes=memory_budget_bytes,
-                        pg=pg,
-                        strict=strict,
-                    )
+                    with ttrace.span("load_stateful", key=key):
+                        self._load_stateful(
+                            stateful_key=key,
+                            stateful=app_state[key],
+                            metadata=metadata,
+                            storage=storage,
+                            memory_budget_bytes=memory_budget_bytes,
+                            pg=pg,
+                            strict=strict,
+                        )
                     pg.barrier()
                 # RNG restored last so nothing later perturbs it (reference
                 # :371-381).
@@ -454,14 +508,36 @@ class Snapshot:
                         memory_budget_bytes=memory_budget_bytes,
                         pg=pg,
                     )
+                phases_delta = phase_stats.delta(phases_before)
+                if tsidecar.enabled():
+                    tsidecar.write(
+                        storage,
+                        tsidecar.build(
+                            action="restore",
+                            unique_id=unique_id,
+                            rank=rank,
+                            duration_s=time.monotonic() - begin,
+                            phases=phases_delta,
+                            extra={"world_size": pg.get_world_size()},
+                        ),
+                    )
             finally:
                 storage.sync_close()
             event_metadata["duration_s"] = time.monotonic() - begin
+            event_metadata["bytes"] = int(
+                max(
+                    (v.get("bytes", 0) for v in phases_delta.values()),
+                    default=0,
+                )
+            )
             event_metadata["is_success"] = True
             log_event(Event(name="restore.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=True)
         except Exception:
+            event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="restore.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=False)
             raise
 
     def _load_stateful(
@@ -517,18 +593,20 @@ class Snapshot:
             read_reqs: List[ReadReq] = []
             futures: Dict[str, Future] = {}
             container_entries: Manifest = {}
-            for path, entry in sub_manifest.items():
-                if is_container_entry(entry):
-                    container_entries[path] = entry
-                    continue
-                obj_out = target_flattened.get(path)
-                entry_read_reqs, fut = io_preparer.prepare_read(
-                    entry, obj_out, h2d_batch=h2d_batch
-                )
-                read_reqs += entry_read_reqs
-                futures[path] = fut
+            with ttrace.span("plan_read", n_entries=len(sub_manifest)):
+                for path, entry in sub_manifest.items():
+                    if is_container_entry(entry):
+                        container_entries[path] = entry
+                        continue
+                    obj_out = target_flattened.get(path)
+                    entry_read_reqs, fut = io_preparer.prepare_read(
+                        entry, obj_out, h2d_batch=h2d_batch
+                    )
+                    read_reqs += entry_read_reqs
+                    futures[path] = fut
 
-            read_reqs = batch_read_requests(read_reqs)
+                read_reqs = batch_read_requests(read_reqs)
+            tmetrics.record_entries("restore", len(sub_manifest))
             sync_execute_read_reqs(
                 read_reqs=read_reqs,
                 storage=storage,
@@ -543,7 +621,8 @@ class Snapshot:
             # invisible to every phase).  Sharded-array uploads do NOT go
             # through this batcher (io_preparer.prepare_read) and stay in
             # flight by design — see restore()'s docstring.
-            h2d_batch.drain()
+            with ttrace.span("h2d_drain"):
+                h2d_batch.drain()
         finally:
             # Idempotent after drain; on a pipeline abort it stops the
             # lander thread (a long-lived trainer must not leak one parked
@@ -574,12 +653,16 @@ class Snapshot:
         uuid below and the local PGWrapper for the budget keep it free of
         store traffic), unlike restore(), which is collective by contract.
         """
+        unique_id = uuid.uuid4().hex
+        tmetrics.maybe_install_bridge()
+        trace_op = ttrace.begin_op("read_object", unique_id, self._pg.get_rank())
         event_metadata = {
-            "unique_id": uuid.uuid4().hex,
+            "unique_id": unique_id,
             "rank": self._pg.get_rank(),
             "action": "read_object",
         }
         log_event(Event(name="read_object.start", metadata=dict(event_metadata)))
+        begin = time.monotonic()
         try:
             rank_str, _, logical_path = path.partition("/")
             storage = url_to_storage_plugin(self.path, self._storage_options)
@@ -594,8 +677,16 @@ class Snapshot:
                     )
                 entry = manifest[logical_path]
                 if isinstance(entry, PrimitiveEntry):
-                    # No storage I/O needed (reference :467-468).
-                    return entry.get_value()
+                    # No storage I/O needed (reference :467-468) — but the
+                    # start event above still needs its terminal end.
+                    value = entry.get_value()
+                    event_metadata["duration_s"] = time.monotonic() - begin
+                    event_metadata["is_success"] = True
+                    log_event(
+                        Event(name="read_object.end", metadata=event_metadata)
+                    )
+                    ttrace.end_op(trace_op, success=True)
+                    return value
                 read_reqs, fut = io_preparer.prepare_read(
                     entry,
                     obj_out,
@@ -611,12 +702,19 @@ class Snapshot:
                 )
             finally:
                 storage.sync_close()
+            event_metadata["duration_s"] = time.monotonic() - begin
+            nbytes = getattr(fut.obj, "nbytes", None)
+            if isinstance(nbytes, (int, np.integer)):
+                event_metadata["bytes"] = int(nbytes)
             event_metadata["is_success"] = True
             log_event(Event(name="read_object.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=True)
             return fut.obj
         except Exception:
+            event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="read_object.end", metadata=event_metadata))
+            ttrace.end_op(trace_op, success=False)
             raise
 
     def get_manifest(self) -> Dict[str, Entry]:
@@ -928,6 +1026,8 @@ class PendingSnapshot:
         unique_id: str,
         storage_options: Optional[Dict[str, Any]] = None,
         stall_s: float = 0.0,
+        trace_op: Optional[object] = None,
+        phases_before: Optional[Dict[str, Dict[str, float]]] = None,
     ) -> None:
         self.path = path
         self.pg = pg
@@ -940,6 +1040,10 @@ class PendingSnapshot:
         self.exception: Optional[BaseException] = None
         self._barrier: Optional[LinearBarrier] = None
         self._retired = False
+        self._trace_op = trace_op
+        self._phases_before = phases_before or {}
+        self._begin = time.monotonic()
+        self._bytes_total = 0
         self._done_event = threading.Event()
         self._thread = threading.Thread(
             target=self._complete_snapshot,
@@ -962,6 +1066,7 @@ class PendingSnapshot:
             self._barrier = barrier
         try:
             pending_io_work.sync_complete()
+            self._bytes_total = getattr(pending_io_work, "bytes_total", 0)
             # Payloads durable; exchange checksum-annotated manifests via
             # storage sidecars (no collectives on this thread) — the arrive
             # barrier orders rank 0's merge after every sidecar landed.
@@ -974,6 +1079,25 @@ class PendingSnapshot:
                 self._finalizer.cleanup_sidecars(self._storage)
             if barrier is not None:
                 barrier.depart(timeout_s=self.DEFAULT_BARRIER_TIMEOUT_S)
+            # Committed: persist this rank's telemetry summary (still on
+            # the background thread — storage-only, no collectives).
+            if tsidecar.enabled():
+                tsidecar.write(
+                    self._storage,
+                    tsidecar.build(
+                        action="async_take",
+                        unique_id=self._unique_id,
+                        rank=self.pg.get_rank(),
+                        duration_s=time.monotonic() - self._begin,
+                        phases=phase_stats.delta(self._phases_before),
+                        nbytes=self._bytes_total,
+                        extra={
+                            "world_size": self.pg.get_world_size(),
+                            "staging_mode": self._finalizer.staging_mode,
+                            "stall_s": round(self.stall_s, 4),
+                        },
+                    ),
+                )
             self._storage.sync_close()
             log_event(
                 Event(
@@ -981,6 +1105,7 @@ class PendingSnapshot:
                     metadata=self._end_event_metadata(is_success=True),
                 )
             )
+            ttrace.end_op(self._trace_op, success=True)
         except BaseException as e:  # noqa: BLE001
             self.exception = e
             if barrier is not None and not isinstance(e, StorePeerError):
@@ -998,6 +1123,7 @@ class PendingSnapshot:
                     metadata=self._end_event_metadata(is_success=False),
                 )
             )
+            ttrace.end_op(self._trace_op, success=False)
         finally:
             self._done_event.set()
 
@@ -1010,7 +1136,13 @@ class PendingSnapshot:
         metadata: Dict[str, Any] = {
             "unique_id": self._unique_id,
             "rank": self.pg.get_rank(),
+            "action": "async_take",
             "is_success": is_success,
+            # Terminal events carry duration + bytes on EVERY path (success
+            # or error) so the metrics bridge never leaks an open span and
+            # histograms see failed operations too.
+            "duration_s": time.monotonic() - self._begin,
+            "bytes": self._bytes_total,
             "staging_mode": self._finalizer.staging_mode,
             "stall_s": round(self.stall_s, 4),
             "copy_bytes": stats.get("copy_bytes", 0),

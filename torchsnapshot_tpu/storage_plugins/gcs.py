@@ -65,6 +65,9 @@ class _SharedDeadlineRetryStrategy:
                 ) from exc
             self._attempts += 1
             attempts = self._attempts
+        from ..telemetry import metrics as tmetrics
+
+        tmetrics.record_retry("gcs")
         backoff = min(2 ** min(attempts, 6), 32.0) * (0.5 + random.random())
         logger.warning("GCS transient error (%r); retrying in %.1fs", exc, backoff)
         if cancel is not None:

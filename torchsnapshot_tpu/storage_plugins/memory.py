@@ -25,17 +25,31 @@ class MemoryStoragePlugin(StoragePlugin):
             self._files = _REGISTRY.setdefault(root, {})
 
     async def write(self, write_io: WriteIO) -> None:
-        data = bytes(contiguous(write_io.buf))
-        with _LOCK:
-            self._files[write_io.path] = data
+        from .. import phase_stats
+
+        # Timed like the fs plugin's fs_write so take/restore on this
+        # backend still produce a storage phase in stats/traces (the smoke
+        # tests trace against memory storage).
+        with phase_stats.timed(
+            "mem_write",
+            write_io.buf.nbytes
+            if hasattr(write_io.buf, "nbytes")
+            else len(write_io.buf),
+        ):
+            data = bytes(contiguous(write_io.buf))
+            with _LOCK:
+                self._files[write_io.path] = data
 
     async def read(self, read_io: ReadIO) -> None:
+        from .. import phase_stats
+
         with _LOCK:
             data = self._files[read_io.path]
         if read_io.byte_range is not None:
             offset, end = read_io.byte_range
             data = data[offset:end]
-        read_io.buf = bytearray(data)
+        with phase_stats.timed("mem_read", len(data)):
+            read_io.buf = bytearray(data)
 
     # The registry namespaces by plugin root, so a Snapshot taken at
     # "memory://root/step_1" lives in the sibling registry "root/step_1",

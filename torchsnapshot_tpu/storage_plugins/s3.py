@@ -241,6 +241,9 @@ class S3StoragePlugin(StoragePlugin):
         last_exc: Optional[BaseException] = None
         for attempt in range(_MAX_ATTEMPTS):
             if attempt:
+                from ..telemetry import metrics as tmetrics
+
+                tmetrics.record_retry("s3")
                 _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
             req_headers = dict(headers)
             if self._signer is not None:
@@ -411,6 +414,9 @@ class S3StoragePlugin(StoragePlugin):
                     f"S3 GET {path} abandoned: a sibling chunk failed"
                 )
             if attempt:
+                from ..telemetry import metrics as tmetrics
+
+                tmetrics.record_retry("s3")
                 _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
             req_headers = {}
             if start is not None:

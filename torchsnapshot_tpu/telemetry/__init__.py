@@ -1,0 +1,31 @@
+"""Observability subsystem: span tracing, metrics, per-snapshot sidecars.
+
+Three cooperating layers, each independently toggled and each near-zero
+cost when off:
+
+- :mod:`.trace` — context-propagated spans around take/async_take/restore/
+  read_object and every pipeline phase underneath (flatten → plan → stage →
+  scheduler workers → storage I/O), exported as Chrome/Perfetto
+  trace-event JSON under ``TPUSNAP_TRACE_DIR``.  Every ``phase_stats``
+  interval (d2h, checksum, compress, fs_write, …) becomes a span for free
+  via a hook, so the span tree is as complete as the phase attribution.
+- :mod:`.metrics` — a counters/gauges/histograms registry with Prometheus
+  text exposition (``TPUSNAP_METRICS=1``) plus a bridge subscribed to the
+  ``event_handlers.log_event`` fan-out, so the existing ``Event`` sites
+  feed operation counters/durations without per-site changes.
+- :mod:`.sidecar` — a small ``telemetry/<op>.json`` written next to
+  ``.snapshot_metadata`` for each take/restore (``TPUSNAP_SIDECAR=0``
+  opts out), capturing phase_stats deltas, throughput, codec, and knob
+  values — the longitudinal record ``python -m torchsnapshot_tpu stats``
+  renders.
+
+No reference analogue: torchsnapshot's observability is a single
+entry-point event hook (event_handlers.py); production checkpointing
+systems (CheckFreq's iteration-overlap tuning, Check-N-Run's fleet
+monitoring) showed per-phase timelines and longitudinal metrics are
+prerequisites for tuning, which is what this package persists.
+"""
+
+from . import metrics, sidecar, trace
+
+__all__ = ["trace", "metrics", "sidecar"]

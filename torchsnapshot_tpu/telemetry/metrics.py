@@ -1,0 +1,385 @@
+"""Metrics registry: counters/gauges/histograms + Prometheus exposition.
+
+A tiny in-process registry (no client-library dependency) gated on
+``TPUSNAP_METRICS=1``.  Two feeding paths:
+
+- **Instrumented sites** call the ``record_*`` helpers below (scheduler
+  queue depth / budget-in-use / worker utilization, storage bytes and
+  retries, codec in/out bytes).  Each helper's first statement is the
+  enabled check, so a disabled registry costs one env lookup per call.
+- **The event bridge** (:func:`install_event_bridge`) subscribes to the
+  existing ``event_handlers.log_event`` fan-out, so every current
+  ``Event`` site (take/async_take/restore/read_object start/end, staging
+  downgrades) feeds operation counters, duration histograms, and the
+  open-operations gauge without per-site changes.  The open-ops gauge is
+  the span-leak detector: a ``.start`` without its terminal ``.end``
+  leaves it non-zero.
+
+Exposition is the Prometheus text format (:func:`render_prometheus`),
+surfaced by ``python -m torchsnapshot_tpu stats --metrics`` and writable
+to a textfile-collector path by whoever embeds the library.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .. import knobs
+
+_DEFAULT_DURATION_BUCKETS = (
+    0.01,
+    0.05,
+    0.25,
+    1.0,
+    5.0,
+    15.0,
+    60.0,
+    300.0,
+    1800.0,
+)
+
+_LOCK = threading.Lock()
+_REGISTRY: "Dict[str, _Metric]" = {}
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def enabled() -> bool:
+    return knobs.metrics_enabled()
+
+
+class _Child:
+    __slots__ = ("value", "sum", "count", "buckets", "_buckets_le")
+
+    def __init__(self, buckets_le: Optional[Tuple[float, ...]] = None) -> None:
+        self.value = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self._buckets_le = buckets_le
+        self.buckets = [0] * len(buckets_le) if buckets_le else None
+
+
+class _Metric:
+    """One metric family: a name, a type, and labeled children."""
+
+    def __init__(
+        self,
+        name: str,
+        mtype: str,
+        help_text: str,
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> None:
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self._buckets = tuple(sorted(buckets)) if buckets else None
+        self._children: Dict[LabelKey, _Child] = {}
+
+    def _child(self, labels: Dict[str, str]) -> _Child:
+        key: LabelKey = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        child = self._children.get(key)
+        if child is None:
+            with _LOCK:
+                child = self._children.setdefault(key, _Child(self._buckets))
+        return child
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        child = self._child(labels)
+        with _LOCK:
+            child.value += amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def set(self, value: float, **labels: str) -> None:
+        child = self._child(labels)
+        with _LOCK:
+            child.value = value
+
+    def observe(self, value: float, **labels: str) -> None:
+        child = self._child(labels)
+        with _LOCK:
+            child.sum += value
+            child.count += 1
+            if child.buckets is not None:
+                # Per-bucket counts stay NON-cumulative here; exposition
+                # accumulates.  Incrementing every le >= value would make
+                # render's running sum double-count.
+                for i, le in enumerate(self._buckets):
+                    if value <= le:
+                        child.buckets[i] += 1
+                        break
+
+    def get(self, **labels: str) -> float:
+        child = self._child(labels)
+        return child.count if self.mtype == "histogram" else child.value
+
+
+def _register(
+    name: str,
+    mtype: str,
+    help_text: str,
+    buckets: Optional[Tuple[float, ...]] = None,
+) -> _Metric:
+    with _LOCK:
+        metric = _REGISTRY.get(name)
+        if metric is None:
+            metric = _Metric(name, mtype, help_text, buckets)
+            _REGISTRY[name] = metric
+    return metric
+
+
+def counter(name: str, help_text: str = "") -> _Metric:
+    return _register(name, "counter", help_text)
+
+
+def gauge(name: str, help_text: str = "") -> _Metric:
+    return _register(name, "gauge", help_text)
+
+
+def histogram(
+    name: str,
+    help_text: str = "",
+    buckets: Iterable[float] = _DEFAULT_DURATION_BUCKETS,
+) -> _Metric:
+    return _register(name, "histogram", help_text, tuple(buckets))
+
+
+def reset() -> None:
+    """Drop every registered metric (tests)."""
+    with _LOCK:
+        _REGISTRY.clear()
+
+
+def _fmt_labels(key: LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def render_prometheus() -> str:
+    """The registry in Prometheus text exposition format."""
+    lines: List[str] = []
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    for m in metrics:
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.mtype}")
+        with _LOCK:
+            children = list(m._children.items())
+        for key, child in children:
+            if m.mtype == "histogram":
+                cumulative = 0
+                for le, n in zip(m._buckets or (), child.buckets or ()):
+                    cumulative += n
+                    le_label = 'le="%s"' % le
+                    lines.append(
+                        f"{m.name}_bucket{_fmt_labels(key, le_label)}"
+                        f" {cumulative}"
+                    )
+                inf_label = 'le="+Inf"'
+                lines.append(
+                    f"{m.name}_bucket{_fmt_labels(key, inf_label)}"
+                    f" {child.count}"
+                )
+                lines.append(
+                    f"{m.name}_sum{_fmt_labels(key)} {_fmt_value(child.sum)}"
+                )
+                lines.append(f"{m.name}_count{_fmt_labels(key)} {child.count}")
+            else:
+                lines.append(
+                    f"{m.name}{_fmt_labels(key)} {_fmt_value(child.value)}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------- instrumentation helpers
+#
+# Call sites use these instead of touching the registry: the first statement
+# is the enabled check, so with TPUSNAP_METRICS unset each call is one env
+# lookup and a return.
+
+
+def record_io_bytes(direction: str, nbytes: int) -> None:
+    """Storage bytes moved through the scheduler (direction: written|read).
+    Counted at the pipeline layer so every backend is covered once."""
+    if not enabled():
+        return
+    counter(
+        f"tpusnap_storage_bytes_{direction}_total",
+        f"Bytes {direction} through storage plugins",
+    ).inc(nbytes)
+
+
+def record_entries(action: str, n: int) -> None:
+    if not enabled():
+        return
+    counter(
+        "tpusnap_manifest_entries_total",
+        "Manifest entries processed per operation kind",
+    ).inc(n, action=action)
+
+
+def record_scheduler_state(
+    verb: str,
+    pending: int,
+    staging: int,
+    inflight_io: int,
+    budget_in_use: int,
+) -> None:
+    """Point-in-time pipeline gauges, refreshed on the scheduler's loop.
+    Called once per loop turn, so everything non-trivial (the io-cap knob
+    parse included) stays behind the enabled check."""
+    if not enabled():
+        return
+    io_cap = knobs.get_max_per_rank_io_concurrency()
+    gauge(
+        "tpusnap_scheduler_queue_depth",
+        "Requests waiting for budget admission",
+    ).set(pending, pipeline=verb)
+    gauge(
+        "tpusnap_scheduler_staging_inflight",
+        "Requests currently staging/reading",
+    ).set(staging, pipeline=verb)
+    gauge(
+        "tpusnap_scheduler_io_inflight",
+        "Storage I/O tasks currently in flight",
+    ).set(inflight_io, pipeline=verb)
+    gauge(
+        "tpusnap_memory_budget_in_use_bytes",
+        "Scheduler memory budget currently debited",
+    ).set(budget_in_use, pipeline=verb)
+    gauge(
+        "tpusnap_worker_utilization",
+        "In-flight storage I/O over the concurrency cap",
+    ).set(inflight_io / io_cap if io_cap else 0.0, pipeline=verb)
+
+
+def record_retry(backend: str) -> None:
+    """A storage-plugin transient-error retry (gcs/s3 backoff loops)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_storage_retries_total",
+        "Transient storage errors retried with backoff",
+    ).inc(backend=backend)
+
+
+def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
+    """One framed payload's in/out byte counts; ratio derives at query
+    time as uncompressed_total / compressed_total."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_codec_uncompressed_bytes_total",
+        "Logical bytes entering the compression codec",
+    ).inc(uncompressed, codec=codec)
+    counter(
+        "tpusnap_codec_compressed_bytes_total",
+        "Stored frame bytes leaving the compression codec",
+    ).inc(compressed, codec=codec)
+
+
+# ------------------------------------------------------------- event bridge
+
+_BRIDGE_LOCK = threading.Lock()
+_BRIDGE_INSTALLED = False
+
+
+def _bridge_handler(event) -> None:
+    """Maps the existing Event stream onto metrics.  Registered via
+    event_handlers.register_event_handler, so one raising handler (this
+    one included) is isolated by log_event's per-handler try/except."""
+    if not enabled():
+        # Installed once, but honors the knob live: flipping
+        # TPUSNAP_METRICS off mid-process stops recording immediately.
+        return
+    name = event.name
+    md = event.metadata or {}
+    counter("tpusnap_events_total", "Events seen on the log_event fan-out").inc(
+        event=name
+    )
+    action = md.get("action") or name.rsplit(".", 1)[0]
+    if name.endswith(".start"):
+        gauge(
+            "tpusnap_open_operations",
+            "Operations started but not yet ended (a leaked span holds "
+            "this above zero)",
+        ).inc(action=action)
+    elif name.endswith(".end"):
+        gauge(
+            "tpusnap_open_operations",
+            "Operations started but not yet ended (a leaked span holds "
+            "this above zero)",
+        ).dec(action=action)
+        outcome = "success" if md.get("is_success", True) else "error"
+        counter(
+            "tpusnap_operations_total", "Completed operations by outcome"
+        ).inc(action=action, outcome=outcome)
+        duration = md.get("duration_s")
+        if isinstance(duration, (int, float)):
+            histogram(
+                "tpusnap_operation_duration_seconds",
+                "End-to-end operation wall time",
+            ).observe(float(duration), action=action)
+        nbytes = md.get("bytes")
+        if isinstance(nbytes, (int, float)) and nbytes:
+            counter(
+                "tpusnap_operation_bytes_total",
+                "Payload bytes moved per completed operation",
+            ).inc(float(nbytes), action=action)
+    elif name == "async_take.staging_downgrade":
+        counter(
+            "tpusnap_staging_downgrades_total",
+            "async_take staging-mode downgrades",
+        ).inc(
+            from_mode=md.get("from_mode", "?"), to_mode=md.get("to_mode", "?")
+        )
+    elif name == "async_take.device_staged":
+        copy_bytes = md.get("copy_bytes")
+        if isinstance(copy_bytes, (int, float)):
+            counter(
+                "tpusnap_device_staged_bytes_total",
+                "Bytes made snapshot-stable by device-side staging",
+            ).inc(float(copy_bytes), mode=md.get("mode", "?"))
+
+
+def install_event_bridge() -> None:
+    """Idempotently subscribe the bridge to the log_event fan-out."""
+    global _BRIDGE_INSTALLED
+    from ..event_handlers import register_event_handler
+
+    with _BRIDGE_LOCK:
+        if _BRIDGE_INSTALLED:
+            return
+        register_event_handler(_bridge_handler)
+        _BRIDGE_INSTALLED = True
+
+
+def uninstall_event_bridge() -> None:
+    global _BRIDGE_INSTALLED
+    from ..event_handlers import unregister_event_handler
+
+    with _BRIDGE_LOCK:
+        if not _BRIDGE_INSTALLED:
+            return
+        try:
+            unregister_event_handler(_bridge_handler)
+        except ValueError:
+            pass
+        _BRIDGE_INSTALLED = False
+
+
+def maybe_install_bridge() -> None:
+    """Install the bridge iff metrics are enabled — called at every
+    operation entry point, so flipping TPUSNAP_METRICS on takes effect at
+    the next take/restore with no explicit setup."""
+    if enabled():
+        install_event_bridge()

@@ -1,0 +1,375 @@
+"""Span tracer exporting Chrome/Perfetto trace-event JSON.
+
+One *operation* (a take / async_take / restore / read_object) is one trace
+file: ``<TPUSNAP_TRACE_DIR>/<kind>-<op8>-rank<rank>.trace.json``, loadable
+directly in Perfetto (ui.perfetto.dev) or ``chrome://tracing``.  Spans are
+"X" (complete) events carrying op id, parent span, phase category, rank
+(as ``pid``), thread (as ``tid``), and byte counts in ``args`` — the
+per-operation timeline that turns "this save took 40 s" into "37 s of it
+was fs_write on two workers while d2h sat idle".
+
+Context propagation: the *operation* is process-global (an async_take's
+spans keep landing from the background commit thread and the scheduler's
+executor workers long after the caller returned), while *parent* links use
+a contextvar so nesting is correct within a thread / asyncio task and
+degrades to "child of the op root" across thread hops.  ``phase_stats``
+forwards every recorded interval through :func:`record_phase` while an op
+is collecting, which is what populates the leaf spans (d2h, checksum,
+compress, slab_pack, fs_write/read, h2d_*) without touching those sites.
+
+Disabled (no ``TPUSNAP_TRACE_DIR``): ``begin_op`` returns None without
+taking a lock, ``span()`` returns a shared no-op context manager after one
+list check, and the phase_stats hook is never installed — the tracer costs
+one branch per call site.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import knobs, phase_stats
+
+logger = logging.getLogger(__name__)
+
+TRACE_FILE_SUFFIX = ".trace.json"
+
+# Maps time.monotonic() stamps (what phase_stats records) onto the epoch
+# clock so per-rank trace files from different processes line up when
+# merged (`python -m torchsnapshot_tpu trace`).
+_EPOCH_OFFSET_S = time.time() - time.monotonic()
+
+_ids = itertools.count(1)
+_OP_LOCK = threading.Lock()
+# Stack of collecting ops; spans attach to the innermost (most recent).
+# Plain list; reads are a truthiness check (the disabled-path fast bail).
+_ACTIVE: List["_TraceOp"] = []
+
+_parent_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "tpusnap_parent_span", default=None
+)
+
+
+def enabled() -> bool:
+    return knobs.get_trace_dir() is not None
+
+
+def _now_us() -> float:
+    return (time.monotonic() + _EPOCH_OFFSET_S) * 1e6
+
+
+class _TraceOp:
+    """Collection state for one traced operation."""
+
+    def __init__(self, kind: str, op_id: str, rank: int, trace_dir: str) -> None:
+        self.kind = kind
+        self.op_id = op_id
+        self.rank = rank
+        self.trace_dir = trace_dir
+        self.begin_us = _now_us()
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._tids: Dict[int, int] = {}
+
+    def _tid(self) -> int:
+        """Small stable per-thread id (+ a thread_name metadata event the
+        first time a thread records)."""
+        ident = threading.get_ident()
+        tid = self._tids.get(ident)
+        if tid is None:
+            tid = len(self._tids)
+            self._tids[ident] = tid
+            self._events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self.rank,
+                    "tid": tid,
+                    "args": {"name": threading.current_thread().name},
+                }
+            )
+        return tid
+
+    def add_complete(
+        self,
+        name: str,
+        begin_us: float,
+        dur_us: float,
+        cat: str,
+        args: Dict[str, Any],
+    ) -> int:
+        span_id = next(_ids)
+        args = dict(args)
+        args["op"] = self.op_id
+        args["span_id"] = span_id
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": cat,
+                    "ph": "X",
+                    "ts": begin_us,
+                    "dur": max(dur_us, 0.0),
+                    "pid": self.rank,
+                    "tid": self._tid(),
+                    "args": args,
+                }
+            )
+        return span_id
+
+    def add_instant(self, name: str, args: Dict[str, Any]) -> None:
+        args = dict(args)
+        args["op"] = self.op_id
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": "event",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": _now_us(),
+                    "pid": self.rank,
+                    "tid": self._tid(),
+                    "args": args,
+                }
+            )
+
+    def finish(self, success: bool, extra: Dict[str, Any]) -> Optional[str]:
+        end_us = _now_us()
+        args = {"op": self.op_id, "success": success, **extra}
+        with self._lock:
+            self._events.append(
+                {
+                    "name": self.kind,
+                    "cat": "op",
+                    "ph": "X",
+                    "ts": self.begin_us,
+                    "dur": end_us - self.begin_us,
+                    "pid": self.rank,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+            self._events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": self.rank,
+                    "tid": 0,
+                    "args": {"name": f"rank {self.rank}"},
+                }
+            )
+            events = list(self._events)
+        payload = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "op": self.op_id,
+                "kind": self.kind,
+                "rank": self.rank,
+                "success": success,
+            },
+        }
+        fname = f"{self.kind}-{self.op_id[:8]}-rank{self.rank}{TRACE_FILE_SUFFIX}"
+        path = os.path.join(self.trace_dir, fname)
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            logger.warning("failed to write trace file %s", path, exc_info=True)
+            return None
+
+
+def _current() -> Optional[_TraceOp]:
+    # Unlocked read of the last element: append/remove happen under
+    # _OP_LOCK, and a span racing an op teardown merely lands in (or
+    # misses) a file that was being finalized — never corrupts state.
+    active = _ACTIVE
+    return active[-1] if active else None
+
+
+def begin_op(kind: str, op_id: str, rank: int) -> Optional[_TraceOp]:
+    """Start collecting spans for one operation.  Returns None (and costs
+    one env lookup) when tracing is disabled."""
+    trace_dir = knobs.get_trace_dir()
+    if trace_dir is None:
+        return None
+    op = _TraceOp(kind, op_id, rank, trace_dir)
+    with _OP_LOCK:
+        _ACTIVE.append(op)
+        phase_stats.set_trace_hook(record_phase)
+    return op
+
+
+def end_op(
+    op: Optional[_TraceOp], success: bool = True, **extra: Any
+) -> Optional[str]:
+    """Stop collecting and write the op's trace file; returns its path."""
+    if op is None:
+        return None
+    with _OP_LOCK:
+        try:
+            _ACTIVE.remove(op)
+        except ValueError:
+            return None  # already ended (double end_op on an error path)
+        if not _ACTIVE:
+            phase_stats.set_trace_hook(None)
+    return op.finish(success, extra)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_op", "_name", "_cat", "_args", "_begin_us", "_token")
+
+    def __init__(self, op: _TraceOp, name: str, cat: str, args: Dict[str, Any]):
+        self._op = op
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_Span":
+        self._begin_us = _now_us()
+        # Reserve the id up front so children opened inside see it.
+        self._args["parent"] = _parent_span.get()
+        span_id = next(_ids)
+        self._args["span_id"] = span_id
+        self._token = _parent_span.set(span_id)
+        return self
+
+    def __exit__(self, exc_type: Any, *exc: Any) -> None:
+        _parent_span.reset(self._token)
+        if exc_type is not None:
+            self._args["error"] = getattr(exc_type, "__name__", str(exc_type))
+        end_us = _now_us()
+        with self._op._lock:
+            self._op._events.append(
+                {
+                    "name": self._name,
+                    "cat": self._cat,
+                    "ph": "X",
+                    "ts": self._begin_us,
+                    "dur": end_us - self._begin_us,
+                    "pid": self._op.rank,
+                    "tid": self._op._tid(),
+                    "args": {**self._args, "op": self._op.op_id},
+                }
+            )
+
+
+def span(name: str, cat: str = "span", nbytes: Optional[int] = None, **args: Any):
+    """Context manager recording one complete span on the active op; a
+    shared no-op when no op is collecting (the common, disabled case)."""
+    op = _current()
+    if op is None:
+        return _NOOP
+    if nbytes is not None:
+        args["bytes"] = int(nbytes)
+    return _Span(op, name, cat, args)
+
+
+def instant(name: str, **args: Any) -> None:
+    op = _current()
+    if op is not None:
+        op.add_instant(name, args)
+
+
+def record_phase(phase: str, begin_mono: float, end_mono: float, nbytes: int) -> None:
+    """phase_stats hook: every recorded interval becomes a leaf span.
+    Installed only while at least one op is collecting."""
+    op = _current()
+    if op is None:
+        return
+    args: Dict[str, Any] = {"parent": _parent_span.get()}
+    if nbytes:
+        args["bytes"] = int(nbytes)
+    op.add_complete(
+        name=phase,
+        begin_us=(begin_mono + _EPOCH_OFFSET_S) * 1e6,
+        dur_us=(end_mono - begin_mono) * 1e6,
+        cat="phase",
+        args=args,
+    )
+
+
+# --------------------------------------------------------------- tooling
+
+
+def validate_trace(obj: Any) -> List[str]:
+    """Structural validation of a trace-event JSON document (the schema the
+    smoke tests and the ``trace`` CLI check — not string matching).
+    Returns a list of problems; empty means valid."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"top level must be an object, got {type(obj).__name__}"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing traceEvents array"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ev.get("name"), str):
+            problems.append(f"{where}: missing string name")
+        if ph not in ("X", "M", "i", "B", "E", "C"):
+            problems.append(f"{where}: unknown ph {ph!r}")
+            continue
+        if ph in ("X", "i"):
+            if not isinstance(ev.get("ts"), (int, float)):
+                problems.append(f"{where}: ph={ph} needs numeric ts")
+            if not isinstance(ev.get("pid"), int) or not isinstance(
+                ev.get("tid"), int
+            ):
+                problems.append(f"{where}: needs integer pid/tid")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: ph=X needs non-negative dur")
+        args = ev.get("args")
+        if args is not None and not isinstance(args, dict):
+            problems.append(f"{where}: args must be an object")
+    return problems
+
+
+def merge_trace_files(paths: List[str]) -> Dict[str, Any]:
+    """Merge per-rank/per-op trace files into one Perfetto-loadable
+    document (timestamps are epoch-anchored, so events align)."""
+    merged: List[Dict[str, Any]] = []
+    sources: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        problems = validate_trace(doc)
+        if problems:
+            raise ValueError(f"{path}: invalid trace: {problems[:3]}")
+        merged.extend(doc.get("traceEvents", []))
+        other = doc.get("otherData", {})
+        sources.append({"file": os.path.basename(path), **other})
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {"merged_from": sources},
+    }
