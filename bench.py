@@ -266,6 +266,25 @@ def main() -> None:
     # CI hook that keeps the observability path exercised end to end.
     telemetry_enabled = "--telemetry" in sys.argv[1:]
 
+    # --faults <spec>: run the whole bench with the fault-injection wrapper
+    # installed (faults.py grammar).  `--faults none` installs the wrapper
+    # with zero rules — the pure-overhead probe, so the wrapper's cost (off
+    # and on) shows up in the perf trajectory; a real spec measures the
+    # pipeline's retry/backoff cost under that schedule.  Forwarded to TPU
+    # re-runs like every other flag (argv passthrough above).
+    faults_spec = None
+    argv = sys.argv[1:]
+    if "--faults" in argv:
+        idx = argv.index("--faults")
+        if idx + 1 >= len(argv):
+            raise SystemExit("--faults requires a spec argument (or 'none')")
+        faults_spec = argv[idx + 1]
+        from torchsnapshot_tpu.faults import parse_fault_spec
+
+        parse_fault_spec(faults_spec)  # fail fast on a typo'd spec
+        os.environ["TPUSNAP_FAULTS"] = faults_spec
+        log(f"fault injection enabled: {faults_spec!r}")
+
     _install_watchdog()
     devices = _init_devices()
 
@@ -781,6 +800,7 @@ def main() -> None:
             "state_gib": round(gib, 2),
             "attempts": attempts,
             "bytes_written": bytes_written,
+            "faults_spec": faults_spec,
             "telemetry_sidecar": telemetry_sidecar,
             "compression_probe": compression_probe,
             "sync_save_s": round(save_s, 2),

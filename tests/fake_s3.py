@@ -2,10 +2,22 @@
 
 Speaks the subset the S3 plugin uses: PUT/GET (with inclusive-end Range)/
 DELETE on ``/bucket/key`` and ListObjectsV2 on ``/bucket?list-type=2``.
-Fault injection via ``fail_next`` (responds 503 to the next N requests) lets
-tests exercise the retry path.  The reference gates its S3 tests behind a
-real bucket (reference tests/test_s3_storage_plugin.py:24-33); this fake
-makes the semantics testable on every run.
+
+Fault injection (parity with ``fake_gcs.py``'s ``fail_put_chunks`` /
+``fail_at_chunks`` hooks):
+
+- ``fail_next`` — 503 SlowDown the next N requests of ANY kind
+- ``fail_puts`` — 503 the next N *object-data* PUTs only (not copies, not
+  multipart parts), with the body discarded first — the bytes are NOT
+  persisted, so the client's resend is load-bearing
+- ``fail_gets`` — 503 the next N object GETs (list requests excluded)
+- ``fail_at_requests`` — fail specific 1-based global request indices
+  (deterministic schedules, like gcs's ``fail_at_chunks``)
+- ``fail_parts`` — 503 the next N multipart part PUTs
+
+The reference gates its S3 tests behind a real bucket (reference
+tests/test_s3_storage_plugin.py:24-33); this fake makes the semantics
+testable on every run.
 """
 
 from __future__ import annotations
@@ -28,6 +40,9 @@ class FakeS3Server:
     def __init__(self) -> None:
         self.objects: Dict[str, bytes] = {}  # "bucket/key" -> data
         self.fail_next = 0
+        self.fail_puts = 0  # 503 the next N object-data PUTs
+        self.fail_gets = 0  # 503 the next N object GETs
+        self.fail_at_requests = set()  # fail specific 1-based request indices
         self.request_count = 0
         self.copies = 0  # server-side copies (x-amz-copy-source PUTs)
         self.gets = 0  # object GETs served (list requests excluded)
@@ -46,6 +61,22 @@ class FakeS3Server:
             def log_message(self, fmt, *args):  # quiet
                 pass
 
+            def _send_503(self, drain: bool = True) -> None:
+                # Drain any request body so the connection stays parseable,
+                # and close it anyway (clients reconnect on retry).
+                # ``drain=False`` when the caller already consumed it — a
+                # second read would block on an empty socket.
+                length = int(self.headers.get("Content-Length", 0))
+                if drain and length:
+                    self.rfile.read(length)
+                body = b"<Error><Code>SlowDown</Code></Error>"
+                self.send_response(503)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(body)
+                self.close_connection = True
+
             def _maybe_fail(self) -> bool:
                 with outer._lock:
                     outer.request_count += 1
@@ -53,20 +84,21 @@ class FakeS3Server:
                         outer.fail_next -= 1
                         fail = True
                     else:
-                        fail = False
+                        fail = outer.request_count in outer.fail_at_requests
                 if fail:
-                    # Drain any request body so the connection stays parseable,
-                    # and close it anyway (clients reconnect on retry).
-                    length = int(self.headers.get("Content-Length", 0))
-                    if length:
-                        self.rfile.read(length)
-                    body = b"<Error><Code>SlowDown</Code></Error>"
-                    self.send_response(503)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.send_header("Connection", "close")
-                    self.end_headers()
-                    self.wfile.write(body)
-                    self.close_connection = True
+                    self._send_503()
+                return fail
+
+            def _maybe_fail_op(self, counter_name: str, drain: bool = True) -> bool:
+                """Per-op hook (``fail_puts`` / ``fail_gets``): fires AFTER
+                ``_maybe_fail`` passed, scoped to one operation kind."""
+                with outer._lock:
+                    remaining = getattr(outer, counter_name)
+                    fail = remaining > 0
+                    if fail:
+                        setattr(outer, counter_name, remaining - 1)
+                if fail:
+                    self._send_503(drain=drain)
                 return fail
 
             def _obj_key(self) -> str:
@@ -84,6 +116,12 @@ class FakeS3Server:
                 if "partNumber" in query and "uploadId" in query:
                     return self._do_upload_part(query, data)
                 copy_source = self.headers.get("x-amz-copy-source")
+                if copy_source is None and self._maybe_fail_op(
+                    "fail_puts", drain=False
+                ):
+                    # The body was already consumed above: the bytes are
+                    # NOT persisted, same contract as gcs's discarded chunk.
+                    return
                 if copy_source:
                     src_key = urllib.parse.unquote(copy_source.lstrip("/"))
                     with outer._lock:
@@ -117,6 +155,8 @@ class FakeS3Server:
                 query = urllib.parse.parse_qs(split.query)
                 if "list-type" in query:
                     return self._do_list(split, query)
+                if self._maybe_fail_op("fail_gets"):
+                    return
                 with outer._lock:
                     outer.gets += 1
                 key = self._obj_key()

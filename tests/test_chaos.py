@@ -1,0 +1,112 @@
+"""Randomized-schedule chaos soak: crash consistency under injected faults.
+
+The capstone invariant of the fault-tolerance layer: under ANY schedule of
+injected write faults, every take either commits fully (retries absorbed
+the fault) or aborts leaving at most a GC-able orphan — never a torn
+snapshot that discovery counts as committed — and ``restore_latest`` always
+lands on a good committed step.
+
+The schedule is drawn from a seeded RNG so failures reproduce from the
+seed alone.  Tier-1 runs one fixed seed (`test_chaos_fast`); the `slow`
+soak sweeps many seeds with longer histories.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import StateDict, knobs
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+
+def _state(v):
+    return {
+        "m": StateDict(
+            {"w": np.full((512,), float(v), np.float32), "step": v}
+        )
+    }
+
+
+# Each entry: (fault spec for this take, must_commit_or_None).
+# must_commit True  -> the retry budget (2) absorbs the schedule.
+# must_commit False -> the schedule exhausts the budget or is terminal.
+# None              -> either outcome is legal (the invariant still holds).
+_MENU = [
+    ("", True),  # no faults
+    ("write:1:transient", True),  # one blip, retried
+    ("write:1:torn:0.5", True),  # torn once, rewritten on retry
+    ("write:1:latency:0.01", True),  # slow but fine
+    ("write:1+:transient", False),  # every attempt fails: abort
+    ("write:1+:torn:0.25", False),  # every attempt torn: abort
+    ("write:1:terminal", False),  # not retryable
+    ("write:2:transient;write:3:transient", None),  # budget-dependent
+]
+
+
+def _run_chaos(root: str, seed: int, n_steps: int) -> None:
+    rng = random.Random(seed)
+    mgr = SnapshotManager(root)
+    committed = []
+    with knobs.override_retry_base_s(0.001), knobs.override_sidecar(False):
+        for step in range(1, n_steps + 1):
+            spec, must_commit = _MENU[rng.randrange(len(_MENU))]
+            use_async = rng.random() < 0.25
+            with knobs.override_faults(spec or None):
+                try:
+                    if use_async:
+                        mgr.save(step, _state(step), async_=True).wait()
+                    else:
+                        mgr.save(step, _state(step))
+                    took = True
+                except Exception:
+                    took = False
+            if must_commit is not None:
+                assert took is must_commit, (seed, step, spec, use_async)
+
+            # THE invariant: commit marker present iff the take reported
+            # success; a failed take left no committed-looking debris.
+            storage = url_to_storage_plugin(root)
+            try:
+                has_marker = storage.sync_exists(
+                    f"step_{step}/{SNAPSHOT_METADATA_FNAME}"
+                )
+            finally:
+                storage.sync_close()
+            assert has_marker is took, (seed, step, spec, use_async)
+            if took:
+                committed.append(step)
+            else:
+                # Any leftover is an orphan `gc` can see; nothing else.
+                assert mgr.orphan_steps() in ([], [step]), (seed, step, spec)
+
+        # GC clears every orphan; committed steps are exactly what's left.
+        mgr.gc(apply=True)
+        assert mgr.orphan_steps() == []
+        assert mgr.all_steps() == committed
+
+        # restore_latest lands on the newest good step with intact bytes.
+        if committed:
+            dst = _state(0)
+            assert mgr.restore_latest(dst) == committed[-1]
+            np.testing.assert_array_equal(
+                dst["m"]["w"], np.full((512,), float(committed[-1]))
+            )
+        else:
+            assert mgr.restore_latest(_state(0)) is None
+
+
+def test_chaos_fast(tmp_path):
+    """Tier-1 variant: one fixed seed, short history — deterministic and
+    quick, but drawing from the same schedule menu as the soak."""
+    _run_chaos(str(tmp_path / "ckpts"), seed=20260803, n_steps=10)
+
+
+@pytest.mark.slow
+def test_chaos_soak(tmp_path):
+    """Multi-seed soak (minutes): every schedule either commits fully or
+    leaves a GC-able orphan, and restore_latest always lands good."""
+    for seed in range(8):
+        _run_chaos(str(tmp_path / f"ckpts_{seed}"), seed=seed, n_steps=25)

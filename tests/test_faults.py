@@ -1,0 +1,293 @@
+"""Fault-injection subsystem + crash-consistent lifecycle.
+
+Deterministic injected faults (faults.py) drive every failure path on CPU:
+the scheduler's bounded transient-write retry, exhausted-budget aborts that
+tear down (or leave GC-able) partial snapshot dirs, the `gc` CLI, and the
+barrier-timeout knob with peer-error propagation.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+from torchsnapshot_tpu.dist_store import FileStore, LinearBarrier, StorePeerError
+from torchsnapshot_tpu.faults import (
+    FaultInjectionError,
+    FaultyStoragePlugin,
+    InjectedTransientError,
+    parse_fault_spec,
+)
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+from torchsnapshot_tpu.storage_plugins.memory import MemoryStoragePlugin
+from torchsnapshot_tpu.telemetry import metrics
+
+
+def _state(v=1):
+    return {"m": StateDict({"w": np.full((256,), float(v), np.float32), "step": v})}
+
+
+# ----------------------------------------------------------- spec grammar
+
+
+def test_parse_rules():
+    rules = parse_fault_spec(
+        "write:2:transient; read:1+:latency:0.01 ;write:1:torn:0.25@*.data"
+    )
+    assert [r.op for r in rules] == ["write", "read", "write"]
+    assert rules[0].first == 2 and not rules[0].open_ended
+    assert rules[1].open_ended and rules[1].param == 0.01
+    assert rules[2].kind == "torn" and rules[2].path_glob == "*.data"
+    assert parse_fault_spec("none") == []
+    assert parse_fault_spec("") == []
+    assert parse_fault_spec("any:*:terminal")[0].first == 1
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "write:transient",  # missing field
+        "frobnicate:1:transient",  # unknown op
+        "write:1:explode",  # unknown kind
+        "read:1:torn",  # torn is write-only
+        "write:0:transient",  # 1-based
+        "write:1:torn:1.5",  # fraction out of range
+        "write:1:latency:-1",  # negative latency
+        "write:1:transient:0:extra",  # too many fields
+    ],
+)
+def test_parse_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+# ------------------------------------------------------- wrapper semantics
+
+
+def _mem(spec, root="faultmem"):
+    MemoryStoragePlugin.reset(root)
+    return FaultyStoragePlugin(MemoryStoragePlugin(root), parse_fault_spec(spec))
+
+
+def test_nth_write_fails_once():
+    plugin = _mem("write:2:transient")
+    plugin.sync_write(WriteIO(path="a", buf=b"1"))
+    with pytest.raises(InjectedTransientError):
+        plugin.sync_write(WriteIO(path="b", buf=b"2"))
+    plugin.sync_write(WriteIO(path="c", buf=b"3"))  # 3rd call passes
+
+
+def test_open_ended_and_terminal():
+    plugin = _mem("write:2+:terminal")
+    plugin.sync_write(WriteIO(path="a", buf=b"1"))
+    for _ in range(3):
+        with pytest.raises(FaultInjectionError):
+            plugin.sync_write(WriteIO(path="b", buf=b"2"))
+
+
+def test_path_glob_scopes_counter():
+    plugin = _mem("write:1:transient@special/*")
+    plugin.sync_write(WriteIO(path="normal", buf=b"1"))  # glob miss: no count
+    with pytest.raises(InjectedTransientError):
+        plugin.sync_write(WriteIO(path="special/x", buf=b"2"))
+
+
+def test_torn_write_persists_prefix():
+    plugin = _mem("write:1:torn:0.5")
+    with pytest.raises(InjectedTransientError, match="torn"):
+        plugin.sync_write(WriteIO(path="t", buf=b"0123456789"))
+    read_io = ReadIO(path="t")
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == b"01234"  # short write really on storage
+
+
+def test_latency_passes_through():
+    plugin = _mem("read:1:latency:0.05")
+    plugin.sync_write(WriteIO(path="a", buf=b"payload"))
+    t0 = time.monotonic()
+    read_io = ReadIO(path="a")
+    plugin.sync_read(read_io)
+    assert bytes(read_io.buf) == b"payload"
+    assert time.monotonic() - t0 >= 0.04
+
+
+# ------------------------------------- pipeline retry + lifecycle (fs e2e)
+
+
+def test_transient_write_fault_retried_take_commits(tmp_path, monkeypatch):
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    metrics.reset()
+    with knobs.override_metrics(True), knobs.override_faults(
+        "write:1:transient"
+    ):
+        snap = Snapshot.take(str(tmp_path / "snap"), _state(7))
+    assert (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+    assert (
+        metrics.counter("tpusnap_pipeline_retries_total").get(stage="write")
+        >= 1
+    )
+    assert (
+        metrics.counter("tpusnap_faults_injected_total").get(
+            op="write", kind="transient"
+        )
+        == 1
+    )
+    dst = _state(0)
+    snap.restore(dst)
+    assert dst["m"]["step"] == 7
+
+
+def test_exhausted_retries_abort_cleanup_no_metadata(tmp_path, monkeypatch):
+    """Every-write-fails: the take aborts, never writes the commit marker,
+    and tears down its partial directory (or leaves a GC-able orphan)."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    metrics.reset()
+    with knobs.override_metrics(True), knobs.override_faults(
+        "write:1+:transient"
+    ):
+        with pytest.raises(InjectedTransientError):
+            Snapshot.take(str(tmp_path / "snap"), _state())
+    assert not (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+    # cleanup tore the partial dir down
+    assert not (tmp_path / "snap").exists()
+    assert metrics.counter("tpusnap_gc_actions_total").get(
+        kind="take_cleanup"
+    ) == 1
+
+
+def test_terminal_fault_not_retried(tmp_path, monkeypatch):
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    metrics.reset()
+    with knobs.override_metrics(True), knobs.override_faults(
+        "write:1:terminal"
+    ):
+        with pytest.raises(FaultInjectionError):
+            Snapshot.take(str(tmp_path / "snap"), _state())
+    # terminal errors never consume the retry budget
+    assert (
+        metrics.counter("tpusnap_pipeline_retries_total").get(stage="write")
+        == 0
+    )
+    assert not (tmp_path / "snap").exists()
+
+
+def test_async_take_fault_cleanup(tmp_path, monkeypatch):
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    with knobs.override_faults("write:1+:transient"):
+        pending = Snapshot.async_take(str(tmp_path / "snap"), _state())
+        with pytest.raises(InjectedTransientError):
+            pending.wait()
+    assert not (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+    assert not (tmp_path / "snap").exists()
+
+
+def test_storage_options_faults_key(tmp_path, monkeypatch):
+    """The faults spec also rides storage_options — popped before the fs
+    plugin (which rejects unknown options) sees it."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    snap = Snapshot.take(
+        str(tmp_path / "snap"),
+        _state(3),
+        storage_options={"faults": "write:1:transient"},
+    )
+    assert (tmp_path / "snap" / SNAPSHOT_METADATA_FNAME).exists()
+    dst = _state(0)
+    snap.restore(dst)
+    assert dst["m"]["step"] == 3
+
+
+def test_take_cleanup_never_deletes_committed(tmp_path, monkeypatch):
+    """A failed RE-take over an already-committed path must not delete the
+    valid snapshot: cleanup is commit-marker-guarded."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    path = str(tmp_path / "snap")
+    Snapshot.take(path, _state(1))
+    with knobs.override_faults("write:1+:transient"):
+        with pytest.raises(InjectedTransientError):
+            Snapshot.take(path, _state(2))
+    # the original commit survives and still restores
+    dst = _state(0)
+    Snapshot(path).restore(dst)
+    assert dst["m"]["step"] == 1
+
+
+# ------------------------------------------------------------------- gc
+
+
+def test_gc_cli_lists_then_removes(tmp_path):
+    root = tmp_path / "ckpts"
+    mgr = SnapshotManager(str(root))
+    mgr.save(1, _state(1))
+    orphan = root / "step_9"
+    orphan.mkdir(parents=True)
+    (orphan / "0%2Fm%2Fw").write_bytes(b"junk")
+
+    assert mgr.orphan_steps() == [9]
+
+    from torchsnapshot_tpu.__main__ import main
+
+    # dry run: reports, removes nothing
+    assert main(["gc", str(root)]) == 0
+    assert orphan.exists()
+    # apply: removes the orphan, keeps the committed step
+    assert main(["gc", str(root), "--apply"]) == 0
+    assert not orphan.exists()
+    assert mgr.all_steps() == [1]
+    dst = _state(0)
+    assert mgr.restore_latest(dst) == 1
+
+
+def test_gc_refuses_committed_snapshot_root(tmp_path):
+    mgr = SnapshotManager(str(tmp_path / "ckpts"))
+    mgr.save(1, _state(1))
+    from torchsnapshot_tpu.__main__ import main
+
+    # pointing gc INSIDE a committed snapshot would classify its payload
+    # dirs as orphans — refused outright
+    assert main(["gc", str(tmp_path / "ckpts" / "step_1"), "--apply"]) == 2
+
+
+# ------------------------------------------------- barrier timeout knob
+
+
+def test_barrier_timeout_knob(tmp_path):
+    store = FileStore(str(tmp_path / "store"))
+    barrier = LinearBarrier("t", store, rank=0, world_size=2)
+    with knobs.override_barrier_timeout_s(0.3):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            barrier.arrive()  # no peer ever arrives; knob bounds the wait
+        assert time.monotonic() - t0 < 5
+
+
+def test_peer_error_surfaces_before_timeout(tmp_path):
+    """A peer's report_error must wake waiting ranks as StorePeerError
+    immediately — not after the (long) barrier timeout."""
+    store = FileStore(str(tmp_path / "store"))
+    result = {}
+
+    def leader():
+        barrier = LinearBarrier("pe", store, rank=0, world_size=2)
+        t0 = time.monotonic()
+        try:
+            barrier.arrive()  # knob default: would wait 60 s
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+            result["waited_s"] = time.monotonic() - t0
+
+    with knobs.override_barrier_timeout_s(60):
+        thread = threading.Thread(target=leader)
+        thread.start()
+        time.sleep(0.3)  # let the leader park in the arrive wait
+        peer = LinearBarrier("pe", store, rank=1, world_size=2)
+        peer.report_error("rank 1 exploded")
+        thread.join(timeout=15)
+    assert not thread.is_alive()
+    assert isinstance(result.get("error"), StorePeerError)
+    assert "rank 1 exploded" in str(result["error"])
+    assert result["waited_s"] < 10  # well before the 60 s timeout

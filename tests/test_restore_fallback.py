@@ -1,0 +1,102 @@
+"""Restore-side graceful degradation: corrupted/torn snapshots are named,
+fail `verify`, and are skipped by restore_latest's last-good fallback."""
+
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+from torchsnapshot_tpu.integrity import ChecksumError
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.telemetry import metrics
+
+
+def _native_available():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    return get_native_lib_path() is not None
+
+
+def _state(v):
+    return {"m": StateDict({"w": np.full((1024,), float(v), np.float32), "step": v})}
+
+
+def _corrupt_payload(snapshot_path: str, entry) -> str:
+    """Flip one byte of an entry's stored payload (length preserved)."""
+    payload = os.path.join(snapshot_path, entry.location)
+    with open(payload, "r+b") as f:
+        offset = (entry.byte_range[0] if entry.byte_range else 0) + 64
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return payload
+
+
+@pytest.mark.skipif(
+    not _native_available(), reason="native library unavailable"
+)
+def test_corrupt_latest_named_verified_and_skipped(tmp_path):
+    root = tmp_path / "ckpts"
+    mgr = SnapshotManager(str(root))
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+
+    step2 = str(root / "step_2")
+    entry = Snapshot(step2).get_manifest()["0/m/w"]
+    _corrupt_payload(step2, entry)
+
+    # 1) the ChecksumError names the offending payload
+    with pytest.raises(ChecksumError, match="Checksum mismatch") as excinfo:
+        Snapshot(step2).restore(_state(0))
+    assert entry.location in str(excinfo.value)
+
+    # 2) `tpusnap verify` exits nonzero on the corrupt snapshot
+    from torchsnapshot_tpu.__main__ import main
+
+    assert main(["verify", step2]) == 1
+    assert main(["verify", str(root / "step_1")]) == 0
+
+    # 3) restore_latest falls back to the previous committed step
+    metrics.reset()
+    with knobs.override_metrics(True):
+        dst = _state(0)
+        assert mgr.restore_latest(dst) == 1
+        np.testing.assert_array_equal(dst["m"]["w"], np.full((1024,), 1.0))
+        assert dst["m"]["step"] == 1
+        assert (
+            metrics.counter("tpusnap_restore_fallbacks_total").get(
+                reason="ChecksumError"
+            )
+            == 1
+        )
+
+
+def test_torn_manifest_skipped(tmp_path):
+    """A .snapshot_metadata that EXISTS but doesn't parse (torn before the
+    atomic-rename hardening, or bit-rotted after) counts as committed for
+    discovery yet must not stop a resume: restore_latest falls past it."""
+    root = tmp_path / "ckpts"
+    mgr = SnapshotManager(str(root))
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    (root / "step_2" / ".snapshot_metadata").write_bytes(b"{torn garbage")
+
+    dst = _state(0)
+    assert mgr.restore_latest(dst) == 1
+    assert dst["m"]["step"] == 1
+
+
+def test_all_snapshots_bad_raises(tmp_path):
+    root = tmp_path / "ckpts"
+    mgr = SnapshotManager(str(root))
+    mgr.save(1, _state(1))
+    (root / "step_1" / ".snapshot_metadata").write_bytes(b"{torn garbage")
+    with pytest.raises(RuntimeError, match="all 1 committed snapshots"):
+        mgr.restore_latest(_state(0))
+
+
+def test_empty_root_still_returns_none(tmp_path):
+    mgr = SnapshotManager(str(tmp_path / "ckpts"))
+    assert mgr.restore_latest(_state(0)) is None

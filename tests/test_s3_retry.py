@@ -1,0 +1,140 @@
+"""S3 retry scenarios at fault-injection parity with test_gcs_retry.py.
+
+The gcs suite proves the shared-deadline strategy + transient taxonomy with
+no network; this ports the same scenarios to S3's bounded-attempt loop —
+classification through the SHARED taxonomy (retry.py), the shared jittered
+backoff, discarded-body 5xx PUT/GET faults against the fake server
+(``fail_puts``/``fail_gets``, the ``fail_put_chunks`` analogues), and the
+``record_retry("s3")`` metric the backoff loop feeds.
+"""
+
+import time
+
+import pytest
+
+from torchsnapshot_tpu import knobs, retry
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.telemetry import metrics
+
+from fake_s3 import FakeS3Server
+
+
+@pytest.fixture()
+def s3_env(monkeypatch):
+    server = FakeS3Server()
+    monkeypatch.setenv("TPUSNAP_S3_ENDPOINT", server.endpoint)
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-access-key")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret-key")
+    yield server
+    server.stop()
+
+
+def _plugin(root="bkt/pre"):
+    from torchsnapshot_tpu.storage_plugins.s3 import S3StoragePlugin
+
+    return S3StoragePlugin(root=root)
+
+
+class _FakeHTTPError(Exception):
+    def __init__(self, status):
+        class R:
+            status_code = status
+
+        self.response = R()
+
+
+def test_shared_transient_classification():
+    """Same taxonomy test_gcs_retry runs, through the SHARED classifier
+    the s3 plugin's status set now aliases."""
+    from torchsnapshot_tpu.storage_plugins.s3 import _TRANSIENT_STATUS
+
+    for status in (408, 429, 500, 502, 503, 504):
+        assert status in _TRANSIENT_STATUS
+        assert retry.is_transient(_FakeHTTPError(status))
+    for status in (400, 401, 403, 404, 412):
+        assert status not in _TRANSIENT_STATUS
+        assert not retry.is_transient(_FakeHTTPError(status))
+    assert retry.is_transient(ConnectionError("reset"))
+    assert retry.is_transient(TimeoutError())
+    assert retry.is_transient(retry.StorageTransientError("typed"))
+    assert not retry.is_transient(ValueError("bad request body"))
+
+
+def test_shared_backoff_bounds():
+    """The shared policy is exponential with ±50% jitter under its cap —
+    every layer (gcs, s3, scheduler, commit) sleeps through this one
+    implementation."""
+    for attempt in range(1, 6):
+        for _ in range(20):
+            delay = retry.backoff_s(attempt, base_s=0.2, cap_s=2.0)
+            ideal = min(2.0, 0.2 * 2 ** (attempt - 1))
+            assert 0.5 * ideal <= delay <= 1.5 * ideal
+    with knobs.override_retry_base_s(0.001):
+        assert retry.backoff_s(1) <= 0.0015
+
+
+def test_put_retries_after_discarded_5xx(s3_env, monkeypatch):
+    """fail_puts discards the body before the 503 (fake_gcs's
+    fail_put_chunks contract): the retried PUT must RE-SEND the bytes, and
+    each retry lands on the record_retry("s3") counter."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    metrics.reset()
+    with knobs.override_metrics(True):
+        plugin = _plugin()
+        payload = bytes(range(256)) * 16
+        s3_env.fail_puts = 2
+        plugin.sync_write(WriteIO(path="retry.bin", buf=payload))
+        assert s3_env.objects["bkt/pre/retry.bin"] == payload
+        assert s3_env.fail_puts == 0
+        assert (
+            metrics.counter("tpusnap_storage_retries_total").get(backend="s3")
+            >= 2
+        )
+        plugin.sync_close()
+
+
+def test_get_retries_after_5xx(s3_env, monkeypatch):
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    metrics.reset()
+    with knobs.override_metrics(True):
+        plugin = _plugin()
+        payload = b"stable-bytes" * 100
+        plugin.sync_write(WriteIO(path="g.bin", buf=payload))
+        s3_env.fail_gets = 2
+        read_io = ReadIO(path="g.bin")
+        plugin.sync_read(read_io)
+        assert bytes(read_io.buf) == payload
+        assert (
+            metrics.counter("tpusnap_storage_retries_total").get(backend="s3")
+            >= 2
+        )
+        plugin.sync_close()
+
+
+def test_deterministic_fail_at_requests(s3_env, monkeypatch):
+    """fail_at_requests pins faults to exact global request indices — the
+    deterministic-schedule hook fail_at_chunks gives the gcs fake."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    plugin = _plugin()
+    # Request 1 = this PUT's first attempt: fails; attempt 2 succeeds.
+    s3_env.fail_at_requests = {1}
+    plugin.sync_write(WriteIO(path="d.bin", buf=b"deterministic"))
+    assert s3_env.objects["bkt/pre/d.bin"] == b"deterministic"
+    assert s3_env.request_count >= 2
+    plugin.sync_close()
+
+
+def test_exhausted_attempts_surface_terminal(s3_env, monkeypatch):
+    """A persistent 5xx exhausts the plugin's bounded budget and surfaces
+    as a terminal error (the scheduler must NOT re-retry a budget the
+    plugin already spent)."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    plugin = _plugin()
+    s3_env.fail_next = 99
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="failed after") as excinfo:
+        plugin.sync_write(WriteIO(path="x.bin", buf=b"doomed"))
+    assert not retry.is_transient(excinfo.value)
+    assert time.monotonic() - t0 < 30
+    s3_env.fail_next = 0
+    plugin.sync_close()

@@ -10,6 +10,7 @@ API mirrors the reference's tiny surface:
 from .rng_state import RNGState
 from .manager import SnapshotManager
 from .replication import copy_snapshot
+from .retry import StorageTransientError
 from .snapshot import PendingSnapshot, Snapshot
 from .state_dict import StateDict
 from .stateful import AppState, Stateful
@@ -22,6 +23,7 @@ __all__ = [
     "StateDict",
     "RNGState",
     "SnapshotManager",
+    "StorageTransientError",
     "copy_snapshot",
 ]
 

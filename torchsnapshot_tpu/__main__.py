@@ -4,14 +4,15 @@
     python -m torchsnapshot_tpu cat <snapshot-url> <rank/logical/path>
     python -m torchsnapshot_tpu info <snapshot-url>
     python -m torchsnapshot_tpu steps <manager-root-url>
+    python -m torchsnapshot_tpu gc <manager-root-url> [--apply]
     python -m torchsnapshot_tpu verify <snapshot-url>
     python -m torchsnapshot_tpu diff <snapshot-url-a> <snapshot-url-b>
     python -m torchsnapshot_tpu cp <src-url> <dst-url> [--verify]
     python -m torchsnapshot_tpu stats <snapshot-url> [--json] [--metrics]
     python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
 
-Read-only except ``cp``; works against any storage backend URL.  (Beyond
-reference parity: the reference ships no CLI.)
+Read-only except ``cp`` and ``gc --apply``; works against any storage
+backend URL.  (Beyond reference parity: the reference ships no CLI.)
 """
 
 from __future__ import annotations
@@ -194,6 +195,45 @@ def cmd_steps(args: argparse.Namespace) -> int:
     for step in steps:
         print(f"step_{step}")
     print(f"latest: {steps[-1]}")
+    return 0
+
+
+def cmd_gc(args: argparse.Namespace) -> int:
+    """List (default) or remove (``--apply``) uncommitted snapshot
+    directories under a SnapshotManager root: ``step_*`` dirs without a
+    ``.snapshot_metadata`` commit marker — what a crashed take leaves when
+    its cleanup never ran.  Dry run by default because an async save still
+    in flight is indistinguishable from a crashed one; apply only when no
+    save is running."""
+    from .manager import SnapshotManager
+    from .pg_wrapper import PGWrapper
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+    from .storage_plugin import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(args.path)
+    try:
+        if storage.sync_exists(SNAPSHOT_METADATA_FNAME):
+            print(
+                f"{args.path} is a committed snapshot, not a manager root; "
+                "refusing to gc inside it"
+            )
+            return 2
+    finally:
+        storage.sync_close()
+    mgr = SnapshotManager(args.path, pg=PGWrapper())
+    if args.apply:
+        removed = mgr.gc(apply=True)
+        for step in removed:
+            print(f"removed step_{step} (uncommitted)")
+        print(f"{len(removed)} orphaned snapshot dir(s) removed")
+    else:
+        orphans = mgr.orphan_steps()
+        for step in orphans:
+            print(f"orphan step_{step} (no {SNAPSHOT_METADATA_FNAME})")
+        print(
+            f"{len(orphans)} orphaned snapshot dir(s); re-run with --apply "
+            "to remove (only when no save is in flight)"
+        )
     return 0
 
 
@@ -452,6 +492,17 @@ def main(argv=None) -> int:
     )
     p.add_argument("path")
     p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser(
+        "gc", help="list/remove uncommitted snapshot dirs under a root"
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--apply",
+        action="store_true",
+        help="remove the orphans (default: dry-run listing)",
+    )
+    p.set_defaults(fn=cmd_gc)
 
     p = sub.add_parser(
         "diff", help="compare two snapshots' content by logical path"

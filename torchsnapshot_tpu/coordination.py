@@ -52,10 +52,12 @@ class JaxCoordinationStore(KVStore):
     def set(self, key: str, value: bytes) -> None:
         self._client.key_value_set_bytes(key, value)
 
-    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+    def get(self, key: str, timeout_s=None) -> bytes:
+        from .dist_store import resolve_wait_timeout_s
+
         try:
             return self._client.blocking_key_value_get_bytes(
-                key, int(timeout_s * 1000)
+                key, int(resolve_wait_timeout_s(timeout_s) * 1000)
             )
         except Exception as e:
             # Normalize the service's DEADLINE_EXCEEDED XlaRuntimeError to the

@@ -30,9 +30,18 @@ import time
 import uuid
 from typing import Optional
 
+from . import knobs
+
 
 class StorePeerError(RuntimeError):
     """Raised on ranks whose peer reported an error through the barrier."""
+
+
+def resolve_wait_timeout_s(timeout_s: Optional[float]) -> float:
+    """``None`` means "use the ``TPUSNAP_BARRIER_TIMEOUT_S`` knob" — one
+    resolution point so every store implementation and the barrier agree
+    on what an unspecified wait bound is."""
+    return knobs.get_barrier_timeout_s() if timeout_s is None else timeout_s
 
 
 class KVStore(abc.ABC):
@@ -41,8 +50,10 @@ class KVStore(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
-        """Block until ``key`` exists, then return its value."""
+    def get(self, key: str, timeout_s: Optional[float] = None) -> bytes:
+        """Block until ``key`` exists, then return its value.  ``None``
+        timeout resolves through the ``TPUSNAP_BARRIER_TIMEOUT_S`` knob
+        (default 1800 s)."""
         ...
 
     @abc.abstractmethod
@@ -115,8 +126,8 @@ class FileStore(KVStore):
         except FileNotFoundError:
             return None
 
-    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
-        deadline = time.monotonic() + timeout_s
+    def get(self, key: str, timeout_s: Optional[float] = None) -> bytes:
+        deadline = time.monotonic() + resolve_wait_timeout_s(timeout_s)
         i = 0
         while True:
             value = self.try_get(key)
@@ -272,7 +283,7 @@ class PrefixStore(KVStore):
     def set(self, key: str, value: bytes) -> None:
         self._store.set(self._k(key), value)
 
-    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+    def get(self, key: str, timeout_s: Optional[float] = None) -> bytes:
         return self._store.get(self._k(key), timeout_s)
 
     def try_get(self, key: str) -> Optional[bytes]:
@@ -346,21 +357,21 @@ class LinearBarrier:
         if err is not None:
             raise StorePeerError(err.decode("utf-8", errors="replace"))
 
-    def _blocking_wait(self, key: str, timeout_s: float) -> None:
+    def _blocking_wait(self, key: str, timeout_s: Optional[float]) -> None:
         try:
-            self._store.get(key, timeout_s=timeout_s)
+            self._store.get(key, timeout_s=resolve_wait_timeout_s(timeout_s))
         except TimeoutError:
             self._check_error()
             raise TimeoutError(f"LinearBarrier timed out waiting on {key}")
         self._check_error()
 
-    def arrive(self, timeout_s: float = 1800.0) -> None:
+    def arrive(self, timeout_s: Optional[float] = None) -> None:
         if self._store.add("arrived", 1) >= self._world_size:
             self._store.set("all_arrived", b"1")
         if self._rank == self._leader_rank:
             self._blocking_wait("all_arrived", timeout_s)
 
-    def depart(self, timeout_s: float = 1800.0) -> None:
+    def depart(self, timeout_s: Optional[float] = None) -> None:
         if self._rank == self._leader_rank:
             self._store.set("departed", b"1")
         else:

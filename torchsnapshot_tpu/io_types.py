@@ -69,6 +69,14 @@ class Future(Generic[T]):
 class WriteIO:
     path: str
     buf: BufferType
+    # Crash-durability request: the write must survive a host crash the
+    # moment it returns (fs fsyncs the file AND its parent dir before/after
+    # the atomic rename).  Set by commit-critical writes only — the
+    # ``.snapshot_metadata`` marker whose existence IS the committed signal;
+    # payload writes stay fast (they are re-creatable until the commit).
+    # Backends whose writes are already durable-on-ack (object stores)
+    # ignore it.
+    durable: bool = False
 
 
 @dataclass

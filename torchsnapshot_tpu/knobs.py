@@ -35,6 +35,10 @@ COMPRESSION_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "COMPRESSION_MIN_BYTES"
 TRACE_DIR_ENV_VAR = _ENV_PREFIX + "TRACE_DIR"
 METRICS_ENV_VAR = _ENV_PREFIX + "METRICS"
 SIDECAR_ENV_VAR = _ENV_PREFIX + "SIDECAR"
+FAULTS_ENV_VAR = _ENV_PREFIX + "FAULTS"
+IO_RETRIES_ENV_VAR = _ENV_PREFIX + "IO_RETRIES"
+RETRY_BASE_S_ENV_VAR = _ENV_PREFIX + "RETRY_BASE_S"
+BARRIER_TIMEOUT_S_ENV_VAR = _ENV_PREFIX + "BARRIER_TIMEOUT_S"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -42,6 +46,11 @@ _DEFAULT_SLAB_SIZE_THRESHOLD_BYTES = 128 * 1024 * 1024
 _DEFAULT_MAX_PER_RANK_IO_CONCURRENCY = 16
 _DEFAULT_MAX_READ_MERGE_GAP_BYTES = 8 * 1024 * 1024
 _DEFAULT_CLOUD_PARALLEL_MIN_BYTES = 64 * 1024 * 1024
+_DEFAULT_IO_RETRIES = 2
+_DEFAULT_RETRY_BASE_S = 0.2
+# Matches PendingSnapshot's historical DEFAULT_BARRIER_TIMEOUT_S and the
+# KV stores' wait default.
+_DEFAULT_BARRIER_TIMEOUT_S = 1800.0
 # Payloads below this stay raw even with compression on: tiny leaves keep
 # their slab batching (compressed payloads can't pre-assign slab offsets —
 # their size is unknown at plan time) and skip per-chunk codec overhead
@@ -318,6 +327,68 @@ def override_metrics(enabled: bool) -> Generator[None, None, None]:
 @contextmanager
 def override_sidecar(enabled: bool) -> Generator[None, None, None]:
     with _override_env(SIDECAR_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+def get_faults_spec() -> Optional[str]:
+    """The ``TPUSNAP_FAULTS`` fault-injection spec (faults.py grammar), or
+    None — injection disabled (the default; no wrapper is installed and
+    the fault layer costs nothing)."""
+    val = os.environ.get(FAULTS_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_io_retries() -> int:
+    """Bounded retry budget for transient storage-write failures: how many
+    times the scheduler re-attempts one write request (and rank 0 the
+    metadata commit) beyond the first try.  0 disables pipeline-level
+    retries; plugin-internal loops (gcs/s3) keep their own budgets."""
+    return max(0, _get_int_env(IO_RETRIES_ENV_VAR, _DEFAULT_IO_RETRIES))
+
+
+def get_retry_base_s(default: Optional[float] = None) -> float:
+    """Base of the shared jittered-exponential backoff (retry.backoff_s).
+
+    The env var, when set, overrides EVERY layer's base — including callers
+    with a calibrated default (gcs's 2 s ramp) — so tests and chaos runs
+    scale all retry sleeps down at once.  Unset, ``default`` (the caller's
+    calibrated base) wins, then the global 0.2 s."""
+    val = os.environ.get(RETRY_BASE_S_ENV_VAR)
+    if val is not None:
+        return float(val)
+    return default if default is not None else _DEFAULT_RETRY_BASE_S
+
+
+def get_barrier_timeout_s() -> float:
+    """Timeout for store-based waits: the async-commit LinearBarrier's
+    arrive/depart and KV-store blocking GETs.  A peer's ``report_error``
+    always wakes waiters immediately — this bounds how long a silent
+    (crashed-without-reporting) peer can park the job."""
+    val = os.environ.get(BARRIER_TIMEOUT_S_ENV_VAR)
+    return float(val) if val is not None else _DEFAULT_BARRIER_TIMEOUT_S
+
+
+@contextmanager
+def override_faults(spec: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(FAULTS_ENV_VAR, spec):
+        yield
+
+
+@contextmanager
+def override_io_retries(value: int) -> Generator[None, None, None]:
+    with _override_env(IO_RETRIES_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_retry_base_s(value: float) -> Generator[None, None, None]:
+    with _override_env(RETRY_BASE_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_barrier_timeout_s(value: float) -> Generator[None, None, None]:
+    with _override_env(BARRIER_TIMEOUT_S_ENV_VAR, str(value)):
         yield
 
 

@@ -17,10 +17,14 @@ import logging
 import re
 from typing import List, Optional, Union
 
+from . import retry
+from .event import Event
+from .event_handlers import log_event
 from .pg_wrapper import PGWrapper
 from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
 from .stateful import AppState
 from .storage_plugin import url_to_storage_plugin
+from .telemetry import metrics as tmetrics
 
 logger = logging.getLogger(__name__)
 
@@ -122,16 +126,125 @@ class SnapshotManager:
     # -------------------------------------------------------------- restore
 
     def restore_latest(self, app_state: AppState) -> Optional[int]:
-        """Restore the newest committed snapshot; returns its step or None
-        (the standard resume-if-possible idiom)."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        Snapshot(self.path_for_step(step), pg=self._pg).restore(app_state)
-        return step
+        """Restore the newest committed snapshot that actually loads;
+        returns its step or None (the standard resume-if-possible idiom).
+
+        Last-good fallback: a committed-looking snapshot can still be
+        unloadable — a torn/bit-rotted manifest, a payload whose checksum
+        audit fails mid-restore, an unreadable object.  Each such failure
+        is logged loudly, counted (``tpusnap_restore_fallbacks_total``,
+        ``restore_latest.fallback`` event), and the previous committed step
+        is tried, so a resume lands on the newest GOOD restore point
+        instead of dying on a bad one.  TRANSIENT storage errors
+        (``retry.is_transient``) re-raise instead of falling back — a 5xx
+        burst says nothing about the snapshot's integrity, and silently
+        resuming from stale weights would be worse than failing the
+        resume.  Only when every committed step fails terminally does the
+        first (newest) error propagate.  Multi-rank caveat:
+        restore is collective — ranks must fail identically (shared
+        storage) for the fallback to stay coherent; per-rank divergent
+        corruption surfaces as a collective error instead."""
+        steps = self.all_steps()
+        first_error: Optional[BaseException] = None
+        for fallbacks, step in enumerate(reversed(steps)):
+            try:
+                Snapshot(self.path_for_step(step), pg=self._pg).restore(
+                    app_state
+                )
+            except Exception as e:  # noqa: BLE001
+                if retry.is_transient(e):
+                    # A transient storage blip (5xx burst, NFS hiccup) says
+                    # nothing about THIS snapshot's integrity: falling back
+                    # would silently resume from stale weights.  Surface it
+                    # — the caller retries the resume; fallback is reserved
+                    # for integrity-class failures (torn manifest,
+                    # ChecksumError, unreadable payload).
+                    raise
+                if first_error is None:
+                    first_error = e
+                tmetrics.record_restore_fallback(type(e).__name__)
+                log_event(
+                    Event(
+                        name="restore_latest.fallback",
+                        metadata={
+                            "step": step,
+                            "rank": self._pg.get_rank(),
+                            "error": repr(e),
+                        },
+                    )
+                )
+                logger.warning(
+                    "restore of committed step_%d failed (%r); falling "
+                    "back to the previous committed step",
+                    step,
+                    e,
+                )
+                continue
+            if fallbacks:
+                logger.warning(
+                    "restore_latest landed on step_%d after skipping %d "
+                    "newer committed snapshot(s)",
+                    step,
+                    fallbacks,
+                )
+            return step
+        if first_error is not None:
+            raise RuntimeError(
+                f"restore_latest: all {len(steps)} committed snapshots "
+                f"under {self.root} failed to restore"
+            ) from first_error
+        return None
 
     def snapshot(self, step: int) -> Snapshot:
         return Snapshot(self.path_for_step(step), pg=self._pg)
+
+    # ------------------------------------------------------------------- gc
+
+    def orphan_steps(self, storage=None) -> List[int]:
+        """Step directories present but UNcommitted (no
+        ``.snapshot_metadata``) — a crashed take whose cleanup never ran,
+        or an async save still in flight.  Ascending."""
+        own = storage is None
+        if own:
+            storage = url_to_storage_plugin(self.root)
+        try:
+            orphans = []
+            for name in storage.sync_list_dir(""):
+                m = _STEP_RE.match(name)
+                if m and not self._is_committed(storage, int(m.group(1))):
+                    orphans.append(int(m.group(1)))
+            return sorted(orphans)
+        finally:
+            if own:
+                storage.sync_close()
+
+    def gc(self, apply: bool = True) -> List[int]:
+        """Remove uncommitted (orphaned) step directories; returns the
+        steps removed (or, with ``apply=False``, the steps that WOULD be).
+
+        Caller's caveat: an async save that hasn't committed yet is
+        indistinguishable from a crashed one — run GC only when no save is
+        in flight (the CLI defaults to a dry run for the same reason)."""
+        orphans = self.orphan_steps()
+        if not apply:
+            return orphans
+        storage = url_to_storage_plugin(self.root)
+        try:
+            for step in orphans:
+                logger.warning(
+                    "GC: removing uncommitted snapshot step_%d", step
+                )
+                storage.sync_delete_dir(f"step_{step}")
+                tmetrics.record_gc("orphan_removed")
+                log_event(
+                    Event(
+                        name="gc.orphan_removed",
+                        metadata={"step": step, "root": self.root},
+                    )
+                )
+        finally:
+            storage.sync_close()
+        return orphans
 
     # ---------------------------------------------------------------- prune
 

@@ -1,0 +1,177 @@
+"""Shared transient-error taxonomy + budgeted backoff policy.
+
+One fault model for the whole pipeline (docs/robustness.md): every layer
+that retries — the gcs/s3 plugins' internal loops, the scheduler's bounded
+write requeue, the rank-0 metadata commit — classifies errors through
+:func:`is_transient` and sleeps through :func:`backoff_s`, instead of the
+two hand-rolled per-plugin policies the repo grew first.
+
+Taxonomy:
+
+- **transient** — safe to retry: :class:`StorageTransientError` (the typed
+  signal a plugin or the fault injector raises deliberately), connection /
+  timeout errors, HTTP 408/429/5xx (any exception carrying a
+  ``response.status_code``), and the retryable ``OSError`` errnos a shared
+  filesystem can throw under contention (EAGAIN, EINTR, EBUSY, EIO,
+  ETIMEDOUT, ESTALE, network-down).  ENOSPC, EACCES and ENOENT are
+  deliberately **terminal**: retrying a full disk or a missing path burns
+  the budget without ever succeeding.
+- **terminal** — everything else: propagate immediately.
+
+Backoff: exponential with full ±50% jitter, base ``TPUSNAP_RETRY_BASE_S``
+(scalable to ~0 for tests), capped.  Retry *budgets* stay with the callers
+(``TPUSNAP_IO_RETRIES`` for the scheduler/commit, the gcs shared deadline,
+the s3 attempt cap) — this module only answers "is it retryable" and
+"how long to wait".
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+from typing import Optional
+
+from . import knobs
+
+__all__ = [
+    "StorageTransientError",
+    "TRANSIENT_HTTP_STATUS",
+    "is_transient",
+    "backoff_s",
+    "sleep_backoff",
+    "call_with_retries",
+]
+
+
+class StorageTransientError(RuntimeError):
+    """A storage error its raiser believes is safe to retry.
+
+    Plugins (and the fault injector, faults.py) raise this — or a subclass
+    — when they can classify a failure as transient themselves; every
+    retry layer treats it as retryable without further inspection.
+    """
+
+
+TRANSIENT_HTTP_STATUS = frozenset({408, 429, 500, 502, 503, 504})
+
+_TRANSIENT_ERRNOS = frozenset(
+    e
+    for e in (
+        errno.EAGAIN,
+        errno.EINTR,
+        errno.EBUSY,
+        errno.EIO,
+        errno.ETIMEDOUT,
+        errno.ESTALE,
+        errno.ENETDOWN,
+        errno.ENETUNREACH,
+        errno.ENETRESET,
+        getattr(errno, "EREMOTEIO", None),
+    )
+    if e is not None
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether ``exc`` is worth retrying, across every backend.
+
+    Covers the typed :class:`StorageTransientError`, HTTP status carried on
+    a ``response`` attribute (requests-style exceptions from gcs), plain
+    connection/timeout errors, the ``requests`` exception family, and
+    retryable ``OSError`` errnos from shared filesystems.  Unknown errors
+    classify terminal — a retry layer must never spin on a logic bug.
+    """
+    if isinstance(exc, StorageTransientError):
+        return True
+    status = getattr(getattr(exc, "response", None), "status_code", None)
+    if status in TRANSIENT_HTTP_STATUS:
+        return True
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        if exc.errno in _TRANSIENT_ERRNOS:
+            return True
+        # FileNotFoundError / PermissionError / ENOSPC etc.: terminal.
+    try:
+        import requests.exceptions as _rex
+    except ImportError:
+        pass
+    else:
+        if isinstance(
+            exc,
+            (
+                _rex.ConnectionError,
+                _rex.Timeout,
+                _rex.ChunkedEncodingError,
+            ),
+        ):
+            return True
+    return False
+
+
+def backoff_s(
+    attempt: int,
+    base_s: Optional[float] = None,
+    cap_s: float = 32.0,
+) -> float:
+    """Jittered exponential backoff for the ``attempt``-th retry (1-based).
+
+    ``base_s`` is the caller's calibrated base (gcs's 2 s ramp, s3's
+    0.2 s); the ``TPUSNAP_RETRY_BASE_S`` env knob, when set, overrides it
+    across EVERY layer so tests and chaos runs scale all sleeps down at
+    once.  Full ±50% jitter de-synchronizes a pod's ranks hammering one
+    storage endpoint.
+    """
+    base = knobs.get_retry_base_s(default=base_s)
+    exp = min(max(attempt, 1) - 1, 8)
+    return min(cap_s, base * (2**exp)) * (0.5 + random.random())
+
+
+def sleep_backoff(attempt: int, cancel=None, **kwargs) -> None:
+    """Blocking sleep for the ``attempt``-th retry; a ``cancel`` event
+    (threading.Event) cuts the wait short so a sibling's hard failure is
+    not held back a full backoff interval."""
+    import time
+
+    delay = backoff_s(attempt, **kwargs)
+    if cancel is not None:
+        cancel.wait(delay)
+    else:
+        time.sleep(delay)
+
+
+def call_with_retries(fn, *, stage: str, max_retries: Optional[int] = None):
+    """Run a blocking callable under the bounded transient-retry budget.
+
+    The canonical sync retry loop (the commit path uses it; the
+    scheduler's write loop stays bespoke only because its backoff must
+    sleep outside an asyncio semaphore): ``max_retries`` retries beyond
+    the first attempt (default ``TPUSNAP_IO_RETRIES``), transient-only
+    via :func:`is_transient`, each retry counted on
+    ``tpusnap_pipeline_retries_total{stage=...}`` and logged, sleeps via
+    :func:`backoff_s`.
+    """
+    import logging
+
+    from .telemetry import metrics as tmetrics
+
+    logger = logging.getLogger(__name__)
+    if max_retries is None:
+        max_retries = knobs.get_io_retries()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            if attempt >= max_retries or not is_transient(e):
+                raise
+            attempt += 1
+            tmetrics.record_pipeline_retry(stage)
+            logger.warning(
+                "transient %s failure (attempt %d/%d): %r; retrying",
+                stage,
+                attempt,
+                max_retries,
+                e,
+            )
+            sleep_backoff(attempt)

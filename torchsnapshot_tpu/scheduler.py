@@ -44,7 +44,9 @@ from typing import Awaitable, Callable, List, Optional
 
 import psutil
 
-from . import knobs, phase_stats
+from . import knobs, phase_stats, retry as retry_policy
+from .event import Event
+from .event_handlers import log_event
 from .telemetry import metrics as tmetrics
 from .telemetry import trace as ttrace
 from .io_types import (
@@ -294,12 +296,54 @@ async def execute_write_reqs(
     all_io_tasks: List[asyncio.Task] = []
     io_semaphore = asyncio.Semaphore(knobs.get_max_per_rank_io_concurrency())
     staged_bytes = 0
+    max_write_retries = knobs.get_io_retries()
     reporter = _ProgressReporter(rank=rank, total=len(write_reqs), verb="write")
 
     async def _io(pipeline: _WritePipeline) -> None:
         try:
-            async with io_semaphore:
-                await pipeline.write_buffer()
+            # Bounded retry of TRANSIENT write failures (shared taxonomy,
+            # retry.py): the staged buffer is still held (write_buffer only
+            # releases it on success), so a requeue is a pure re-send — a
+            # flaky fs/NFS blip or an injected fault no longer aborts the
+            # whole pipeline.  Terminal errors and an exhausted budget
+            # propagate exactly as before.  The backoff sleeps OUTSIDE the
+            # io semaphore so a waiting request isn't blocked by a slot
+            # parked in backoff.
+            attempt = 0
+            while True:
+                try:
+                    async with io_semaphore:
+                        await pipeline.write_buffer()
+                    break
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    if attempt >= max_write_retries or not (
+                        retry_policy.is_transient(e)
+                    ):
+                        raise
+                    attempt += 1
+                    tmetrics.record_pipeline_retry("write")
+                    log_event(
+                        Event(
+                            name="scheduler.write_retry",
+                            metadata={
+                                "path": pipeline.write_req.path,
+                                "attempt": attempt,
+                                "error": repr(e),
+                            },
+                        )
+                    )
+                    logger.warning(
+                        "[rank %d] transient write failure for %s "
+                        "(attempt %d/%d): %r; retrying",
+                        rank,
+                        pipeline.write_req.path,
+                        attempt,
+                        max_write_retries,
+                        e,
+                    )
+                    await asyncio.sleep(retry_policy.backoff_s(attempt))
             reporter.io_done += 1
             reporter.bytes_done += pipeline.buf_sz_bytes
             tmetrics.record_io_bytes("written", pipeline.buf_sz_bytes)

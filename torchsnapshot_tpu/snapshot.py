@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from . import io_preparer, knobs, phase_stats, staging
+from . import io_preparer, knobs, phase_stats, retry as retry_policy, staging
 from .telemetry import metrics as tmetrics
 from .telemetry import sidecar as tsidecar
 from .telemetry import trace as ttrace
@@ -128,21 +128,30 @@ class Snapshot:
                     storage, incremental_from, target_path=path
                 )
             try:
-                pending_io_work, metadata, _ = cls._take_impl(
-                    path=path,
-                    app_state=app_state,
-                    replicated_patterns=replicated_patterns,
-                    storage=storage,
-                    pg=pg,
-                    is_async_snapshot=False,
-                )
-                pending_io_work.sync_complete()
-                # All ranks' payloads durable → rank 0 commits (reference
-                # :202-209).
-                pg.barrier()
-                if pg.get_rank() == 0:
-                    cls._write_snapshot_metadata(metadata, storage)
-                pg.barrier()
+                try:
+                    pending_io_work, metadata, _ = cls._take_impl(
+                        path=path,
+                        app_state=app_state,
+                        replicated_patterns=replicated_patterns,
+                        storage=storage,
+                        pg=pg,
+                        is_async_snapshot=False,
+                    )
+                    pending_io_work.sync_complete()
+                    # All ranks' payloads durable → rank 0 commits
+                    # (reference :202-209).
+                    pg.barrier()
+                    if pg.get_rank() == 0:
+                        cls._write_snapshot_metadata(metadata, storage)
+                    pg.barrier()
+                except BaseException:
+                    # Crash consistency: a take that dies before the commit
+                    # tears its partially-written directory down so no
+                    # orphaned payloads accumulate (best-effort, rank 0,
+                    # guarded on the commit marker being absent — a cleanup
+                    # that itself fails leaves a GC-able orphan, CLI `gc`).
+                    cls._cleanup_failed_take(storage, pg, action="take")
+                    raise
                 # Committed: persist this rank's telemetry summary next to
                 # the payloads it describes (best-effort, opt-out via
                 # TPUSNAP_SIDECAR=0).
@@ -803,14 +812,61 @@ class Snapshot:
     def _write_snapshot_metadata(
         metadata: SnapshotMetadata, storage: StoragePlugin
     ) -> None:
+        """Rank 0's commit: the ONE write whose existence means "committed".
+
+        ``durable=True`` makes the fs plugin route it through tmp-file +
+        fsync + atomic rename + parent-dir fsync, so a crash mid-commit can
+        never leave a torn manifest that parses as committed.  Transient
+        failures are retried under the same bounded budget as pipeline
+        writes — a single 503 at the very last step must not discard a
+        fully-durable snapshot."""
         from .io_types import WriteIO
 
-        storage.sync_write(
-            WriteIO(
-                path=SNAPSHOT_METADATA_FNAME,
-                buf=metadata.to_json().encode("utf-8"),
-            )
+        payload = metadata.to_json().encode("utf-8")
+        retry_policy.call_with_retries(
+            lambda: storage.sync_write(
+                WriteIO(path=SNAPSHOT_METADATA_FNAME, buf=payload, durable=True)
+            ),
+            stage="commit",
         )
+
+    @staticmethod
+    def _cleanup_failed_take(
+        storage: StoragePlugin, pg: PGWrapper, action: str
+    ) -> None:
+        """Best-effort teardown of a take that failed before its commit.
+
+        Rank 0 only (the snapshot directory is shared), and ONLY when the
+        commit marker is absent: a take re-targeting an already-committed
+        path, or a failure after the commit landed, must never delete a
+        valid restore point.  Every failure here is swallowed and logged —
+        the orphan stays discoverable by ``gc`` either way."""
+        if pg.get_rank() != 0:
+            return
+        try:
+            if storage.sync_exists(SNAPSHOT_METADATA_FNAME):
+                return
+            storage.sync_delete_dir("")
+            tmetrics.record_gc("take_cleanup")
+            log_event(
+                Event(
+                    name=f"{action}.cleanup",
+                    metadata={"rank": pg.get_rank(), "action": action},
+                )
+            )
+            logger.warning(
+                "%s failed before commit; removed its partial snapshot "
+                "directory",
+                action,
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "%s failed before commit and cleanup also failed; the "
+                "partial snapshot directory is GC-able "
+                "(python -m torchsnapshot_tpu gc)",
+                action,
+                exc_info=True,
+            )
 
     @staticmethod
     def _validate_app_state(app_state: AppState) -> None:
@@ -1014,7 +1070,13 @@ class PendingSnapshot:
     :class:`LinearBarrier` instead.
     """
 
-    DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+    # Default for the commit barrier's arrive/depart waits; overridden by
+    # the ``TPUSNAP_BARRIER_TIMEOUT_S`` knob (knobs.get_barrier_timeout_s),
+    # which also governs KV-store blocking GETs.  Aliased to the knob's
+    # default so the two can never silently diverge.  A peer's
+    # report_error wakes waiters immediately regardless — the timeout only
+    # bounds a silently-dead peer.
+    DEFAULT_BARRIER_TIMEOUT_S = knobs._DEFAULT_BARRIER_TIMEOUT_S
 
     def __init__(
         self,
@@ -1071,14 +1133,15 @@ class PendingSnapshot:
             # storage sidecars (no collectives on this thread) — the arrive
             # barrier orders rank 0's merge after every sidecar landed.
             self._finalizer.write_sidecar(self._storage)
+            barrier_timeout_s = knobs.get_barrier_timeout_s()
             if barrier is not None:
-                barrier.arrive(timeout_s=self.DEFAULT_BARRIER_TIMEOUT_S)
+                barrier.arrive(timeout_s=barrier_timeout_s)
             if self.pg.get_rank() == 0:
                 self._metadata = self._finalizer.build_global(self._storage)
                 Snapshot._write_snapshot_metadata(self._metadata, self._storage)
                 self._finalizer.cleanup_sidecars(self._storage)
             if barrier is not None:
-                barrier.depart(timeout_s=self.DEFAULT_BARRIER_TIMEOUT_S)
+                barrier.depart(timeout_s=barrier_timeout_s)
             # Committed: persist this rank's telemetry summary (still on
             # the background thread — storage-only, no collectives).
             if tsidecar.enabled():
@@ -1113,6 +1176,17 @@ class PendingSnapshot:
                     barrier.report_error(repr(e))
                 except Exception:
                     pass
+            # Same crash consistency as the sync take: an async snapshot
+            # that dies before its commit tears down the partial directory
+            # (rank 0, best-effort, commit-marker-guarded) — a peer's
+            # StorePeerError lands here too, so rank 0 cleans up no matter
+            # which rank failed first.
+            try:
+                Snapshot._cleanup_failed_take(
+                    self._storage, self.pg, action="async_take"
+                )
+            except Exception:
+                pass
             try:
                 self._storage.sync_close()
             except Exception:

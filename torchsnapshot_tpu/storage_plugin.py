@@ -34,6 +34,29 @@ def parse_url(url_path: str) -> Tuple[str, str]:
 def url_to_storage_plugin(
     url_path: str, storage_options: Optional[Dict[str, Any]] = None
 ) -> StoragePlugin:
+    # Fault injection (faults.py) rides storage_options["faults"] or the
+    # TPUSNAP_FAULTS env var; the key is popped HERE so plugins that reject
+    # unknown options never see it, and the wrapper composes over every
+    # backend — built-in or entry-point — uniformly.
+    faults_spec: Optional[str] = None
+    if storage_options and "faults" in storage_options:
+        storage_options = dict(storage_options)
+        faults_spec = storage_options.pop("faults")
+    if faults_spec is None:
+        from . import knobs
+
+        faults_spec = knobs.get_faults_spec()
+    plugin = _resolve_plugin(url_path, storage_options)
+    if faults_spec:
+        from .faults import maybe_wrap_faults
+
+        plugin = maybe_wrap_faults(plugin, faults_spec)
+    return plugin
+
+
+def _resolve_plugin(
+    url_path: str, storage_options: Optional[Dict[str, Any]] = None
+) -> StoragePlugin:
     protocol, path = parse_url(url_path)
 
     if protocol == "fs":

@@ -89,11 +89,16 @@ class FSStoragePlugin(StoragePlugin):
             os.makedirs(parent, exist_ok=True)
             self._dir_cache.add(parent)
 
-    def _blocking_write(self, path: str, buf) -> None:
+    def _blocking_write(self, path: str, buf, durable: bool = False) -> None:
         # Write to a temp file and rename: atomic (readers never see partial
         # payloads) and breaks hard links instead of truncating a shared
         # inode (incremental snapshots hard-link unchanged payloads into new
         # snapshot dirs — an in-place rewrite would corrupt the base).
+        # ``durable`` additionally fsyncs the bytes BEFORE the rename and
+        # the parent directory AFTER it: a crash mid-commit can then never
+        # leave a name pointing at torn content, nor a rename the journal
+        # forgot — the contract the ``.snapshot_metadata`` marker needs,
+        # since its existence alone means "committed".
         from .. import phase_stats
 
         from ..io_types import ScatterBuffer
@@ -117,7 +122,19 @@ class FSStoragePlugin(StoragePlugin):
                 else:
                     with open(tmp, "wb") as f:
                         f.write(buf)
+                if durable:
+                    fd = os.open(tmp, os.O_RDONLY)
+                    try:
+                        os.fsync(fd)
+                    finally:
+                        os.close(fd)
                 os.replace(tmp, path)
+                if durable:
+                    dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+                    try:
+                        os.fsync(dfd)
+                    finally:
+                        os.close(dfd)
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -294,7 +311,11 @@ class FSStoragePlugin(StoragePlugin):
         path = os.path.join(self.root, write_io.path)
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(
-            self._get_executor(), self._blocking_write, path, write_io.buf
+            self._get_executor(),
+            self._blocking_write,
+            path,
+            write_io.buf,
+            getattr(write_io, "durable", False),
         )
 
     async def read(self, read_io: ReadIO) -> None:

@@ -19,13 +19,13 @@ from __future__ import annotations
 import asyncio
 import io
 import logging
-import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 from urllib.parse import quote as _quote
 
+from .. import retry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from ..memoryview_stream import MemoryviewStream
 
@@ -68,7 +68,11 @@ class _SharedDeadlineRetryStrategy:
         from ..telemetry import metrics as tmetrics
 
         tmetrics.record_retry("gcs")
-        backoff = min(2 ** min(attempts, 6), 32.0) * (0.5 + random.random())
+        # Shared jittered-exponential policy (retry.backoff_s): base 2 s
+        # capped at 32 s reproduces this strategy's historical ramp exactly
+        # (2**min(n,6) capped at 32, ±50% jitter) while keeping one backoff
+        # implementation for gcs/s3/scheduler/commit.
+        backoff = retry.backoff_s(attempts, base_s=2.0, cap_s=32.0)
         logger.warning("GCS transient error (%r); retrying in %.1fs", exc, backoff)
         if cancel is not None:
             cancel.wait(backoff)
@@ -77,23 +81,10 @@ class _SharedDeadlineRetryStrategy:
 
 
 def _is_transient(exc: BaseException) -> bool:
-    """(reference gcs.py:91-111)"""
-    import requests.exceptions
-
-    transient_codes = {408, 429, 500, 502, 503, 504}
-    status = getattr(getattr(exc, "response", None), "status_code", None)
-    if status in transient_codes:
-        return True
-    return isinstance(
-        exc,
-        (
-            ConnectionError,
-            TimeoutError,
-            requests.exceptions.ConnectionError,
-            requests.exceptions.Timeout,
-            requests.exceptions.ChunkedEncodingError,
-        ),
-    )
+    """Shared taxonomy (retry.is_transient): HTTP 408/429/5xx via the
+    exception's ``response.status_code``, connection/timeout errors, the
+    requests exception family (reference gcs.py:91-111 semantics)."""
+    return retry.is_transient(exc)
 
 
 class _ViewWriter(io.RawIOBase):

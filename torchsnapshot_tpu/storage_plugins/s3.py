@@ -32,11 +32,18 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 from xml.etree import ElementTree
 
+from .. import retry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 
 _IO_THREADS = 16
-_TRANSIENT_STATUS = {429, 500, 502, 503, 504}
+# Shared taxonomy (retry.py): same status set every retry layer classifies.
+_TRANSIENT_STATUS = retry.TRANSIENT_HTTP_STATUS
 _MAX_ATTEMPTS = 5
+# Shared backoff policy parameters for this plugin's internal attempt loops
+# (retry.backoff_s): quick ramp, low cap — S3 throttling clears fast and the
+# scheduler holds the longer-horizon budget above us.
+_BACKOFF_BASE_S = 0.2
+_BACKOFF_CAP_S = 2.0
 _UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 
 # AWS rejects single PUTs over 5 GB; payloads past the threshold go through
@@ -235,8 +242,6 @@ class S3StoragePlugin(StoragePlugin):
         return f"{url}?{query}" if query else url
 
     def _request(self, method: str, url: str, *, data=None, headers=None):
-        import time as _time
-
         headers = dict(headers or {})
         last_exc: Optional[BaseException] = None
         for attempt in range(_MAX_ATTEMPTS):
@@ -244,7 +249,9 @@ class S3StoragePlugin(StoragePlugin):
                 from ..telemetry import metrics as tmetrics
 
                 tmetrics.record_retry("s3")
-                _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
+                retry.sleep_backoff(
+                    attempt, base_s=_BACKOFF_BASE_S, cap_s=_BACKOFF_CAP_S
+                )
             req_headers = dict(headers)
             if self._signer is not None:
                 self._signer.sign(method, url, req_headers)
@@ -401,8 +408,6 @@ class S3StoragePlugin(StoragePlugin):
         Owns its retry loop instead of riding ``_request``: transient
         errors can surface mid-body here, after ``_request`` would already
         have returned."""
-        import time as _time
-
         expected = view.nbytes
         url = self._url(self._key(path))
         last_exc: Optional[BaseException] = None
@@ -417,7 +422,12 @@ class S3StoragePlugin(StoragePlugin):
                 from ..telemetry import metrics as tmetrics
 
                 tmetrics.record_retry("s3")
-                _time.sleep(min(0.2 * 2 ** (attempt - 1), 2.0))
+                retry.sleep_backoff(
+                    attempt,
+                    base_s=_BACKOFF_BASE_S,
+                    cap_s=_BACKOFF_CAP_S,
+                    cancel=cancel,
+                )
             req_headers = {}
             if start is not None:
                 req_headers["Range"] = f"bytes={start}-{end - 1}"

@@ -272,6 +272,53 @@ def record_retry(backend: str) -> None:
     ).inc(backend=backend)
 
 
+def record_pipeline_retry(stage: str) -> None:
+    """A bounded pipeline-level retry of a failed storage write: the
+    scheduler requeueing a write request (``stage="write"``) or rank 0
+    re-attempting the metadata commit (``stage="commit"``)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_pipeline_retries_total",
+        "Transient write failures retried at the pipeline layer",
+    ).inc(stage=stage)
+
+
+def record_restore_fallback(reason: str) -> None:
+    """restore_latest skipped a committed-looking snapshot that failed to
+    load (torn manifest, checksum mismatch, unreadable payload) and fell
+    back to the previous step."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_restore_fallbacks_total",
+        "Snapshots skipped by restore_latest's last-good fallback",
+    ).inc(reason=reason)
+
+
+def record_gc(kind: str) -> None:
+    """A crash-consistency GC action: ``take_cleanup`` (a failed take tore
+    down its partial dir) or ``orphan_removed`` (gc removed an uncommitted
+    snapshot dir)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_gc_actions_total",
+        "Partial-snapshot cleanup and orphan-GC actions",
+    ).inc(kind=kind)
+
+
+def record_fault(op: str, kind: str) -> None:
+    """A deliberately injected fault fired (faults.py) — lets a chaos run
+    assert its schedule actually executed."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_faults_injected_total",
+        "Faults fired by the deterministic injection wrapper",
+    ).inc(op=op, kind=kind)
+
+
 def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
     """One framed payload's in/out byte counts; ratio derives at query
     time as uncompressed_total / compressed_total."""

@@ -185,11 +185,15 @@ class TCPStore(KVStore):
             raise RuntimeError(f"tpustore set failed for {key}: status {status}")
         self._checkin(handle)
 
-    def get(self, key: str, timeout_s: float = 1800.0) -> bytes:
+    def get(self, key: str, timeout_s=None) -> bytes:
+        from .dist_store import resolve_wait_timeout_s
+
         handle = self._checkout()
         try:
             status = self._lib.tpustore_client_get(
-                handle, key.encode(), int(timeout_s * 1000)
+                handle,
+                key.encode(),
+                int(resolve_wait_timeout_s(timeout_s) * 1000),
             )
             if status == 0:
                 value = self._read_value(handle)
