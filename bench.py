@@ -555,6 +555,7 @@ def main() -> None:
             "throughput_gbps": doc.get("throughput_gbps"),
             "phases": doc.get("phases"),
             "knobs": doc.get("knobs"),
+            "rss_high_water_bytes": doc.get("rss_high_water_bytes"),
         }
         log(f"telemetry sidecar: {telemetry_sidecar['path']}")
     log(f"sync save: {save_s:.2f}s -> {save_gbps:.2f} GB/s (runs: {save_attempts_s})")

@@ -1,0 +1,338 @@
+"""Bottleneck analyzer + step-history regression tracking.
+
+The analyzer golden test builds a synthetic two-rank trace+sidecar
+fixture with a KNOWN straggler (rank 1, 2x slower) and a KNOWN dominant
+phase (fs_write) and asserts ``tpusnap analyze --json`` names both; the
+CLI must exit nonzero on schema-invalid trace input.
+"""
+
+import json
+import os
+
+import pytest
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.__main__ import main as cli_main
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+from torchsnapshot_tpu.telemetry import analyze, history, metrics
+
+OP = "deadbeefcafef00d" * 2
+
+
+def _trace_doc(kind, op, rank, op_dur_us, phases):
+    """phases: [(name, begin_us, dur_us, nbytes)]"""
+    events = [
+        {
+            "name": kind,
+            "cat": "op",
+            "ph": "X",
+            "ts": 0.0,
+            "dur": float(op_dur_us),
+            "pid": rank,
+            "tid": 0,
+            "args": {"op": op, "success": True},
+        }
+    ]
+    for name, begin, dur, nbytes in phases:
+        events.append(
+            {
+                "name": name,
+                "cat": "phase",
+                "ph": "X",
+                "ts": float(begin),
+                "dur": float(dur),
+                "pid": rank,
+                "tid": 1,
+                "args": {"bytes": nbytes},
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"op": op, "kind": kind, "rank": rank, "success": True},
+    }
+
+
+@pytest.fixture
+def two_rank_fixture(tmp_path):
+    """Rank 0: 10 s take, fs_write-dominated.  Rank 1: the straggler —
+    20 s, fs_write even more dominant.  Plus per-rank sidecars."""
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    s = 1e6  # seconds -> trace microseconds
+    docs = {
+        0: _trace_doc(
+            "take",
+            OP,
+            0,
+            10 * s,
+            [
+                ("d2h", 0 * s, 2 * s, 1 << 30),
+                ("serialize", 2 * s, 1 * s, 1 << 30),
+                ("fs_write", 3 * s, 6 * s, 1 << 30),
+            ],
+        ),
+        1: _trace_doc(
+            "take",
+            OP,
+            1,
+            20 * s,
+            [
+                ("d2h", 0 * s, 2 * s, 1 << 30),
+                ("serialize", 2 * s, 1 * s, 1 << 30),
+                ("fs_write", 3 * s, 16 * s, 1 << 30),
+            ],
+        ),
+    }
+    for rank, doc in docs.items():
+        path = trace_dir / f"take-{OP[:8]}-rank{rank}.trace.json"
+        path.write_text(json.dumps(doc))
+    snap_dir = tmp_path / "snap"
+    (snap_dir / "telemetry").mkdir(parents=True)
+    for rank, dur in ((0, 10.0), (1, 20.0)):
+        (snap_dir / "telemetry" / f"take-{OP[:8]}-rank{rank}.json").write_text(
+            json.dumps(
+                {
+                    "schema_version": "1.0",
+                    "action": "take",
+                    "op_id": OP,
+                    "rank": rank,
+                    "timestamp": 1700000000.0 + rank,
+                    "success": True,
+                    "duration_s": dur,
+                    "bytes": 1 << 30,
+                    "throughput_gbps": round((1 << 30) / 1e9 / dur, 4),
+                    "phases": {},
+                    "knobs": {},
+                    "rss_high_water_bytes": 123456789,
+                }
+            )
+        )
+    return trace_dir, snap_dir
+
+
+def test_analyze_json_names_straggler_and_dominant_phase(
+    two_rank_fixture, capsys
+):
+    trace_dir, snap_dir = two_rank_fixture
+    rc = cli_main(
+        ["analyze", str(trace_dir), "--snapshot", str(snap_dir), "--json"]
+    )
+    assert rc == 0
+    analysis = json.loads(capsys.readouterr().out)
+    (op,) = analysis["ops"]
+    assert op["kind"] == "take" and op["world"] == 2
+    # The known straggler and the known dominant phase, by name.
+    assert op["straggler_rank"] == 1
+    assert op["dominant_phase"] == "fs_write"
+    assert op["limiting_resource"] == "storage_io"
+    assert op["skew"] == pytest.approx(2.0)
+    assert op["duration_s"]["max"] == pytest.approx(20.0)
+    assert op["phases"]["fs_write"]["slowest_rank"] == 1
+    assert op["phases"]["fs_write"]["max_wall_s"] == pytest.approx(16.0)
+    # Idle: rank 0 has 1 s uncovered (10 - 9), rank 1 has 1 s (20 - 19).
+    assert op["idle"]["by_rank"]["0"] == pytest.approx(1.0)
+    # Sidecars enriched the report per rank.
+    assert op["sidecars"]["1"]["duration_s"] == 20.0
+    assert op["sidecars"]["0"]["rss_high_water_bytes"] == 123456789
+
+
+def test_analyze_human_output_names_both(two_rank_fixture, capsys):
+    trace_dir, _ = two_rank_fixture
+    rc = cli_main(["analyze", str(trace_dir)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "straggler: rank 1" in out
+    assert "dominant phase fs_write" in out
+    assert "limiting resource: storage_io" in out
+
+
+def test_analyze_rejects_invalid_trace(tmp_path, capsys):
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "x.trace.json").write_text('{"traceEvents": "nope"}')
+    assert cli_main(["analyze", str(bad)]) == 1
+    (bad / "x.trace.json").write_text("not json at all")
+    assert cli_main(["analyze", str(bad)]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main(["analyze", str(empty)]) == 2
+
+
+def test_analyze_classifies_budget_and_io_cap_throttling():
+    s = 1e6
+    budget_doc = _trace_doc(
+        "take",
+        "a" * 32,
+        0,
+        10 * s,
+        [
+            ("budget_wait", 0 * s, 7 * s, 0),
+            ("fs_write", 0 * s, 3 * s, 1 << 20),
+        ],
+    )
+    (op,) = analyze.analyze_traces([budget_doc])["ops"]
+    assert op["limiting_resource"] == "memory_budget"
+    assert op["dominant_phase"] == "fs_write"  # wait groups never dominate
+
+    slot_doc = _trace_doc(
+        "take",
+        "b" * 32,
+        0,
+        10 * s,
+        [
+            ("io_slot_wait", 0 * s, 6 * s, 0),
+            ("fs_write", 0 * s, 4 * s, 1 << 20),
+        ],
+    )
+    (op,) = analyze.analyze_traces([slot_doc])["ops"]
+    assert op["limiting_resource"] == "io_concurrency"
+
+    d2h_doc = _trace_doc(
+        "take", "c" * 32, 0, 10 * s, [("d2h", 0, 8 * s, 1 << 20)]
+    )
+    (op,) = analyze.analyze_traces([d2h_doc])["ops"]
+    assert op["limiting_resource"] == "d2h"
+
+
+def test_phase_group_classification():
+    assert analyze.classify_phase("d2h") == "d2h"
+    assert analyze.classify_phase("compress") == "serialize"
+    assert analyze.classify_phase("fs_write") == "storage_io"
+    assert analyze.classify_phase("gcs_read") == "storage_io"
+    assert analyze.classify_phase("h2d_land") == "h2d"
+    assert analyze.classify_phase("budget_wait") == "memory_budget"
+    assert analyze.classify_phase("io_slot_wait") == "io_concurrency"
+
+
+# ------------------------------------------------------------ step history
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+    yield
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+
+
+def _entry(duration_s, step, action="take"):
+    return {
+        "timestamp": 1700000000.0 + step,
+        "step": step,
+        "action": action,
+        "op_id": f"{step:08x}",
+        "rank": 0,
+        "duration_s": duration_s,
+        "bytes": 1 << 28,
+        "throughput_gbps": 1.0,
+        "top_phases": {"fs_write": duration_s * 0.8},
+    }
+
+
+def test_history_append_read_roundtrip_and_regression(tmp_path, capsys):
+    from torchsnapshot_tpu import event_handlers
+
+    events = []
+    event_handlers.register_event_handler(events.append)
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        with knobs.override_metrics(True), knobs.override_regression_factor(
+            2.0
+        ), knobs.override_regression_window(10):
+            metrics.install_event_bridge()
+            for step in range(1, 7):
+                reg = history.append(storage, _entry(1.0, step))
+                assert reg is None
+            # 6 baseline entries at 1.0 s; a 5 s save is a 5x regression.
+            reg = history.append(storage, _entry(5.0, 7))
+            assert reg is not None
+            assert reg["ratio"] == pytest.approx(5.0)
+            entries = history.read(storage)
+    finally:
+        event_handlers.unregister_event_handler(events.append)
+        storage.sync_close()
+    assert len(entries) == 7
+    assert "regression" in entries[-1]
+    regs = [e for e in events if e.name == "telemetry.regression"]
+    assert len(regs) == 1
+    assert regs[0].metadata["step"] == 7
+    assert (
+        metrics.counter("tpusnap_save_regressions_total").get(action="take")
+        == 1
+    )
+
+    # The CLI renders the trend and flags the regression.
+    rc = cli_main(["history", str(tmp_path / "root")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "7 entries total, 1 regression(s)" in out
+    rc = cli_main(["history", str(tmp_path / "root"), "--json"])
+    assert rc == 0
+    assert len(json.loads(capsys.readouterr().out)) == 7
+
+
+def test_history_regression_needs_baseline(tmp_path):
+    """Below MIN_BASELINE_ENTRIES same-action entries no verdict fires —
+    two noisy first steps must not alarm."""
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        with knobs.override_regression_factor(2.0):
+            for step in range(1, history.MIN_BASELINE_ENTRIES):
+                assert history.append(storage, _entry(1.0, step)) is None
+            assert history.append(storage, _entry(99.0, 98)) is None
+            # Baseline now complete (5 entries incl. the 99 s outlier? no:
+            # median over [1,1,1,1,99] = 1): next slow save fires.
+            assert history.append(storage, _entry(9.0, 99)) is not None
+    finally:
+        storage.sync_close()
+
+
+def test_history_factor_zero_disables(tmp_path):
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        with knobs.override_regression_factor(0):
+            for step in range(1, 8):
+                assert history.append(storage, _entry(1.0, step)) is None
+            assert history.append(storage, _entry(50.0, 8)) is None
+    finally:
+        storage.sync_close()
+
+
+def test_history_file_stays_bounded(tmp_path, monkeypatch):
+    monkeypatch.setattr(history, "MAX_HISTORY_ENTRIES", 10)
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        with knobs.override_regression_factor(0):
+            for step in range(1, 25):
+                history.append(storage, _entry(1.0, step))
+        entries = history.read(storage)
+    finally:
+        storage.sync_close()
+    assert len(entries) == 10
+    assert [e["step"] for e in entries] == list(range(15, 25))
+
+
+def test_history_render_empty(tmp_path, capsys):
+    rc = cli_main(["history", str(tmp_path / "nothing")])
+    assert rc == 0
+    assert "no step history" in capsys.readouterr().out
+
+
+def test_history_skips_torn_lines(tmp_path):
+    from torchsnapshot_tpu.io_types import WriteIO
+
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        good = json.dumps(_entry(1.0, 1))
+        storage.sync_write(
+            WriteIO(
+                path=history.HISTORY_PATH,
+                buf=(good + "\n{torn garba").encode(),
+            )
+        )
+        entries = history.read(storage)
+    finally:
+        storage.sync_close()
+    assert len(entries) == 1 and entries[0]["step"] == 1

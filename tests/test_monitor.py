@@ -1,0 +1,288 @@
+"""Pipeline health monitor: live progress API, stall watchdog (with
+injected-latency faults), heartbeat file, and escalation.
+
+The watchdog tests compose PR 3's fault injection (``TPUSNAP_FAULTS``
+latency kinds) with a short ``TPUSNAP_STALL_TIMEOUT_S``: a hung write
+must produce a stall diagnostic bundle + event + counter, while a
+slow-but-*advancing* op must not trip the watchdog (no false positives).
+"""
+
+import glob
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, event_handlers, knobs
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.telemetry import metrics, monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+    event_handlers.reset_handlers_cache()
+    saved_handlers = list(event_handlers._INPROCESS_HANDLERS)
+    yield
+    event_handlers._INPROCESS_HANDLERS[:] = saved_handlers
+    metrics.uninstall_event_bridge()
+    metrics.reset()
+    event_handlers.reset_handlers_cache()
+    assert monitor._ACTIVE == [], "leaked op monitors"
+
+
+def _capture_events():
+    events = []
+    event_handlers.register_event_handler(events.append)
+    return events
+
+
+def _state(n_leaves=1, shape=(64, 64)):
+    return {
+        "m": StateDict(
+            {f"w{i}": np.ones(shape, np.float32) for i in range(n_leaves)}
+        )
+    }
+
+
+def _stall_bundles(trace_dir):
+    return glob.glob(
+        os.path.join(str(trace_dir), monitor.STALL_BUNDLE_PREFIX + "*.txt")
+    )
+
+
+# ----------------------------------------------------------- progress API
+
+
+def test_progress_api_on_pending_snapshot(tmp_path):
+    pending = Snapshot.async_take(str(tmp_path / "snap"), _state())
+    doc = pending.progress()  # valid at any moment, any thread
+    assert doc["action"] == "async_take"
+    pending.wait()
+    doc = pending.progress()
+    assert doc["done"] is True and doc["success"] is True
+    assert doc["requests"]["total"] >= 1
+    assert doc["requests"]["written"] == doc["requests"]["total"]
+    assert doc["bytes"]["written"] >= 64 * 64 * 4
+    assert doc["rss_high_water_bytes"] > 0
+    assert doc["stalls"] == 0
+    # Per-pipeline breakdown carries the scheduler's machine-readable state.
+    assert doc["pipelines"] and doc["pipelines"][0]["verb"] == "write"
+    assert doc["pipelines"][0]["budget_total_bytes"] > 0
+
+
+def test_progress_gauges_recorded(tmp_path):
+    with knobs.override_metrics(True):
+        Snapshot.take(str(tmp_path / "snap"), _state())
+        written = metrics.gauge("tpusnap_progress_requests_written")
+        total = metrics.gauge("tpusnap_progress_requests_total")
+        assert total.get(pipeline="write") >= 1
+        assert written.get(pipeline="write") == total.get(pipeline="write")
+        assert (
+            metrics.gauge("tpusnap_progress_bytes_written").get(
+                pipeline="write"
+            )
+            >= 64 * 64 * 4
+        )
+
+
+def test_sidecars_carry_rss_high_water(tmp_path):
+    state = _state()
+    snap = Snapshot.take(str(tmp_path / "snap"), state)
+    snap.restore(_state())
+    docs = [
+        json.loads(p.read_text())
+        for p in (tmp_path / "snap" / "telemetry").glob("*.json")
+    ]
+    assert {d["action"] for d in docs} == {"take", "restore"}
+    for doc in docs:
+        assert doc["rss_high_water_bytes"] > 0
+
+
+# -------------------------------------------------------- stall watchdog
+
+
+def test_watchdog_fires_on_injected_hang(tmp_path, monkeypatch):
+    """A hung payload write (injected latency far past the stall timeout)
+    must produce a diagnostic bundle, a watchdog.stall event, and the
+    stalls counter — while the op itself still completes."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    trace_dir = tmp_path / "traces"
+    events = _capture_events()
+    with knobs.override_metrics(True), knobs.override_trace_dir(
+        str(trace_dir)
+    ), knobs.override_stall_timeout_s(0.3), knobs.override_faults(
+        "write:1:latency:1.5"
+    ):
+        snap = Snapshot.take(str(tmp_path / "snap"), _state())
+    # The save still committed (latency, not an error).
+    dst = _state()
+    snap.restore(dst)
+
+    stalls = [e for e in events if e.name == "watchdog.stall"]
+    assert stalls, [e.name for e in events]
+    md = stalls[0].metadata
+    assert md["action"] == "take"
+    assert md["idle_s"] >= 0.3
+    assert metrics.counter("tpusnap_stalls_total").get(action="take") >= 1
+
+    bundles = _stall_bundles(trace_dir)
+    assert bundles and md["bundle"] in bundles
+    text = open(bundles[0], encoding="utf-8").read()
+    # The bundle names the parked pipeline state, the budget, the asyncio
+    # tasks, and every thread's stack.
+    assert "pipeline states" in text
+    assert "budget:" in text
+    assert "pending asyncio tasks" in text
+    assert "thread stacks (faulthandler)" in text
+    assert "Thread" in text or "thread" in text
+
+
+def test_watchdog_no_false_positive_when_advancing(tmp_path, monkeypatch):
+    """Eight writes each 0.1 s slow, forced through one I/O slot: the op
+    takes ~1 s wall but a counter advances every ~0.1 s, so a 0.6 s stall
+    timeout must never fire."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    trace_dir = tmp_path / "traces"
+    events = _capture_events()
+    with knobs.override_trace_dir(str(trace_dir)), knobs.override_batching_disabled(
+        True
+    ), knobs.override_max_per_rank_io_concurrency(
+        1
+    ), knobs.override_stall_timeout_s(
+        0.6
+    ), knobs.override_faults(
+        "write:1+:latency:0.1"
+    ):
+        Snapshot.take(str(tmp_path / "snap"), _state(n_leaves=8))
+    assert [e.name for e in events if e.name == "watchdog.stall"] == []
+    assert _stall_bundles(trace_dir) == []
+
+
+def test_watchdog_escalates_through_assigned_channel():
+    """With TPUSNAP_STALL_ESCALATE=1, a stall invokes the op's escalation
+    channel (PendingSnapshot points this at its commit barrier's
+    report_error so peers un-hang as StorePeerError)."""
+    calls = []
+    events = _capture_events()
+    with knobs.override_stall_timeout_s(0.15), knobs.override_stall_escalate(
+        True
+    ):
+        mon = monitor.op_started("take", "deadbeef" * 4, rank=0)
+        mon.escalate = calls.append
+        try:
+            deadline = time.monotonic() + 5.0
+            while not calls and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            monitor.op_finished(mon, success=False)
+    assert calls and "stalled" in calls[0]
+    stalls = [e for e in events if e.name == "watchdog.stall"]
+    assert stalls and stalls[0].metadata["escalated"] is True
+
+
+def test_watchdog_disabled_by_default_starts_no_thread():
+    mon = monitor.op_started("take", "feedface" * 4, rank=0)
+    try:
+        assert mon._thread is None
+    finally:
+        monitor.op_finished(mon)
+
+
+def test_concurrent_op_phase_activity_does_not_rearm_watchdog():
+    """phase_stats is process-global: with TWO ops being monitored, one
+    op's phase activity must not fingerprint as the other's progress (it
+    would mask a genuine stall — the flagship case)."""
+    from torchsnapshot_tpu import phase_stats
+
+    mon_a = monitor.op_started("take", "a" * 32, rank=0)
+    mon_b = monitor.op_started("take", "b" * 32, rank=0)
+    try:
+        fp = mon_a._fingerprint()
+        phase_stats.add("d2h", 0.01, 128)  # op B's (or anyone's) activity
+        assert mon_a._fingerprint() == fp
+        monitor.op_finished(mon_b)
+        # Sole op again: phase activity counts as progress once more.
+        fp = mon_a._fingerprint()
+        phase_stats.add("d2h", 0.01, 128)
+        assert mon_a._fingerprint() != fp
+    finally:
+        monitor.op_finished(mon_b)
+        monitor.op_finished(mon_a)
+
+
+def test_finish_releases_scheduler_debug_refs(tmp_path):
+    """A held PendingSnapshot must not pin the scheduler's pipeline
+    containers through the monitor's debug closures after completion."""
+    pending = Snapshot.async_take(str(tmp_path / "snap"), _state())
+    pending.wait()
+    deadline = time.monotonic() + 5.0
+    while not pending.progress()["done"] and time.monotonic() < deadline:
+        time.sleep(0.02)
+    mon = pending._monitor
+    assert mon._snapshot_reporters()
+    for reporter in mon._snapshot_reporters():
+        assert reporter.debug_refs is None
+        assert reporter.loop is None
+    # progress() still renders terminal counters from the plain attributes.
+    assert pending.progress()["requests"]["written"] >= 1
+
+
+# -------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_file_rewritten(tmp_path):
+    hb = tmp_path / "hb.json"
+    with knobs.override_heartbeat_file(str(hb)), knobs.override_progress_interval_s(
+        0.05
+    ):
+        pending = Snapshot.async_take(str(tmp_path / "snap"), _state())
+        pending.wait()
+        # finish() joins the monitor thread, which writes the terminal
+        # heartbeat — but the async op finishes on the background thread;
+        # wait for the file to carry the terminal state.
+        deadline = time.monotonic() + 5.0
+        doc = None
+        while time.monotonic() < deadline:
+            if hb.exists():
+                doc = json.loads(hb.read_text())
+                if doc.get("done"):
+                    break
+            time.sleep(0.02)
+    assert doc is not None and doc["done"] is True
+    assert doc["success"] is True
+    assert doc["action"] == "async_take"
+    assert "heartbeat_time" in doc
+
+
+# -------------------------------------------- history via SnapshotManager
+
+
+def test_manager_records_step_history(tmp_path):
+    from torchsnapshot_tpu.telemetry import history
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    root = tmp_path / "ckpts"
+    mgr = SnapshotManager(str(root))
+    mgr.save(1, _state())
+    pending = mgr.save(2, _state(), async_=True)
+    pending.wait()
+    deadline = time.monotonic() + 5.0
+    entries = []
+    while time.monotonic() < deadline:
+        storage = url_to_storage_plugin(str(root))
+        try:
+            entries = history.read(storage)
+        finally:
+            storage.sync_close()
+        if len(entries) >= 2:
+            break
+        time.sleep(0.05)
+    assert [e["step"] for e in entries] == [1, 2]
+    assert entries[0]["action"] == "take"
+    assert entries[1]["action"] == "async_take"
+    assert entries[0]["duration_s"] > 0
+    assert entries[0]["rss_high_water_bytes"] > 0

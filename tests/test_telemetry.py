@@ -395,3 +395,109 @@ def test_timed_blocks_unaffected_by_clamp():
         assert stats["bytes"] == 150
     finally:
         phase_stats.reset()
+
+
+# ------------------------------------------------- scheduler gauge freshness
+
+_SCHEDULER_GAUGES = (
+    "tpusnap_scheduler_queue_depth",
+    "tpusnap_scheduler_staging_inflight",
+    "tpusnap_scheduler_io_inflight",
+    "tpusnap_memory_budget_in_use_bytes",
+    "tpusnap_worker_utilization",
+)
+
+
+def test_scheduler_gauges_zeroed_after_success(tmp_path):
+    with knobs.override_metrics(True):
+        state = {"m": StateDict({"w": jnp.ones((64, 256), jnp.float32)})}
+        snap = Snapshot.take(str(tmp_path / "snap"), state)
+        snap.restore({"m": StateDict({"w": jnp.zeros((64, 256), jnp.float32)})})
+        for name in _SCHEDULER_GAUGES:
+            for pipeline in ("write", "read"):
+                assert metrics.gauge(name).get(pipeline=pipeline) == 0, (
+                    f"{name} frozen nonzero for {pipeline} after op drained"
+                )
+
+
+def test_scheduler_gauges_zeroed_after_error(tmp_path, monkeypatch):
+    """The stale-gauge regression case: an op that dies mid-pipeline never
+    reaches another maybe_report, so without completion-time zeroing the
+    gauges freeze at their last in-flight values (budget_in_use > 0)."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    with knobs.override_metrics(True), knobs.override_faults(
+        "write:1+:terminal"
+    ):
+        with pytest.raises(Exception):
+            Snapshot.take(
+                str(tmp_path / "snap"),
+                {"m": StateDict({"w": np.ones((64, 256), np.float32)})},
+            )
+        for name in _SCHEDULER_GAUGES:
+            assert metrics.gauge(name).get(pipeline="write") == 0, (
+                f"{name} frozen nonzero after failed take"
+            )
+
+
+# ---------------------------------------- event kind <-> metrics consistency
+
+
+def test_every_emitted_event_kind_is_covered_by_metrics():
+    """Cross-check every Event ``name=`` in the package source against the
+    metrics bridge's handled families plus the direct-instrumentation
+    allowlist, so a new event kind (watchdog.stall, telemetry.regression,
+    ...) can't silently bypass metrics.  Also fails on STALE allowlist
+    entries — the sets must track the source exactly."""
+    import pathlib
+    import re
+
+    import torchsnapshot_tpu
+
+    pkg_dir = pathlib.Path(torchsnapshot_tpu.__file__).parent
+    event_re = re.compile(r'Event\(\s*name=(f?)"([^"]+)"', re.S)
+    # f-string name templates expand over the placeholder values the emit
+    # site can produce (snapshot.py's {action}.cleanup).
+    fstring_expansions = {"{action}": ("take", "async_take")}
+
+    emitted = set()
+    for path in pkg_dir.rglob("*.py"):
+        for is_f, name in event_re.findall(path.read_text(encoding="utf-8")):
+            if not is_f:
+                emitted.add(name)
+                continue
+            names = [name]
+            for placeholder, values in fstring_expansions.items():
+                expanded = []
+                for n in names:
+                    if placeholder in n:
+                        expanded.extend(
+                            n.replace(placeholder, v) for v in values
+                        )
+                    else:
+                        expanded.append(n)
+                names = expanded
+            unexpanded = [n for n in names if "{" in n]
+            assert not unexpanded, (
+                f"{path.name}: f-string event name {name!r} has placeholders "
+                f"this test can't expand — extend fstring_expansions"
+            )
+            emitted.update(names)
+    assert emitted, "source scan found no Event emissions (regex rot?)"
+
+    def covered(kind: str) -> bool:
+        return (
+            kind.endswith(metrics.BRIDGED_EVENT_SUFFIXES)
+            or kind in metrics.BRIDGED_EVENTS
+            or kind in metrics.DIRECT_METRIC_EVENTS
+        )
+
+    uncovered = sorted(k for k in emitted if not covered(k))
+    assert not uncovered, (
+        f"event kinds with no metrics coverage: {uncovered} — handle them "
+        "in the bridge (metrics.BRIDGED_EVENTS) or record a metric at the "
+        "emit site and add them to metrics.DIRECT_METRIC_EVENTS"
+    )
+    stale = sorted(
+        (metrics.BRIDGED_EVENTS | metrics.DIRECT_METRIC_EVENTS) - emitted
+    )
+    assert not stale, f"allowlisted event kinds no longer emitted: {stale}"

@@ -10,6 +10,8 @@
     python -m torchsnapshot_tpu cp <src-url> <dst-url> [--verify]
     python -m torchsnapshot_tpu stats <snapshot-url> [--json] [--metrics]
     python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
+    python -m torchsnapshot_tpu analyze <trace-dir> [--snapshot URL] [--json]
+    python -m torchsnapshot_tpu history <manager-root-url> [--json]
 
 Read-only except ``cp`` and ``gc --apply``; works against any storage
 backend URL.  (Beyond reference parity: the reference ships no CLI.)
@@ -465,6 +467,56 @@ def cmd_trace(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_analyze(args: argparse.Namespace) -> int:
+    """Cross-rank / cross-phase bottleneck analysis over a trace dir
+    (telemetry/analyze.py): per-phase exclusive wall, scheduler idle, the
+    limiting resource (d2h vs serialize vs storage vs budget/io-cap
+    throttling), and the straggler rank.  ``--snapshot`` enriches the
+    report with that snapshot's telemetry sidecars."""
+    import json
+
+    from .telemetry import analyze, trace
+
+    try:
+        docs = analyze.load_trace_dir(args.trace_dir)
+    except ValueError as e:
+        print(f"invalid trace input: {e}")
+        return 1
+    if not docs:
+        print(f"no *{trace.TRACE_FILE_SUFFIX} files under {args.trace_dir}")
+        return 2
+    sidecars = None
+    if args.snapshot:
+        sidecars = analyze.load_sidecars(args.snapshot)
+    analysis = analyze.analyze_traces(docs, sidecars)
+    if args.json:
+        print(json.dumps(analysis, indent=1))
+    else:
+        print(analyze.render(analysis))
+    return 0
+
+
+def cmd_history(args: argparse.Namespace) -> int:
+    """Render a SnapshotManager root's step-save history
+    (telemetry/history.jsonl): the per-step duration/GB-s trend with
+    regression flags."""
+    import json
+
+    from .storage_plugin import url_to_storage_plugin
+    from .telemetry import history
+
+    storage = url_to_storage_plugin(args.path)
+    try:
+        entries = history.read(storage)
+    finally:
+        storage.sync_close()
+    if args.json:
+        print(json.dumps(entries, indent=1))
+    else:
+        print(history.render(entries, limit=args.limit))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -552,6 +604,29 @@ def main(argv=None) -> int:
         "--out", default=None, help="write the merged trace-event JSON here"
     )
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "analyze",
+        help="cross-rank bottleneck analysis over per-rank trace files",
+    )
+    p.add_argument("trace_dir")
+    p.add_argument(
+        "--snapshot",
+        default=None,
+        help="snapshot URL whose telemetry sidecars enrich the report",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser(
+        "history", help="render a manager root's step-save history/trend"
+    )
+    p.add_argument("path")
+    p.add_argument("--json", action="store_true", help="raw history entries")
+    p.add_argument(
+        "--limit", type=int, default=50, help="entries shown (newest last)"
+    )
+    p.set_defaults(fn=cmd_history)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -39,6 +39,11 @@ FAULTS_ENV_VAR = _ENV_PREFIX + "FAULTS"
 IO_RETRIES_ENV_VAR = _ENV_PREFIX + "IO_RETRIES"
 RETRY_BASE_S_ENV_VAR = _ENV_PREFIX + "RETRY_BASE_S"
 BARRIER_TIMEOUT_S_ENV_VAR = _ENV_PREFIX + "BARRIER_TIMEOUT_S"
+STALL_TIMEOUT_S_ENV_VAR = _ENV_PREFIX + "STALL_TIMEOUT_S"
+STALL_ESCALATE_ENV_VAR = _ENV_PREFIX + "STALL_ESCALATE"
+HEARTBEAT_FILE_ENV_VAR = _ENV_PREFIX + "HEARTBEAT_FILE"
+REGRESSION_FACTOR_ENV_VAR = _ENV_PREFIX + "REGRESSION_FACTOR"
+REGRESSION_WINDOW_ENV_VAR = _ENV_PREFIX + "REGRESSION_WINDOW"
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -48,6 +53,12 @@ _DEFAULT_MAX_READ_MERGE_GAP_BYTES = 8 * 1024 * 1024
 _DEFAULT_CLOUD_PARALLEL_MIN_BYTES = 64 * 1024 * 1024
 _DEFAULT_IO_RETRIES = 2
 _DEFAULT_RETRY_BASE_S = 0.2
+# Save-duration regression detection (telemetry/history.py): a committed
+# save slower than factor x the trailing-window median emits
+# telemetry.regression.  Window matches the operator question "did step
+# 9000 regress versus the last fifty steps".
+_DEFAULT_REGRESSION_FACTOR = 2.0
+_DEFAULT_REGRESSION_WINDOW = 50
 # Matches PendingSnapshot's historical DEFAULT_BARRIER_TIMEOUT_S and the
 # KV stores' wait default.
 _DEFAULT_BARRIER_TIMEOUT_S = 1800.0
@@ -312,9 +323,86 @@ def sidecar_enabled() -> bool:
     return os.environ.get(SIDECAR_ENV_VAR, "1") not in ("0", "", "false", "False")
 
 
+def get_stall_timeout_s() -> float:
+    """Seconds of zero pipeline progress before the health monitor
+    (``telemetry/monitor.py``) declares a take/async_take/restore stalled:
+    it dumps a diagnostic bundle (pipeline states, budget, pending asyncio
+    tasks, all-thread stacks), emits ``watchdog.stall`` +
+    ``tpusnap_stalls_total``, and — with ``TPUSNAP_STALL_ESCALATE=1`` —
+    reports the stall through the coordination store so peers un-hang.
+    0 (the default) disables the watchdog entirely: no thread is started."""
+    val = os.environ.get(STALL_TIMEOUT_S_ENV_VAR)
+    return float(val) if val is not None else 0.0
+
+
+def stall_escalate_enabled() -> bool:
+    """Whether a detected stall is escalated via ``report_error`` on the
+    async-commit barrier's store, waking peers as StorePeerError instead of
+    letting them ride out ``TPUSNAP_BARRIER_TIMEOUT_S``.  Off by default:
+    the watchdog's default action is diagnose-only (a false positive must
+    not fail a multi-rank save)."""
+    return _get_bool_env(STALL_ESCALATE_ENV_VAR)
+
+
+def get_heartbeat_file() -> Optional[str]:
+    """Path the health monitor rewrites with a machine-readable progress
+    snapshot on every tick, for external supervisors (k8s liveness probes,
+    babysitter scripts) watching a training job's saves from outside the
+    process.  None (default) disables."""
+    val = os.environ.get(HEARTBEAT_FILE_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_regression_factor() -> float:
+    """A committed save whose duration exceeds this multiple of the
+    trailing-window median (``TPUSNAP_REGRESSION_WINDOW``) emits
+    ``telemetry.regression`` + ``tpusnap_save_regressions_total``.
+    0 disables detection (history is still appended)."""
+    val = os.environ.get(REGRESSION_FACTOR_ENV_VAR)
+    return float(val) if val is not None else _DEFAULT_REGRESSION_FACTOR
+
+
+def get_regression_window() -> int:
+    """Trailing-window size (entries of the same action) the regression
+    median is computed over."""
+    return max(
+        1, _get_int_env(REGRESSION_WINDOW_ENV_VAR, _DEFAULT_REGRESSION_WINDOW)
+    )
+
+
 @contextmanager
 def override_trace_dir(value: Optional[str]) -> Generator[None, None, None]:
     with _override_env(TRACE_DIR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_stall_timeout_s(value: float) -> Generator[None, None, None]:
+    with _override_env(STALL_TIMEOUT_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_stall_escalate(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(STALL_ESCALATE_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_heartbeat_file(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(HEARTBEAT_FILE_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_regression_factor(value: float) -> Generator[None, None, None]:
+    with _override_env(REGRESSION_FACTOR_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_regression_window(value: int) -> Generator[None, None, None]:
+    with _override_env(REGRESSION_WINDOW_ENV_VAR, str(value)):
         yield
 
 

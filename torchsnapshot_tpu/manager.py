@@ -24,7 +24,9 @@ from .pg_wrapper import PGWrapper
 from .snapshot import SNAPSHOT_METADATA_FNAME, PendingSnapshot, Snapshot
 from .stateful import AppState
 from .storage_plugin import url_to_storage_plugin
+from .telemetry import history as thistory
 from .telemetry import metrics as tmetrics
+from .telemetry import sidecar as tsidecar
 
 logger = logging.getLogger(__name__)
 
@@ -108,6 +110,16 @@ class SnapshotManager:
                 replicated=replicated,
                 incremental_from=base,
             )
+            # Step history is appended only once the snapshot COMMITS —
+            # the done-callback runs on the completion thread (storage
+            # ops only, no collectives) and a failed save records nothing.
+            pending.add_done_callback(
+                lambda p: (
+                    self._record_history(step, action="async_take")
+                    if p.exception is None
+                    else None
+                )
+            )
             # The in-flight snapshot must not count toward retention: if it
             # never commits, the previously committed ones are still the
             # only restore points — deleting them now could leave zero.
@@ -120,8 +132,44 @@ class SnapshotManager:
             replicated=replicated,
             incremental_from=base,
         )
+        self._record_history(step, action="take")
         self._maybe_prune(exclude_step=step, include_current=True)
         return snapshot
+
+    def _record_history(self, step: int, action: str) -> None:
+        """Append the committed save's sidecar summary to the root's
+        ``telemetry/history.jsonl`` (telemetry/history.py), running
+        trailing-median regression detection.  Rank 0 only (the history
+        file is shared), best-effort (a read-only root logs and moves
+        on), and a no-op when sidecars are disabled — they are the data
+        source."""
+        if self._pg.get_rank() != 0 or not tsidecar.enabled():
+            return
+        try:
+            snap_storage = url_to_storage_plugin(self.path_for_step(step))
+            try:
+                docs = tsidecar.read_all(snap_storage)
+            finally:
+                snap_storage.sync_close()
+            docs = [
+                d
+                for d in docs
+                if d.get("action") == action and d.get("rank", 1) == 0
+            ]
+            if not docs:
+                return
+            # read_all sorts newest-first; docs[0] is this save's sidecar.
+            entry = thistory.summarize_sidecar(docs[0], step=step)
+            root_storage = url_to_storage_plugin(self.root)
+            try:
+                thistory.append(root_storage, entry)
+            finally:
+                root_storage.sync_close()
+        except Exception:
+            logger.warning(
+                "failed to record step history for step_%d", step,
+                exc_info=True,
+            )
 
     # -------------------------------------------------------------- restore
 

@@ -48,6 +48,7 @@ from . import knobs, phase_stats, retry as retry_policy
 from .event import Event
 from .event_handlers import log_event
 from .telemetry import metrics as tmetrics
+from .telemetry import monitor as tmonitor
 from .telemetry import trace as ttrace
 from .io_types import (
     ReadIO,
@@ -212,6 +213,10 @@ class PendingIOWork:
             if self._executor is not None:
                 self._executor.shutdown()
             self._loop.close()
+            # All I/O drained (or torn down): zero the pipeline gauges so
+            # scrapes after the op see an idle scheduler, not the last
+            # in-flight values frozen forever.
+            tmetrics.record_scheduler_idle("write")
         elapsed = time.monotonic() - begin
         if elapsed > 0 and self.bytes_total:
             logger.debug(
@@ -294,10 +299,29 @@ async def execute_write_reqs(
     io_tasks: set = set()
     io_pipelines: dict = {}
     all_io_tasks: List[asyncio.Task] = []
-    io_semaphore = asyncio.Semaphore(knobs.get_max_per_rank_io_concurrency())
+    io_cap = knobs.get_max_per_rank_io_concurrency()
+    io_semaphore = asyncio.Semaphore(io_cap)
     staged_bytes = 0
     max_write_retries = knobs.get_io_retries()
-    reporter = _ProgressReporter(rank=rank, total=len(write_reqs), verb="write")
+    reporter = _ProgressReporter(
+        rank=rank, total=len(write_reqs), verb="write", budget=budget
+    )
+    reporter.debug_refs = {
+        # Best-effort snapshots for stall bundles; racing mutation from
+        # this loop only costs the bundle section (monitor wraps in
+        # try/except).
+        "ready_for_staging": lambda: [
+            p.write_req.path for p in list(ready_for_staging)
+        ],
+        "staging": lambda: [
+            p.write_req.path for p in list(staging_pipelines.values())
+        ],
+        "inflight_io": lambda: [
+            p.write_req.path
+            for t, p in list(io_pipelines.items())
+            if not t.done()
+        ],
+    }
 
     async def _io(pipeline: _WritePipeline) -> None:
         try:
@@ -312,7 +336,15 @@ async def execute_write_reqs(
             attempt = 0
             while True:
                 try:
+                    slot_wait_begin = time.monotonic()
                     async with io_semaphore:
+                        # Time spent queued for an I/O slot: when this
+                        # dominates a save, the limiting resource is the
+                        # io_concurrency cap, not the storage itself —
+                        # the distinction `analyze` draws.
+                        slot_wait_s = time.monotonic() - slot_wait_begin
+                        if slot_wait_s > 0.001:
+                            phase_stats.add("io_slot_wait", slot_wait_s)
                         await pipeline.write_buffer()
                     break
                 except asyncio.CancelledError:
@@ -402,6 +434,23 @@ async def execute_write_reqs(
         # guard, staging_tasks can be empty while over-budget requests wait
         # for in-flight writes to free budget — keep waiting on io_tasks.
         while staging_tasks or ready_for_staging:
+            # `budget_wait` phase: the memory budget is the BINDING
+            # constraint this turn — the queue head is inadmissible
+            # (dispatch_staging already admitted everything that fits)
+            # while nothing is staging AND io slots sit idle, i.e. a
+            # bigger budget would demonstrably add parallelism.  A head
+            # merely queued behind saturated storage/staging is NOT
+            # budget-bound (that wall belongs to the storage/stage
+            # phases, and counting it would make `analyze` blame the
+            # budget for every storage-bound save).  Deliberately NOT
+            # counted as watchdog progress (monitor excludes it) — a rank
+            # parked here behind hung storage is exactly a stall.
+            budget_bound = (
+                bool(ready_for_staging)
+                and not staging_tasks
+                and len(io_tasks) < io_cap
+            )
+            blocked_begin = time.monotonic() if budget_bound else None
             # The timeout lets the progress table fire while a rank is
             # budget-blocked on hung storage — the flagship stuck-rank case
             # would otherwise log nothing (no task ever completes).
@@ -410,6 +459,10 @@ async def execute_write_reqs(
                 timeout=reporter._interval_s or None,
                 return_when=asyncio.FIRST_COMPLETED,
             )
+            if blocked_begin is not None:
+                phase_stats.add(
+                    "budget_wait", time.monotonic() - blocked_begin
+                )
             for task in done:
                 if task in staging_pipelines:
                     staging_tasks.discard(task)
@@ -457,6 +510,10 @@ async def execute_write_reqs(
         # path it is never constructed, so shut our own executor down too.
         if own_executor:
             executor.shutdown(wait=False)
+        # The op is over: zero the pipeline gauges so they don't freeze at
+        # their last in-flight values (PendingIOWork handles the success
+        # path's zeroing after the drain).
+        tmetrics.record_scheduler_idle("write")
         raise
 
     staging_span.__exit__(None, None, None)
@@ -595,15 +652,32 @@ async def execute_read_reqs(
             key=lambda p: p.consuming_cost,
         )
     )
-    io_semaphore = asyncio.Semaphore(knobs.get_max_per_rank_io_concurrency())
+    io_cap = knobs.get_max_per_rank_io_concurrency()
+    io_semaphore = asyncio.Semaphore(io_cap)
     io_tasks: set = set()
     consume_tasks: set = set()
     # task -> pipeline, for re-crediting un-consumed pipelines on failure
     pipelines: dict = {}
-    reporter = _ProgressReporter(rank=rank, total=len(read_reqs), verb="read")
+    reporter = _ProgressReporter(
+        rank=rank, total=len(read_reqs), verb="read", budget=budget
+    )
+    reporter.debug_refs = {
+        "ready_for_io": lambda: [
+            p.read_req.path for p in list(ready_for_io)
+        ],
+        "inflight": lambda: [
+            p.read_req.path
+            for t, p in list(pipelines.items())
+            if not t.done()
+        ],
+    }
 
     async def _read(pipeline: _ReadPipeline) -> _ReadPipeline:
+        slot_wait_begin = time.monotonic()
         async with io_semaphore:
+            slot_wait_s = time.monotonic() - slot_wait_begin
+            if slot_wait_s > 0.001:
+                phase_stats.add("io_slot_wait", slot_wait_s)
             return await pipeline.read_buffer()
 
     def dispatch_io() -> None:
@@ -626,11 +700,21 @@ async def execute_read_reqs(
     try:
         dispatch_io()
         while io_tasks or consume_tasks:
+            # Mirror of the write path's budget_wait attribution: the
+            # consuming budget is binding only when the queue head is
+            # inadmissible WHILE read slots sit idle — a head queued
+            # behind saturated storage is storage-bound, not budget-bound.
+            budget_bound = bool(ready_for_io) and len(io_tasks) < io_cap
+            blocked_begin = time.monotonic() if budget_bound else None
             done, _ = await asyncio.wait(
                 io_tasks | consume_tasks,
                 timeout=reporter._interval_s or None,
                 return_when=asyncio.FIRST_COMPLETED,
             )
+            if blocked_begin is not None:
+                phase_stats.add(
+                    "budget_wait", time.monotonic() - blocked_begin
+                )
             for task in done:
                 if task in io_tasks:
                     io_tasks.discard(task)
@@ -678,6 +762,8 @@ async def execute_read_reqs(
         raise
     finally:
         executor.shutdown()
+        # Success or error, the read pipeline is over: zero its gauges.
+        tmetrics.record_scheduler_idle("read")
 
 
 def sync_execute_read_reqs(
@@ -716,7 +802,13 @@ class _ProgressReporter:
     exhausted, and whether RSS is drifting past the budget.  Interval via
     the ``TPUSNAP_PROGRESS_INTERVAL_S`` knob (0 disables)."""
 
-    def __init__(self, rank: int, total: int, verb: str) -> None:
+    def __init__(
+        self,
+        rank: int,
+        total: int,
+        verb: str,
+        budget: Optional[_BudgetTracker] = None,
+    ) -> None:
         self.rank = rank
         self.total = total
         self.verb = verb
@@ -724,6 +816,23 @@ class _ProgressReporter:
         self.io_done = 0
         self.bytes_staged = 0
         self.bytes_done = 0
+        # Last-reported pipeline-state counts, refreshed every loop turn:
+        # the health monitor (telemetry/monitor.py) reads these — plus the
+        # counters above and `budget` — for its progress snapshots and
+        # stall fingerprints.
+        self.pending = 0
+        self.staging = 0
+        self.inflight_io = 0
+        self.budget = budget
+        # Optional {label: () -> [paths]} closures over the scheduler's
+        # request containers, snapshotted (best-effort) into stall bundles.
+        self.debug_refs: Optional[dict] = None
+        try:
+            self.loop: Optional[asyncio.AbstractEventLoop] = (
+                asyncio.get_running_loop()
+            )
+        except RuntimeError:
+            self.loop = None
         self._interval_s = knobs.get_progress_interval_s()
         self._last = time.monotonic()
         self._begin = self._last
@@ -731,6 +840,7 @@ class _ProgressReporter:
             self._rss_base = psutil.Process().memory_info().rss
         except Exception:
             self._rss_base = None
+        tmonitor.attach_reporter(self)
 
     def maybe_report(
         self,
@@ -739,6 +849,9 @@ class _ProgressReporter:
         staging: int = 0,
         inflight_io: int = 0,
     ) -> None:
+        self.pending = pending
+        self.staging = staging
+        self.inflight_io = inflight_io
         # Gauges refresh on every scheduler loop turn, not just on the log
         # interval — short operations would otherwise never register.  One
         # env lookup when metrics are off.
@@ -748,6 +861,14 @@ class _ProgressReporter:
             staging=staging,
             inflight_io=inflight_io,
             budget_in_use=budget.in_use,
+        )
+        tmetrics.record_progress(
+            verb=self.verb,
+            requests_total=self.total,
+            requests_staged=self.staged,
+            requests_done=self.io_done,
+            bytes_staged=self.bytes_staged,
+            bytes_done=self.bytes_done,
         )
         if not self._interval_s:
             return

@@ -37,6 +37,7 @@ import numpy as np
 
 from . import io_preparer, knobs, phase_stats, retry as retry_policy, staging
 from .telemetry import metrics as tmetrics
+from .telemetry import monitor as tmonitor
 from .telemetry import sidecar as tsidecar
 from .telemetry import trace as ttrace
 from .batcher import batch_read_requests, batch_write_requests
@@ -111,6 +112,7 @@ class Snapshot:
         unique_id = _gen_unique_id(pg)
         tmetrics.maybe_install_bridge()
         trace_op = ttrace.begin_op("take", unique_id, pg.get_rank())
+        health = tmonitor.op_started("take", unique_id, pg.get_rank())
         phases_before = phase_stats.snapshot()
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
         log_event(Event(name="take.start", metadata=dict(event_metadata)))
@@ -165,7 +167,12 @@ class Snapshot:
                             duration_s=time.monotonic() - begin,
                             phases=phase_stats.delta(phases_before),
                             nbytes=pending_io_work.bytes_total,
-                            extra={"world_size": pg.get_world_size()},
+                            extra={
+                                "world_size": pg.get_world_size(),
+                                "rss_high_water_bytes": (
+                                    health.rss_high_water()
+                                ),
+                            },
                         ),
                     )
             finally:
@@ -177,12 +184,14 @@ class Snapshot:
             event_metadata["is_success"] = True
             log_event(Event(name="take.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=True)
+            tmonitor.op_finished(health, success=True)
             return snapshot
         except Exception:
             event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="take.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=False)
+            tmonitor.op_finished(health, success=False)
             raise
 
     @classmethod
@@ -218,6 +227,7 @@ class Snapshot:
         unique_id = _gen_unique_id(pg)
         tmetrics.maybe_install_bridge()
         trace_op = ttrace.begin_op("async_take", unique_id, pg.get_rank())
+        health = tmonitor.op_started("async_take", unique_id, pg.get_rank())
         phases_before = phase_stats.snapshot()
         event_metadata = {
             "unique_id": unique_id,
@@ -259,6 +269,7 @@ class Snapshot:
             event_metadata["is_success"] = False
             log_event(Event(name="async_take.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=False)
+            tmonitor.op_finished(health, success=False)
             raise
         return PendingSnapshot(
             path=path,
@@ -271,6 +282,7 @@ class Snapshot:
             stall_s=time.monotonic() - begin,
             trace_op=trace_op,
             phases_before=phases_before,
+            monitor=health,
         )
 
     @classmethod
@@ -473,6 +485,7 @@ class Snapshot:
         unique_id = _gen_unique_id(pg)
         tmetrics.maybe_install_bridge()
         trace_op = ttrace.begin_op("restore", unique_id, rank)
+        health = tmonitor.op_started("restore", unique_id, rank)
         phases_before = phase_stats.snapshot()
         event_metadata = {
             "unique_id": unique_id,
@@ -527,7 +540,12 @@ class Snapshot:
                             rank=rank,
                             duration_s=time.monotonic() - begin,
                             phases=phases_delta,
-                            extra={"world_size": pg.get_world_size()},
+                            extra={
+                                "world_size": pg.get_world_size(),
+                                "rss_high_water_bytes": (
+                                    health.rss_high_water()
+                                ),
+                            },
                         ),
                     )
             finally:
@@ -542,11 +560,13 @@ class Snapshot:
             event_metadata["is_success"] = True
             log_event(Event(name="restore.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=True)
+            tmonitor.op_finished(health, success=True)
         except Exception:
             event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="restore.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=False)
+            tmonitor.op_finished(health, success=False)
             raise
 
     def _load_stateful(
@@ -665,6 +685,12 @@ class Snapshot:
         unique_id = uuid.uuid4().hex
         tmetrics.maybe_install_bridge()
         trace_op = ttrace.begin_op("read_object", unique_id, self._pg.get_rank())
+        # Progress registry only (watchdog=False): a concurrent read_object
+        # must not adopt another in-flight op's reporters, but the stall
+        # watchdog is a take/async_take/restore concern.
+        health = tmonitor.op_started(
+            "read_object", unique_id, self._pg.get_rank(), watchdog=False
+        )
         event_metadata = {
             "unique_id": unique_id,
             "rank": self._pg.get_rank(),
@@ -695,6 +721,7 @@ class Snapshot:
                         Event(name="read_object.end", metadata=event_metadata)
                     )
                     ttrace.end_op(trace_op, success=True)
+                    tmonitor.op_finished(health, success=True)
                     return value
                 read_reqs, fut = io_preparer.prepare_read(
                     entry,
@@ -718,12 +745,14 @@ class Snapshot:
             event_metadata["is_success"] = True
             log_event(Event(name="read_object.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=True)
+            tmonitor.op_finished(health, success=True)
             return fut.obj
         except Exception:
             event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="read_object.end", metadata=event_metadata))
             ttrace.end_op(trace_op, success=False)
+            tmonitor.op_finished(health, success=False)
             raise
 
     def get_manifest(self) -> Dict[str, Entry]:
@@ -1090,6 +1119,7 @@ class PendingSnapshot:
         stall_s: float = 0.0,
         trace_op: Optional[object] = None,
         phases_before: Optional[Dict[str, Dict[str, float]]] = None,
+        monitor: Optional[tmonitor.OpMonitor] = None,
     ) -> None:
         self.path = path
         self.pg = pg
@@ -1104,9 +1134,12 @@ class PendingSnapshot:
         self._retired = False
         self._trace_op = trace_op
         self._phases_before = phases_before or {}
+        self._monitor = monitor
         self._begin = time.monotonic()
         self._bytes_total = 0
         self._done_event = threading.Event()
+        self._callbacks_lock = threading.Lock()
+        self._done_callbacks: List[Any] = []
         self._thread = threading.Thread(
             target=self._complete_snapshot,
             args=(pending_io_work,),
@@ -1126,6 +1159,13 @@ class PendingSnapshot:
                 world_size=self.pg.get_world_size(),
             )
             self._barrier = barrier
+            # Give the stall watchdog a peer-visible escalation channel:
+            # with TPUSNAP_STALL_ESCALATE=1, a stall detected on this rank
+            # wakes every peer blocked in the commit barrier as
+            # StorePeerError instead of them riding out
+            # TPUSNAP_BARRIER_TIMEOUT_S.
+            if self._monitor is not None:
+                self._monitor.escalate = barrier.report_error
         try:
             pending_io_work.sync_complete()
             self._bytes_total = getattr(pending_io_work, "bytes_total", 0)
@@ -1158,6 +1198,11 @@ class PendingSnapshot:
                             "world_size": self.pg.get_world_size(),
                             "staging_mode": self._finalizer.staging_mode,
                             "stall_s": round(self.stall_s, 4),
+                            "rss_high_water_bytes": (
+                                self._monitor.rss_high_water()
+                                if self._monitor is not None
+                                else None
+                            ),
                         },
                     ),
                 )
@@ -1169,6 +1214,7 @@ class PendingSnapshot:
                 )
             )
             ttrace.end_op(self._trace_op, success=True)
+            tmonitor.op_finished(self._monitor, success=True)
         except BaseException as e:  # noqa: BLE001
             self.exception = e
             if barrier is not None and not isinstance(e, StorePeerError):
@@ -1198,8 +1244,14 @@ class PendingSnapshot:
                 )
             )
             ttrace.end_op(self._trace_op, success=False)
+            tmonitor.op_finished(self._monitor, success=False)
         finally:
-            self._done_event.set()
+            with self._callbacks_lock:
+                self._done_event.set()
+                callbacks = list(self._done_callbacks)
+                self._done_callbacks = []
+            for fn in callbacks:
+                self._run_done_callback(fn)
 
     def _end_event_metadata(self, is_success: bool) -> Dict[str, Any]:
         """async_take.end carries the full staging telemetry — stall time,
@@ -1265,6 +1317,44 @@ class PendingSnapshot:
 
     def done(self) -> bool:
         return self._done_event.is_set()
+
+    def progress(self) -> Dict[str, Any]:
+        """Machine-readable live progress of the in-flight snapshot
+        (telemetry/monitor.py): requests/bytes staged and written, pipeline
+        state counts, memory-budget usage, a requests-based ETA, RSS high
+        water, and any watchdog stalls observed so far.  Callable from any
+        thread at any time — including after completion, when it reports
+        the terminal counters with ``done: true``."""
+        if self._monitor is not None:
+            return self._monitor.progress()
+        return {
+            "action": "async_take",
+            "op_id": self._unique_id,
+            "rank": self.pg.get_rank(),
+            "done": self.done(),
+            "success": None if not self.done() else self.exception is None,
+        }
+
+    def add_done_callback(self, fn: Any) -> None:
+        """Run ``fn(self)`` once the snapshot commits or fails — on the
+        background completion thread, or immediately on the calling thread
+        if already done.  Callback exceptions are logged and swallowed
+        (they must never mask the snapshot's own outcome).  Used by
+        SnapshotManager to append committed async saves to the step
+        history without blocking in ``wait()``."""
+        with self._callbacks_lock:
+            if not self._done_event.is_set():
+                self._done_callbacks.append(fn)
+                return
+        self._run_done_callback(fn)
+
+    def _run_done_callback(self, fn: Any) -> None:
+        try:
+            fn(self)
+        except Exception:
+            logger.warning(
+                "PendingSnapshot done-callback %r failed", fn, exc_info=True
+            )
 
 
 def _accepts_strict(stateful: Stateful) -> bool:

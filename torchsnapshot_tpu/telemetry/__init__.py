@@ -19,6 +19,21 @@ cost when off:
   values — the longitudinal record ``python -m torchsnapshot_tpu stats``
   renders.
 
+On top of those recording layers sits the *health* layer — the modules
+that turn raw data into operator answers:
+
+- :mod:`.monitor` — live progress API (``PendingSnapshot.progress()``,
+  ``tpusnap_progress_*`` gauges), the ``TPUSNAP_STALL_TIMEOUT_S`` stall
+  watchdog with its diagnostic bundles, and the
+  ``TPUSNAP_HEARTBEAT_FILE`` supervisor heartbeat.
+- :mod:`.analyze` — post-hoc bottleneck analysis over the per-rank trace
+  files + sidecars (``python -m torchsnapshot_tpu analyze``): per-phase
+  exclusive time, scheduler idle, the limiting resource, and cross-rank
+  straggler ranking.
+- :mod:`.history` — per-step save history (``telemetry/history.jsonl``
+  under a SnapshotManager root) with trailing-median regression
+  detection (``telemetry.regression`` events).
+
 No reference analogue: torchsnapshot's observability is a single
 entry-point event hook (event_handlers.py); production checkpointing
 systems (CheckFreq's iteration-overlap tuning, Check-N-Run's fleet
@@ -26,6 +41,6 @@ monitoring) showed per-phase timelines and longitudinal metrics are
 prerequisites for tuning, which is what this package persists.
 """
 
-from . import metrics, sidecar, trace
+from . import analyze, history, metrics, monitor, sidecar, trace
 
-__all__ = ["trace", "metrics", "sidecar"]
+__all__ = ["trace", "metrics", "sidecar", "monitor", "analyze", "history"]

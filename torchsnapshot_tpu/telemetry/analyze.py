@@ -1,0 +1,391 @@
+"""Bottleneck analysis over per-rank trace files and telemetry sidecars.
+
+Answers the post-hoc operator questions PR 2's raw data only stores:
+*was this take d2h-bound, serialize-bound, storage-bound, or throttled by
+the memory budget / io_concurrency cap — and which rank dragged the op*.
+
+Input: a ``TPUSNAP_TRACE_DIR`` of per-rank ``<kind>-<op8>-rank<r>``
+trace-event files (telemetry/trace.py), optionally enriched with the
+snapshot's ``telemetry/*.json`` sidecars.  Per (kind, op) the analyzer
+computes, per rank and across ranks:
+
+- **per-phase exclusive wall** — the union of each leaf phase's intervals
+  (``cat: "phase"`` spans: d2h, serialize, compress, checksum, fs_write,
+  h2d_*, …), so concurrent workers don't double-count;
+- **scheduler idle** — op wall not covered by ANY phase interval: time
+  the pipeline spent in barriers, planning, or waiting on nothing
+  attributable;
+- **the limiting resource** — ``memory_budget`` when the scheduler's
+  ``budget_wait`` attribution dominates, ``io_concurrency`` when
+  ``io_slot_wait`` does, else the dominant of the d2h / serialize /
+  storage_io / h2d phase groups;
+- **cross-rank skew** — p50/p99/max op duration, the straggler rank, and
+  the slowest rank per phase.
+
+Rendered by ``python -m torchsnapshot_tpu analyze <trace-dir>`` as a
+human table or ``--json``.  Schema-invalid trace input raises
+:class:`ValueError` (the CLI exits nonzero) — a corrupt trace must never
+produce a confident-looking report.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import trace as ttrace
+
+# Leaf-phase → resource-group classification.  Storage phases are matched
+# by suffix so every backend (fs/mem/gcs/s3) lands in storage_io without
+# this table needing to know plugin names.
+PHASE_GROUPS: Dict[str, frozenset] = {
+    "d2h": frozenset({"d2h", "device_stage"}),
+    "serialize": frozenset(
+        {
+            "serialize",
+            "compress",
+            "decompress",
+            "checksum",
+            "slab_pack",
+            "consume_copy",
+            "scatter_copy",
+        }
+    ),
+    "h2d": frozenset({"h2d_dispatch", "h2d_land"}),
+    "memory_budget": frozenset({"budget_wait"}),
+    "io_concurrency": frozenset({"io_slot_wait"}),
+}
+_STORAGE_SUFFIXES = ("_write", "_read")
+# A wait group only names the limiting resource when it covers at least
+# this share of the op (below that it's contention noise, and the real
+# answer is the dominant work group).
+_WAIT_DOMINANCE_SHARE = 0.2
+
+
+def classify_phase(phase: str) -> str:
+    for group, members in PHASE_GROUPS.items():
+        if phase in members:
+            return group
+    if phase.endswith(_STORAGE_SUFFIXES):
+        return "storage_io"
+    return "other"
+
+
+def _merge_intervals(
+    intervals: List[Tuple[float, float]],
+) -> List[Tuple[float, float]]:
+    merged: List[Tuple[float, float]] = []
+    for begin, end in sorted(intervals):
+        if merged and begin <= merged[-1][1]:
+            if end > merged[-1][1]:
+                merged[-1] = (merged[-1][0], end)
+        else:
+            merged.append((begin, end))
+    return merged
+
+
+def _union_s(intervals: List[Tuple[float, float]]) -> float:
+    return sum(e - b for b, e in _merge_intervals(intervals))
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+# ------------------------------------------------------------------ loading
+
+
+def load_trace_dir(trace_dir: str) -> List[Dict[str, Any]]:
+    """Load and schema-validate every trace file under ``trace_dir``.
+    Raises ValueError on the first invalid file; returns the parsed docs
+    (each with ``_file`` set to its basename)."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, f"*{ttrace.TRACE_FILE_SUFFIX}"))
+    )
+    docs: List[Dict[str, Any]] = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ValueError(f"{path}: unreadable trace file: {e}") from None
+        problems = ttrace.validate_trace(doc)
+        if problems:
+            raise ValueError(f"{path}: invalid trace: {problems[:3]}")
+        doc["_file"] = os.path.basename(path)
+        docs.append(doc)
+    return docs
+
+
+def load_sidecars(snapshot_url: str) -> List[Dict[str, Any]]:
+    """Read a snapshot's telemetry sidecars (best effort: a snapshot
+    without sidecars yields [])."""
+    from ..storage_plugin import url_to_storage_plugin
+    from . import sidecar
+
+    storage = url_to_storage_plugin(snapshot_url)
+    try:
+        return sidecar.read_all(storage)
+    finally:
+        storage.sync_close()
+
+
+# ----------------------------------------------------------------- analysis
+
+
+def _rank_analysis(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-phase walls, bytes, idle, and op duration for one rank's file."""
+    events = doc.get("traceEvents", [])
+    op_dur_s: Optional[float] = None
+    op_begin = op_end = None
+    phase_intervals: Dict[str, List[Tuple[float, float]]] = {}
+    phase_bytes: Dict[str, int] = {}
+    span_lo = span_hi = None
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        ts = float(ev.get("ts", 0.0))
+        dur = float(ev.get("dur", 0.0))
+        span_lo = ts if span_lo is None else min(span_lo, ts)
+        span_hi = ts + dur if span_hi is None else max(span_hi, ts + dur)
+        if ev.get("cat") == "op":
+            op_dur_s = dur / 1e6
+            op_begin, op_end = ts, ts + dur
+        elif ev.get("cat") == "phase":
+            name = ev["name"]
+            phase_intervals.setdefault(name, []).append((ts, ts + dur))
+            nbytes = (ev.get("args") or {}).get("bytes")
+            if isinstance(nbytes, (int, float)):
+                phase_bytes[name] = phase_bytes.get(name, 0) + int(nbytes)
+    if op_dur_s is None:
+        # Crashed op whose root span never closed: use the event envelope.
+        op_begin = span_lo or 0.0
+        op_end = span_hi or 0.0
+        op_dur_s = (op_end - op_begin) / 1e6
+    phases = {
+        name: {
+            "wall_s": round(_union_s(ivs) / 1e6, 6),
+            "bytes": phase_bytes.get(name, 0),
+            "n": len(ivs),
+        }
+        for name, ivs in phase_intervals.items()
+    }
+    busy_s = _union_s([iv for ivs in phase_intervals.values() for iv in ivs]) / 1e6
+    idle_s = max(0.0, op_dur_s - busy_s)
+    return {
+        "duration_s": round(op_dur_s, 6),
+        "phases": phases,
+        "busy_s": round(busy_s, 6),
+        "idle_s": round(idle_s, 6),
+        "idle_frac": round(idle_s / op_dur_s, 4) if op_dur_s > 0 else 0.0,
+    }
+
+
+def _classify_limiting(
+    group_walls: Dict[str, float], duration_s: float
+) -> str:
+    """Name the limiting resource from group walls: a dominant wait group
+    (budget / io-slot) wins outright — the pipeline was *throttled*, and
+    attacking the work phases won't help until the throttle moves."""
+    if duration_s <= 0 or not group_walls:
+        return "unknown"
+    for wait_group in ("memory_budget", "io_concurrency"):
+        wait = group_walls.get(wait_group, 0.0)
+        work_max = max(
+            (
+                v
+                for k, v in group_walls.items()
+                if k not in ("memory_budget", "io_concurrency")
+            ),
+            default=0.0,
+        )
+        if wait / duration_s >= _WAIT_DOMINANCE_SHARE and wait >= work_max:
+            return wait_group
+    work = {
+        k: v
+        for k, v in group_walls.items()
+        if k not in ("memory_budget", "io_concurrency", "other")
+    }
+    if not work:
+        return "unknown"
+    return max(work, key=work.get)
+
+
+def analyze_traces(
+    docs: List[Dict[str, Any]],
+    sidecars: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Group trace docs by (kind, op) and compute the cross-rank report."""
+    by_op: Dict[Tuple[str, str], Dict[int, Dict[str, Any]]] = {}
+    for doc in docs:
+        other = doc.get("otherData", {})
+        key = (other.get("kind", "?"), str(other.get("op", "?")))
+        rank = int(other.get("rank", 0))
+        by_op.setdefault(key, {})[rank] = _rank_analysis(doc)
+    sidecars = sidecars or []
+
+    ops: List[Dict[str, Any]] = []
+    for (kind, op), ranks in sorted(by_op.items()):
+        durations = {r: a["duration_s"] for r, a in ranks.items()}
+        sorted_durs = sorted(durations.values())
+        p50 = _percentile(sorted_durs, 0.5)
+        straggler = max(durations, key=durations.get)
+        # Aggregate phases: mean wall across ranks (the per-rank view stays
+        # available), slowest rank per phase.
+        phase_names = sorted(
+            {p for a in ranks.values() for p in a["phases"]}
+        )
+        phases: Dict[str, Any] = {}
+        for name in phase_names:
+            walls = {
+                r: a["phases"].get(name, {}).get("wall_s", 0.0)
+                for r, a in ranks.items()
+            }
+            phases[name] = {
+                "wall_s": round(sum(walls.values()) / len(walls), 6),
+                "max_wall_s": round(max(walls.values()), 6),
+                "slowest_rank": max(walls, key=walls.get),
+                "bytes": sum(
+                    a["phases"].get(name, {}).get("bytes", 0)
+                    for a in ranks.values()
+                ),
+                "group": classify_phase(name),
+                "by_rank": {str(r): round(w, 6) for r, w in walls.items()},
+            }
+        group_walls: Dict[str, float] = {}
+        for name, info in phases.items():
+            group_walls[info["group"]] = (
+                group_walls.get(info["group"], 0.0) + info["wall_s"]
+            )
+        mean_duration = sum(sorted_durs) / len(sorted_durs)
+        limiting = _classify_limiting(group_walls, mean_duration)
+        work_phases = {
+            n: i
+            for n, i in phases.items()
+            if i["group"] not in ("memory_budget", "io_concurrency")
+        }
+        dominant_phase = (
+            max(work_phases, key=lambda n: work_phases[n]["wall_s"])
+            if work_phases
+            else None
+        )
+        op_sidecars = {
+            str(d.get("rank", "?")): d
+            for d in sidecars
+            if str(d.get("op_id", ""))[:8] == op[:8]
+            and d.get("action") == kind
+        }
+        entry: Dict[str, Any] = {
+            "kind": kind,
+            "op": op,
+            "ranks": sorted(ranks),
+            "world": len(ranks),
+            "duration_s": {
+                "p50": round(p50, 6),
+                "p99": round(_percentile(sorted_durs, 0.99), 6),
+                "max": round(sorted_durs[-1], 6),
+                "by_rank": {
+                    str(r): round(d, 6) for r, d in durations.items()
+                },
+            },
+            "straggler_rank": straggler,
+            "skew": round(durations[straggler] / p50, 4) if p50 > 0 else 1.0,
+            "idle": {
+                "mean_s": round(
+                    sum(a["idle_s"] for a in ranks.values()) / len(ranks), 6
+                ),
+                "by_rank": {
+                    str(r): a["idle_s"] for r, a in ranks.items()
+                },
+            },
+            "phases": phases,
+            "groups": {
+                g: round(w, 6) for g, w in sorted(group_walls.items())
+            },
+            "limiting_resource": limiting,
+            "dominant_phase": dominant_phase,
+        }
+        if op_sidecars:
+            entry["sidecars"] = {
+                r: {
+                    k: d.get(k)
+                    for k in (
+                        "duration_s",
+                        "bytes",
+                        "throughput_gbps",
+                        "rss_high_water_bytes",
+                        "staging_mode",
+                        "knobs",
+                    )
+                    if k in d
+                }
+                for r, d in op_sidecars.items()
+            }
+        ops.append(entry)
+    return {"ops": ops}
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def render(analysis: Dict[str, Any]) -> str:
+    """Human-readable report (one block per analyzed operation)."""
+    lines: List[str] = []
+    for op in analysis.get("ops", []):
+        dur = op["duration_s"]
+        lines.append(
+            f"{op['kind']} {op['op'][:8]} — {op['world']} rank(s), "
+            f"p50 {dur['p50']:.2f}s  p99 {dur['p99']:.2f}s  "
+            f"max {dur['max']:.2f}s"
+        )
+        lines.append(
+            f"  straggler: rank {op['straggler_rank']} "
+            f"({dur['by_rank'][str(op['straggler_rank'])]:.2f}s, "
+            f"{op['skew']:.2f}x the p50)"
+        )
+        limiting = op["limiting_resource"]
+        dom = op["dominant_phase"]
+        dom_str = ""
+        if dom is not None:
+            info = op["phases"][dom]
+            share = info["wall_s"] / dur["p50"] if dur["p50"] > 0 else 0.0
+            dom_str = (
+                f"; dominant phase {dom} "
+                f"({info['wall_s']:.2f}s wall, {share:.0%} of p50)"
+            )
+        lines.append(f"  limiting resource: {limiting}{dom_str}")
+        lines.append(
+            f"  scheduler idle (no phase active): "
+            f"{op['idle']['mean_s']:.2f}s mean"
+        )
+        lines.append(
+            f"  {'phase':<14} {'wall(mean)':>10} {'wall(max)':>10} "
+            f"{'slowest':>8} {'bytes':>10}  group"
+        )
+        ranked = sorted(
+            op["phases"].items(), key=lambda kv: -kv[1]["wall_s"]
+        )
+        for name, info in ranked:
+            lines.append(
+                f"  {name:<14} {info['wall_s']:>9.2f}s "
+                f"{info['max_wall_s']:>9.2f}s "
+                f"{'rank ' + str(info['slowest_rank']):>8} "
+                f"{_fmt_bytes(info['bytes']):>10}  {info['group']}"
+            )
+        lines.append("")
+    if not lines:
+        return "no operations found in trace input"
+    return "\n".join(lines).rstrip()

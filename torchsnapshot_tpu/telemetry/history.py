@@ -1,0 +1,231 @@
+"""Step-history regression tracking under a SnapshotManager root.
+
+Every *committed* save appends one JSON line to
+``<root>/telemetry/history.jsonl`` — a compact summary of that step's
+telemetry sidecar (duration, bytes, GB/s, dominant phases, RSS high
+water).  The file is the longitudinal record the sidecars alone can't
+give (they live inside snapshots, which retention prunes): "did step
+9000 regress versus the last fifty steps" stays answerable after the
+snapshots that produced the baseline are gone.
+
+Regression detection runs at append time: a save whose duration exceeds
+``TPUSNAP_REGRESSION_FACTOR`` (default 2.0, 0 disables) times the median
+of the trailing ``TPUSNAP_REGRESSION_WINDOW`` same-action entries emits a
+``telemetry.regression`` event (→ ``tpusnap_save_regressions_total`` via
+the metrics bridge) and flags the history line, so an operator alerting
+on the event stream hears about a slow step the moment it commits.
+
+Appends are rank-0-only, best-effort (a read-only root degrades to a log
+line, never a failed save), serialized in-process, bounded (the oldest
+entries roll off past :data:`MAX_HISTORY_ENTRIES`), and ride the root's
+storage plugin — fs, memory, s3, gs all work.  ``python -m
+torchsnapshot_tpu history <root>`` renders the trend.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import knobs
+from ..event import Event
+from ..event_handlers import log_event
+
+logger = logging.getLogger(__name__)
+
+HISTORY_PATH = "telemetry/history.jsonl"
+# Below this many prior same-action entries the median is noise, not a
+# baseline — no regression verdict is rendered.
+MIN_BASELINE_ENTRIES = 5
+# The file is rewritten whole on each append (storage plugins have no
+# append primitive), so it must stay bounded: the oldest entries roll off
+# past this count.  1000 entries ≈ a few hundred KB — weeks of saves at
+# production cadence, far beyond any regression window — while keeping
+# the per-save read-modify-write O(1) instead of O(steps).
+MAX_HISTORY_ENTRIES = 1000
+
+# Appends are read-modify-write; concurrent committers in one process (an
+# async save's completion thread racing the next sync save) must not lose
+# each other's lines.  Cross-process writers are already excluded: only
+# rank 0 of one job appends to its root.
+_APPEND_LOCK = threading.Lock()
+
+
+def summarize_sidecar(
+    doc: Dict[str, Any], step: Optional[int] = None
+) -> Dict[str, Any]:
+    """One compact history entry from a telemetry sidecar document."""
+    phases = doc.get("phases") or {}
+    top = sorted(
+        phases.items(),
+        key=lambda kv: -kv[1].get("wall", kv[1].get("s", 0.0)),
+    )[:4]
+    entry: Dict[str, Any] = {
+        "timestamp": doc.get("timestamp", time.time()),
+        "step": step,
+        "action": doc.get("action", "?"),
+        "op_id": str(doc.get("op_id", ""))[:8],
+        "rank": doc.get("rank", 0),
+        "duration_s": doc.get("duration_s", 0.0),
+        "bytes": doc.get("bytes", 0),
+        "throughput_gbps": doc.get("throughput_gbps"),
+        "top_phases": {
+            name: round(v.get("wall", v.get("s", 0.0)), 4) for name, v in top
+        },
+    }
+    for key in ("rss_high_water_bytes", "staging_mode", "stall_s"):
+        if key in doc:
+            entry[key] = doc[key]
+    return entry
+
+
+def read(storage) -> List[Dict[str, Any]]:
+    """Parse the root's history file; [] when absent.  Unparseable lines
+    (a torn append on a non-atomic backend) are skipped, not fatal."""
+    from ..io_types import ReadIO
+
+    read_io = ReadIO(path=HISTORY_PATH)
+    try:
+        storage.sync_read(read_io)
+    except Exception:
+        return []
+    entries: List[Dict[str, Any]] = []
+    for line in bytes(read_io.buf).decode("utf-8", errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entries.append(json.loads(line))
+        except json.JSONDecodeError:
+            logger.debug("skipping unparseable history line: %r", line[:120])
+    return entries
+
+
+def detect_regression(
+    entries: List[Dict[str, Any]], new_entry: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """Trailing-window median check for the entry about to be appended.
+    Returns the regression record (median, factor, window) or None."""
+    factor = knobs.get_regression_factor()
+    if factor <= 0:
+        return None
+    window = knobs.get_regression_window()
+    same_action = [
+        e
+        for e in entries
+        if e.get("action") == new_entry.get("action")
+        and isinstance(e.get("duration_s"), (int, float))
+    ][-window:]
+    if len(same_action) < MIN_BASELINE_ENTRIES:
+        return None
+    median = statistics.median(e["duration_s"] for e in same_action)
+    duration = new_entry.get("duration_s") or 0.0
+    if median <= 0 or duration <= factor * median:
+        return None
+    return {
+        "median_s": round(median, 4),
+        "factor": factor,
+        "window": len(same_action),
+        "ratio": round(duration / median, 3),
+    }
+
+
+def append(storage, entry: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Append one entry to the root's history (read-modify-write through
+    the storage plugin, so object stores work too), running regression
+    detection against the trailing window first.  Returns the regression
+    record if one fired.  Best-effort: failures log and return None."""
+    from ..io_types import WriteIO
+
+    try:
+        with _APPEND_LOCK:
+            return _append_locked(storage, entry, WriteIO)
+    except Exception:
+        logger.warning(
+            "failed to append step history entry", exc_info=True
+        )
+        return None
+
+
+def _append_locked(
+    storage, entry: Dict[str, Any], WriteIO
+) -> Optional[Dict[str, Any]]:
+    entries = read(storage)
+    regression = detect_regression(entries, entry)
+    if regression is not None:
+        entry = dict(entry)
+        entry["regression"] = regression
+        log_event(
+            Event(
+                name="telemetry.regression",
+                metadata={
+                    "action": entry.get("action", "?"),
+                    "step": entry.get("step"),
+                    "rank": entry.get("rank", 0),
+                    "duration_s": entry.get("duration_s"),
+                    **regression,
+                },
+            )
+        )
+        logger.warning(
+            "save regression: step %s %s took %.2fs vs trailing "
+            "median %.2fs (%.1fx, threshold %.1fx over %d entries)",
+            entry.get("step"),
+            entry.get("action"),
+            entry.get("duration_s") or 0.0,
+            regression["median_s"],
+            regression["ratio"],
+            regression["factor"],
+            regression["window"],
+        )
+    kept = entries[-(MAX_HISTORY_ENTRIES - 1):] + [entry]
+    payload = "".join(json.dumps(e, sort_keys=True) + "\n" for e in kept)
+    storage.sync_write(
+        WriteIO(path=HISTORY_PATH, buf=payload.encode("utf-8"))
+    )
+    return regression
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def render(entries: List[Dict[str, Any]], limit: int = 50) -> str:
+    """Human trend table: newest last, regressions flagged, with a crude
+    duration bar so drift is visible without plotting."""
+    if not entries:
+        return (
+            "no step history (telemetry/history.jsonl absent — saves "
+            "predate history tracking, sidecars are disabled, or this is "
+            "not a SnapshotManager root)"
+        )
+    shown = entries[-limit:]
+    max_dur = max(
+        (e.get("duration_s") or 0.0 for e in shown), default=0.0
+    )
+    lines = [
+        f"{'step':>8} {'action':>10} {'duration':>9} {'size':>9} "
+        f"{'GB/s':>6}  trend"
+    ]
+    for e in shown:
+        dur = e.get("duration_s") or 0.0
+        bar = "#" * int(round(20 * dur / max_dur)) if max_dur > 0 else ""
+        gbps = e.get("throughput_gbps")
+        flag = ""
+        if "regression" in e:
+            reg = e["regression"]
+            flag = f"  << REGRESSION {reg.get('ratio', '?')}x median"
+        lines.append(
+            f"{str(e.get('step', '-')):>8} {e.get('action', '?'):>10} "
+            f"{dur:>8.2f}s {(e.get('bytes') or 0) / 1e9:>8.2f}G "
+            f"{gbps if gbps is not None else '-':>6}  {bar}{flag}"
+        )
+    n_reg = sum(1 for e in entries if "regression" in e)
+    lines.append(
+        f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'} total, "
+        f"{n_reg} regression(s)"
+    )
+    return "\n".join(lines)

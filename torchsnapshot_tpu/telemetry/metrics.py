@@ -227,6 +227,42 @@ def record_entries(action: str, n: int) -> None:
     ).inc(n, action=action)
 
 
+def record_progress(
+    verb: str,
+    requests_total: int,
+    requests_staged: int,
+    requests_done: int,
+    bytes_staged: int,
+    bytes_done: int,
+) -> None:
+    """Live progress gauges for the in-flight pipeline (the monitor's
+    machine-readable view, exported so a scrape mid-save answers "how far
+    along is rank N" without logs).  Refreshed on the scheduler loop,
+    same cadence as record_scheduler_state."""
+    if not enabled():
+        return
+    gauge(
+        "tpusnap_progress_requests_total",
+        "Requests this operation will stage+write in total",
+    ).set(requests_total, pipeline=verb)
+    gauge(
+        "tpusnap_progress_requests_staged",
+        "Requests staged so far (bytes in host memory)",
+    ).set(requests_staged, pipeline=verb)
+    gauge(
+        "tpusnap_progress_requests_written",
+        "Requests fully written/consumed so far",
+    ).set(requests_done, pipeline=verb)
+    gauge(
+        "tpusnap_progress_bytes_staged",
+        "Payload bytes staged so far",
+    ).set(bytes_staged, pipeline=verb)
+    gauge(
+        "tpusnap_progress_bytes_written",
+        "Payload bytes written/consumed so far",
+    ).set(bytes_done, pipeline=verb)
+
+
 def record_scheduler_state(
     verb: str,
     pending: int,
@@ -260,6 +296,20 @@ def record_scheduler_state(
         "tpusnap_worker_utilization",
         "In-flight storage I/O over the concurrency cap",
     ).set(inflight_io / io_cap if io_cap else 0.0, pipeline=verb)
+
+
+def record_scheduler_idle(verb: str) -> None:
+    """Zero the point-in-time pipeline gauges when an operation drains
+    (success or error).  record_scheduler_state only runs inside the
+    scheduler loop, so without this the pending/staging/inflight/budget/
+    utilization gauges freeze at their last nonzero values forever after
+    the op completes — a scrape an hour later would show a phantom
+    in-flight save."""
+    if not enabled():
+        return
+    record_scheduler_state(
+        verb=verb, pending=0, staging=0, inflight_io=0, budget_in_use=0
+    )
 
 
 def record_retry(backend: str) -> None:
@@ -336,6 +386,36 @@ def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
 
 # ------------------------------------------------------------- event bridge
 
+# The bridge's contract with the event stream, exported for the tier-1
+# consistency test (tests/test_telemetry.py): every event kind the package
+# emits must be covered by one of these three sets, so a new event can't
+# silently bypass metrics.
+#
+# Operation-lifecycle families: any "<action>.start" / "<action>.end" pair
+# feeds the open-ops gauge, the operations counter, and the duration/bytes
+# series generically.
+BRIDGED_EVENT_SUFFIXES = (".start", ".end")
+# Events the bridge maps to a dedicated metric by exact name.
+BRIDGED_EVENTS = frozenset(
+    {
+        "async_take.staging_downgrade",
+        "async_take.device_staged",
+        "watchdog.stall",
+        "telemetry.regression",
+    }
+)
+# Events whose metric is recorded directly at the emit site (a record_*
+# helper next to the log_event call) — bridging them too would double-count.
+DIRECT_METRIC_EVENTS = frozenset(
+    {
+        "scheduler.write_retry",  # record_pipeline_retry("write")
+        "restore_latest.fallback",  # record_restore_fallback
+        "gc.orphan_removed",  # record_gc("orphan_removed")
+        "take.cleanup",  # record_gc("take_cleanup")
+        "async_take.cleanup",  # record_gc("take_cleanup")
+    }
+)
+
 _BRIDGE_LOCK = threading.Lock()
 _BRIDGE_INSTALLED = False
 
@@ -396,6 +476,17 @@ def _bridge_handler(event) -> None:
                 "tpusnap_device_staged_bytes_total",
                 "Bytes made snapshot-stable by device-side staging",
             ).inc(float(copy_bytes), mode=md.get("mode", "?"))
+    elif name == "watchdog.stall":
+        counter(
+            "tpusnap_stalls_total",
+            "Stalls detected by the pipeline health watchdog",
+        ).inc(action=md.get("action", "?"))
+    elif name == "telemetry.regression":
+        counter(
+            "tpusnap_save_regressions_total",
+            "Committed saves slower than the trailing-window "
+            "regression threshold",
+        ).inc(action=md.get("action", "?"))
 
 
 def install_event_bridge() -> None:

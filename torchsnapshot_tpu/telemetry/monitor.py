@@ -1,0 +1,491 @@
+"""Pipeline health monitor: live progress, stall watchdog, heartbeat.
+
+PR 2 made operations *recordable* (traces/metrics/sidecars) and PR 3 made
+failures *survivable*; this module makes a running operation *diagnosable
+while it is stuck*.  Three cooperating pieces, all fed by counters the
+scheduler already maintains (no new hot-path work):
+
+- **Live progress** — every take/async_take/restore/read_object registers
+  an :class:`OpMonitor`; the scheduler's per-pipeline reporters attach to
+  the innermost active one.  :meth:`OpMonitor.progress` aggregates them
+  into a machine-readable snapshot (requests/bytes staged + written,
+  pipeline-state counts, budget, ETA, RSS high water), surfaced as
+  ``PendingSnapshot.progress()`` and the ``tpusnap_progress_*`` gauges.
+- **Stall watchdog** — with ``TPUSNAP_STALL_TIMEOUT_S`` > 0, a per-op
+  daemon thread fingerprints the counters each tick; when nothing
+  advances for the timeout it dumps a diagnostic bundle (pipeline states,
+  budget tracker, pending asyncio task names, ``faulthandler`` stacks of
+  every thread) next to the trace dir, emits a ``watchdog.stall`` event
+  (→ ``tpusnap_stalls_total``), and — with ``TPUSNAP_STALL_ESCALATE=1`` —
+  reports the stall through the coordination store so peers blocked in
+  the commit barrier un-hang as ``StorePeerError`` instead of riding out
+  ``TPUSNAP_BARRIER_TIMEOUT_S``.  The watchdog re-arms when progress
+  resumes, so one op can record several distinct stalls.
+- **Heartbeat** — with ``TPUSNAP_HEARTBEAT_FILE`` set, the monitor thread
+  atomically rewrites that file with the progress snapshot every tick,
+  for external supervisors (liveness probes, babysitter scripts) that
+  must distinguish "slow" from "dead" without attaching to the process.
+
+With both knobs unset (the default) no thread is started and the whole
+module costs one small object per *operation* — nothing per payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import faulthandler
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import knobs, phase_stats, rss_profiler
+from ..event import Event
+from ..event_handlers import log_event
+
+logger = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+# Stack of active ops; scheduler reporters attach to the innermost (most
+# recent) — same degradation across thread hops as the span tracer.
+_ACTIVE: List["OpMonitor"] = []
+
+_MIN_TICK_S = 0.02
+_MAX_TICK_S = 60.0
+STALL_BUNDLE_PREFIX = "stall-"
+
+# phase_stats phases that accumulate occurrences while the pipeline is
+# going NOWHERE (the scheduler records one budget_wait interval per
+# blocked wait turn).  Counting them as progress would blind the watchdog
+# to the flagship budget-blocked-on-hung-storage stall.
+_NON_PROGRESS_PHASES = frozenset({"budget_wait"})
+
+
+class OpMonitor:
+    """Health-monitoring state for one operation.
+
+    The object itself is always created (progress must be answerable for
+    every op); the tick thread starts only when the stall watchdog or the
+    heartbeat file is configured."""
+
+    def __init__(
+        self, kind: str, op_id: str, rank: int, watchdog: bool = True
+    ) -> None:
+        self.kind = kind
+        self.op_id = op_id
+        self.rank = rank
+        self._begin = time.monotonic()
+        self._reporters_lock = threading.Lock()
+        # Scheduler _ProgressReporter objects (duck-typed: verb/total/
+        # staged/io_done/bytes_staged/bytes_done plus the pipeline-state
+        # attributes maybe_report refreshes).
+        self._reporters: List[Any] = []
+        self.watermark = rss_profiler.RSSWatermark()
+        # Assignable escalation channel (PendingSnapshot points it at its
+        # commit barrier's report_error once that barrier exists).
+        self.escalate: Optional[Callable[[str], None]] = None
+        self.stall_count = 0
+        self.stall_bundle_path: Optional[str] = None
+        self.done = False
+        self.success: Optional[bool] = None
+        self._stall_timeout_s = knobs.get_stall_timeout_s() if watchdog else 0.0
+        # Heartbeat is a save/restore supervisor concern: a read_object
+        # (watchdog=False) completing mid-save must not overwrite the
+        # in-flight save's heartbeat with its own terminal done:true.
+        self._heartbeat_path = knobs.get_heartbeat_file() if watchdog else None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self._stall_timeout_s > 0 or self._heartbeat_path:
+            self._thread = threading.Thread(
+                target=self._run,
+                name=f"tpusnap-monitor-{kind}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------- feeding
+
+    def attach(self, reporter: Any) -> None:
+        with self._reporters_lock:
+            self._reporters.append(reporter)
+
+    def _snapshot_reporters(self) -> List[Any]:
+        with self._reporters_lock:
+            return list(self._reporters)
+
+    def rss_high_water(self) -> int:
+        """Current high-water RSS (samples once, so an op that never
+        ticked still reports an honest watermark)."""
+        self.watermark.sample()
+        return self.watermark.high_water
+
+    # ------------------------------------------------------------ progress
+
+    def progress(self) -> Dict[str, Any]:
+        """Machine-readable progress snapshot for this operation."""
+        reporters = self._snapshot_reporters()
+        elapsed = time.monotonic() - self._begin
+        total = staged = done = bytes_staged = bytes_done = 0
+        pipelines: List[Dict[str, Any]] = []
+        for r in reporters:
+            total += r.total
+            staged += r.staged
+            done += r.io_done
+            bytes_staged += r.bytes_staged
+            bytes_done += r.bytes_done
+            budget = getattr(r, "budget", None)
+            pipelines.append(
+                {
+                    "verb": r.verb,
+                    "requests_total": r.total,
+                    "requests_staged": r.staged,
+                    "requests_done": r.io_done,
+                    "bytes_staged": r.bytes_staged,
+                    "bytes_done": r.bytes_done,
+                    "pending": getattr(r, "pending", 0),
+                    "staging": getattr(r, "staging", 0),
+                    "inflight_io": getattr(r, "inflight_io", 0),
+                    "budget_in_use_bytes": (
+                        budget.in_use if budget is not None else None
+                    ),
+                    "budget_total_bytes": (
+                        budget.total if budget is not None else None
+                    ),
+                }
+            )
+        eta_s = None
+        if not self.done and done and total > done and elapsed > 0:
+            # Requests-based ETA: total bytes aren't known up front (staging
+            # costs are declared, actual sizes land as payloads stage).
+            eta_s = round((total - done) * (elapsed / done), 3)
+        return {
+            "action": self.kind,
+            "op_id": self.op_id,
+            "rank": self.rank,
+            "elapsed_s": round(elapsed, 3),
+            "requests": {"total": total, "staged": staged, "written": done},
+            "bytes": {"staged": bytes_staged, "written": bytes_done},
+            "eta_s": eta_s,
+            "pipelines": pipelines,
+            "rss_high_water_bytes": self.watermark.high_water,
+            "stalls": self.stall_count,
+            "stall_bundle": self.stall_bundle_path,
+            "done": self.done,
+            "success": self.success,
+        }
+
+    # ------------------------------------------------------------ watchdog
+
+    def _fingerprint(self) -> tuple:
+        """Anything that changes while the pipeline makes progress.  The
+        scheduler counters catch staged/written payloads; the phase_stats
+        occurrence counts catch intra-payload progress (a multi-chunk d2h,
+        a crawling-but-alive storage write recording retries), so a
+        slow-but-advancing op never fingerprints as stalled."""
+        reporters = self._snapshot_reporters()
+        parts: List[Any] = [len(reporters)]
+        for r in reporters:
+            parts.extend(
+                (
+                    r.staged,
+                    r.io_done,
+                    r.bytes_staged,
+                    r.bytes_done,
+                    getattr(r, "pending", 0),
+                    getattr(r, "staging", 0),
+                    getattr(r, "inflight_io", 0),
+                )
+            )
+        # phase_stats occurrence counts catch intra-payload progress the
+        # request counters miss — but they are process-GLOBAL, so another
+        # in-flight op's activity would keep re-arming this op's watchdog
+        # and mask a genuine stall.  Only counted when this op is the sole
+        # one being monitored.
+        with _LOCK:
+            sole = len(_ACTIVE) == 1 and _ACTIVE[0] is self
+        if sole:
+            try:
+                stats = phase_stats.snapshot()
+                parts.append(
+                    sum(
+                        int(v.get("n", 0))
+                        for k, v in stats.items()
+                        if k not in _NON_PROGRESS_PHASES
+                    )
+                )
+            except Exception:
+                pass
+        return tuple(parts)
+
+    def _tick_interval_s(self) -> float:
+        candidates = []
+        if self._stall_timeout_s > 0:
+            candidates.append(self._stall_timeout_s / 4.0)
+        if self._heartbeat_path:
+            candidates.append(min(knobs.get_progress_interval_s() or 5.0, 5.0))
+        return max(_MIN_TICK_S, min(min(candidates), _MAX_TICK_S))
+
+    def _run(self) -> None:
+        tick = self._tick_interval_s()
+        last_fp = self._fingerprint()
+        last_change = time.monotonic()
+        fired = False
+        while not self._stop.wait(tick):
+            self.watermark.sample()
+            if self._heartbeat_path:
+                self._write_heartbeat()
+            if self._stall_timeout_s <= 0:
+                continue
+            fp = self._fingerprint()
+            now = time.monotonic()
+            if fp != last_fp:
+                last_fp = fp
+                last_change = now
+                fired = False  # progress resumed: re-arm
+                continue
+            idle_s = now - last_change
+            if idle_s >= self._stall_timeout_s and not fired:
+                fired = True  # once per quiet period
+                self._on_stall(idle_s)
+        if self._heartbeat_path:
+            self._write_heartbeat()  # terminal heartbeat carries done/success
+
+    def _on_stall(self, idle_s: float) -> None:
+        self.stall_count += 1
+        self.stall_bundle_path = (
+            self._dump_bundle(idle_s) or self.stall_bundle_path
+        )
+        escalated = False
+        if knobs.stall_escalate_enabled() and self.escalate is not None:
+            try:
+                self.escalate(
+                    f"rank {self.rank}: {self.kind} op {self.op_id[:8]} "
+                    f"stalled for {idle_s:.1f}s (watchdog escalation)"
+                )
+                escalated = True
+            except Exception:
+                logger.warning("stall escalation failed", exc_info=True)
+        log_event(
+            Event(
+                name="watchdog.stall",
+                metadata={
+                    "action": self.kind,
+                    "unique_id": self.op_id,
+                    "rank": self.rank,
+                    "idle_s": round(idle_s, 3),
+                    "bundle": self.stall_bundle_path,
+                    "escalated": escalated,
+                },
+            )
+        )
+        logger.error(
+            "[rank %d] %s op %s appears STALLED: no pipeline progress for "
+            "%.1fs (timeout %.1fs); diagnostic bundle: %s%s",
+            self.rank,
+            self.kind,
+            self.op_id[:8],
+            idle_s,
+            self._stall_timeout_s,
+            self.stall_bundle_path or "<bundle write failed>",
+            "; escalated to peers" if escalated else "",
+        )
+
+    # ----------------------------------------------------------- artifacts
+
+    def _bundle_dir(self) -> str:
+        trace_dir = knobs.get_trace_dir()
+        if trace_dir is not None:
+            return trace_dir
+        if self._heartbeat_path:
+            return os.path.dirname(os.path.abspath(self._heartbeat_path))
+        return tempfile.gettempdir()
+
+    def _dump_bundle(self, idle_s: float) -> Optional[str]:
+        bundle_dir = self._bundle_dir()
+        fname = (
+            f"{STALL_BUNDLE_PREFIX}{self.kind}-{self.op_id[:8]}"
+            f"-rank{self.rank}-{self.stall_count}.txt"
+        )
+        path = os.path.join(bundle_dir, fname)
+        try:
+            os.makedirs(bundle_dir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                f.write("=== tpusnap stall diagnostic bundle ===\n")
+                f.write(
+                    f"op: {self.kind} {self.op_id} rank {self.rank}\n"
+                    f"idle: {idle_s:.3f}s "
+                    f"(stall timeout {self._stall_timeout_s}s)\n"
+                    f"wall clock: "
+                    f"{time.strftime('%Y-%m-%dT%H:%M:%S%z')}\n\n"
+                )
+                f.write("--- progress ---\n")
+                json.dump(self.progress(), f, indent=1)
+                f.write("\n\n--- pipeline states ---\n")
+                for line in self._pipeline_state_lines():
+                    f.write(line + "\n")
+                f.write("\n--- pending asyncio tasks ---\n")
+                for line in self._asyncio_task_lines():
+                    f.write(line + "\n")
+                f.write("\n--- thread stacks (faulthandler) ---\n")
+                f.flush()
+                faulthandler.dump_traceback(file=f)
+            return path
+        except OSError:
+            logger.warning(
+                "failed to write stall bundle %s", path, exc_info=True
+            )
+            return None
+
+    def _pipeline_state_lines(self) -> List[str]:
+        lines: List[str] = []
+        for r in self._snapshot_reporters():
+            lines.append(
+                f"[{r.verb}] total={r.total} staged={r.staged} "
+                f"done={r.io_done} pending={getattr(r, 'pending', 0)} "
+                f"staging={getattr(r, 'staging', 0)} "
+                f"inflight_io={getattr(r, 'inflight_io', 0)} "
+                f"bytes_staged={r.bytes_staged} bytes_done={r.bytes_done}"
+            )
+            budget = getattr(r, "budget", None)
+            if budget is not None:
+                lines.append(
+                    f"  budget: in_use={budget.in_use} "
+                    f"remaining={budget.remaining} total={budget.total} "
+                    f"staging_inflight={budget.inflight}"
+                )
+            # Per-request pipeline states (which paths are parked where) —
+            # snapshotted best-effort: the event loop mutates these
+            # containers concurrently and a racing resize only costs us
+            # this bundle section, never the pipeline.
+            for label, getter in (getattr(r, "debug_refs", None) or {}).items():
+                try:
+                    paths = list(getter())
+                except Exception:
+                    continue
+                shown = ", ".join(str(p) for p in paths[:8])
+                suffix = (
+                    f" (+{len(paths) - 8} more)" if len(paths) > 8 else ""
+                )
+                lines.append(f"  {label} ({len(paths)}): {shown}{suffix}")
+        if not lines:
+            lines.append(
+                "(no scheduler pipeline attached yet — the op is in "
+                "planning, device staging, a collective barrier, or the "
+                "metadata commit; see thread stacks below)"
+            )
+        return lines
+
+    def _asyncio_task_lines(self) -> List[str]:
+        lines: List[str] = []
+        loops = {
+            getattr(r, "loop", None) for r in self._snapshot_reporters()
+        } - {None}
+        for loop in loops:
+            for attempt in range(2):
+                try:
+                    tasks = list(asyncio.all_tasks(loop))
+                    break
+                except RuntimeError:
+                    # all_tasks iterates a WeakSet the loop thread mutates;
+                    # one retry, then give up on this loop's section.
+                    tasks = None
+            if tasks is None:
+                lines.append("  <asyncio task set unreadable (loop busy)>")
+                continue
+            for task in tasks[:64]:
+                try:
+                    coro = task.get_coro()
+                    where = getattr(coro, "__qualname__", repr(coro))
+                    lines.append(
+                        f"  {task.get_name()}: {where} done={task.done()}"
+                    )
+                except Exception:
+                    continue
+        if not lines:
+            lines.append("(no scheduler event loop attached)")
+        return lines
+
+    def _write_heartbeat(self) -> None:
+        path = self._heartbeat_path
+        if not path:
+            return
+        try:
+            doc = self.progress()
+            doc["heartbeat_time"] = time.time()
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            logger.debug("failed to write heartbeat %s", path, exc_info=True)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def finish(self, success: bool) -> None:
+        self.done = True
+        self.success = success
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        # Release the scheduler containers the debug closures (and the
+        # closed event loop) pin: a caller holding the PendingSnapshot
+        # between checkpoints must not keep every _WritePipeline / staged
+        # request object alive through this monitor.  The plain counters
+        # stay, so progress() keeps reporting terminal numbers.
+        for reporter in self._snapshot_reporters():
+            try:
+                reporter.debug_refs = None
+                reporter.loop = None
+            except AttributeError:
+                pass
+
+
+# ------------------------------------------------------------- module API
+
+
+def op_started(
+    kind: str, op_id: str, rank: int, watchdog: bool = True
+) -> OpMonitor:
+    """Register (and return) the monitor for one operation.  ``watchdog``
+    False (read_object) keeps the progress registry correct without a
+    stall thread — the watchdog belongs to take/async_take/restore."""
+    mon = OpMonitor(kind, op_id, rank, watchdog=watchdog)
+    with _LOCK:
+        _ACTIVE.append(mon)
+    return mon
+
+
+def op_finished(mon: Optional[OpMonitor], success: bool = True) -> None:
+    """Stop monitoring; idempotent (error paths may double-finish).  The
+    monitor object stays readable — ``PendingSnapshot.progress()`` after
+    completion reports the terminal counters with ``done: true``."""
+    if mon is None:
+        return
+    with _LOCK:
+        try:
+            _ACTIVE.remove(mon)
+        except ValueError:
+            return  # already finished
+    mon.finish(success)
+
+
+def current() -> Optional[OpMonitor]:
+    # Unlocked read: append/remove run under _LOCK, and a racing reader
+    # merely attaches to (or misses) an op being torn down.  The
+    # try/except covers the list emptying between check and index — a
+    # monitor race must never abort the pipeline.
+    try:
+        return _ACTIVE[-1]
+    except IndexError:
+        return None
+
+
+def attach_reporter(reporter: Any) -> None:
+    """Attach a scheduler progress reporter to the innermost active op
+    (no-op when no op is being monitored)."""
+    mon = current()
+    if mon is not None:
+        mon.attach(reporter)
