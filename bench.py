@@ -662,6 +662,89 @@ def main() -> None:
             log("compression probe skipped: insufficient watchdog budget")
     _PARTIAL["banked"]["sync"]["compression_probe"] = compression_probe
 
+    # --- CAS dedup probe (--cas): content-addressed store economics ---
+    # A 3-step simulated fine-tune — frozen backbone + churning optimizer —
+    # saved under TPUSNAP_CAS=1: physical chunk bytes written per step and
+    # the logical/physical dedup ratio, the storage-cost story the CAS
+    # subsystem (cas.py) exists for.  Host-side state on purpose: dedup is
+    # a storage-layer property, and burning watchdog budget on D2H here
+    # would steal it from the async/restore sections.
+    cas_probe = None
+    if "--cas" in argv:
+        _PARTIAL["phase"] = "cas_probe"
+        from torchsnapshot_tpu.manager import SnapshotManager as _Manager
+
+        cas_root = os.path.join(workdir, "cas_root")
+        shutil.rmtree(cas_root, ignore_errors=True)
+        backbone_mb = int(os.environ.get("BENCH_CAS_BACKBONE_MB", "64"))
+        backbone = np.random.RandomState(7).bytes(backbone_mb << 20)
+        backbone = np.frombuffer(backbone, np.uint8).reshape(-1)
+        opt_nbytes = max(backbone.nbytes // 8, 1 << 20)
+        logical_per_step = backbone.nbytes + opt_nbytes
+        step_s = []
+        # Dedup granularity is the CHUNK: payloads under the slab threshold
+        # share slab chunks, and a slab mixing the frozen backbone with the
+        # churning optimizer can never dedup (one changed member renames
+        # the whole slab's digest).  Real frozen backbones are far above
+        # the 128 MB threshold; the probe's scaled-down one must be too,
+        # so drop the threshold instead of inflating the probe state.
+        with _knobs.override_cas(True), _knobs.override_slab_size_threshold_bytes(
+            4 << 20
+        ):
+            mgr = _Manager(cas_root)
+            for step in (1, 2, 3):
+                opt = np.random.RandomState(step).bytes(opt_nbytes)
+                opt = np.frombuffer(opt, np.uint8).reshape(-1)
+                _drain_writeback()
+                t0 = time.monotonic()
+                mgr.save(
+                    step,
+                    {
+                        "ft": StateDict(
+                            {"backbone": backbone, "optimizer": opt}
+                        )
+                    },
+                )
+                step_s.append(round(time.monotonic() - t0, 2))
+        physical_bytes = _dir_bytes(os.path.join(cas_root, "cas"))
+        logical_bytes = 3 * logical_per_step
+        # Restore the oldest step to prove dedup'd references resolve.
+        dst = {
+            "ft": StateDict(
+                {
+                    "backbone": np.zeros_like(backbone),
+                    "optimizer": np.zeros(opt_nbytes, np.uint8),
+                }
+            )
+        }
+        mgr.snapshot(1).restore(dst)
+        np.testing.assert_array_equal(
+            np.asarray(dst["ft"]["backbone"][:64]), backbone[:64]
+        )
+        shutil.rmtree(cas_root, ignore_errors=True)
+        cas_probe = {
+            "steps": 3,
+            "backbone_bytes": backbone.nbytes,
+            "optimizer_bytes": opt_nbytes,
+            "logical_bytes": logical_bytes,
+            "physical_bytes_written": physical_bytes,
+            "dedup_ratio": round(logical_bytes / physical_bytes, 3)
+            if physical_bytes
+            else None,
+            "step_save_s": step_s,
+            # The frozen backbone must be stored exactly once: physical ≈
+            # backbone + 3 optimizers (+ manifest/sidecar noise outside
+            # cas/, not counted here).
+            "backbone_stored_once": physical_bytes
+            < backbone.nbytes + 3 * opt_nbytes + (1 << 20),
+        }
+        log(
+            f"cas probe: {physical_bytes / 1e9:.3f} GB physical for "
+            f"{logical_bytes / 1e9:.3f} GB logical "
+            f"(dedup {cas_probe['dedup_ratio']}x, steps {step_s})"
+        )
+        _PARTIAL["banked"]["sync"]["cas_probe"] = cas_probe
+
     # --- async save: training-blocked time, best of N ---
     # Round-2 verdict: a single async run recorded 11.87 s total vs 0.23 s
     # best-of-3 sync — cold-start apples vs warm oranges.  Async gets the
@@ -804,6 +887,7 @@ def main() -> None:
             "faults_spec": faults_spec,
             "telemetry_sidecar": telemetry_sidecar,
             "compression_probe": compression_probe,
+            "cas_probe": cas_probe,
             "sync_save_s": round(save_s, 2),
             "sync_save_worst_s": round(max(save_attempts_s), 2),
             "save_attempts_s": save_attempts_s,

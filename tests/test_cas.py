@@ -1,0 +1,430 @@
+"""Content-addressed chunk store (cas.py): cross-snapshot dedup, digest
+references, refcounted GC, and the repack migration.
+
+The acceptance spine: a 3-step CAS-mode save of a model with a frozen
+subtree writes the frozen payload bytes exactly once (asserted by counting
+physical chunk files/bytes), restore of every step round-trips bit-exact on
+fs and the fake object stores, pruning reclaims only unshared chunks, and
+``repack`` converts an existing per-step root to CAS and back with
+``verify`` passing on both sides."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs
+from torchsnapshot_tpu import __main__ as cli
+from torchsnapshot_tpu import cas
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.manifest import CAS_MANIFEST_VERSION
+
+
+def _native_available():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    return get_native_lib_path() is not None
+
+
+# Content addressing is digest-driven: without the native xxh64 the writer
+# degrades to plain per-step writes (covered by
+# test_cas_degrades_without_digest), so everything else needs the lib.
+needs_native = pytest.mark.skipif(
+    not _native_available(), reason="CAS digests require the native library"
+)
+
+FROZEN = np.random.RandomState(0).rand(65536).astype(np.float32)
+
+
+def _state(v):
+    return {
+        "m": StateDict(
+            {
+                "frozen": FROZEN.copy(),
+                "opt": np.full(4096, float(v), np.float32),
+            }
+        )
+    }
+
+
+def _chunk_files(root):
+    return sorted(glob.glob(os.path.join(root, "cas", "*", "*", "*")))
+
+
+def _assert_roundtrip(mgr, step):
+    dst = _state(0)
+    mgr.snapshot(step).restore(dst)
+    np.testing.assert_array_equal(dst["m"]["frozen"], FROZEN)
+    np.testing.assert_array_equal(
+        dst["m"]["opt"], np.full(4096, float(step), np.float32)
+    )
+
+
+@needs_native
+def test_three_step_save_stores_frozen_bytes_once(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        for step in (1, 2, 3):
+            mgr.save(step, _state(step))
+    chunks = _chunk_files(root)
+    # frozen chunk + one optimizer chunk per step — the frozen payload is
+    # physically present exactly once.
+    assert len(chunks) == 4, chunks
+    total = sum(os.path.getsize(c) for c in chunks)
+    opt_nbytes = np.full(4096, 1.0, np.float32).nbytes
+    assert total == FROZEN.nbytes + 3 * opt_nbytes
+    frozen_copies = [
+        c for c in chunks if os.path.getsize(c) == FROZEN.nbytes
+    ]
+    assert len(frozen_copies) == 1
+    # every step restores bit-exact, including the deduped base step
+    for step in (1, 2, 3):
+        _assert_roundtrip(mgr, step)
+    # manifests declare the CAS version and reference digests
+    md = mgr.snapshot(2).metadata
+    assert md.version == CAS_MANIFEST_VERSION
+    assert cas.is_cas_location(md.manifest["0/m/frozen"].location)
+    # steps 1-3 reference the SAME frozen chunk
+    locs = {
+        mgr.snapshot(s).metadata.manifest["0/m/frozen"].location
+        for s in (1, 2, 3)
+    }
+    assert len(locs) == 1
+
+
+@needs_native
+@pytest.mark.parametrize("backend", ["s3", "gcs"])
+def test_cas_roundtrip_on_fake_object_stores(backend, monkeypatch):
+    if backend == "s3":
+        from fake_s3 import FakeS3Server as Server
+
+        env, scheme = "TPUSNAP_S3_ENDPOINT", "s3"
+    else:
+        from fake_gcs import FakeGCSServer as Server
+
+        env, scheme = "TPUSNAP_GCS_ENDPOINT", "gs"
+    server = Server()
+    try:
+        monkeypatch.setenv(env, server.endpoint)
+        mgr = SnapshotManager(f"{scheme}://bkt/casrun")
+        with knobs.override_cas(True), knobs.override_batching_disabled(True):
+            for step in (1, 2, 3):
+                mgr.save(step, _state(step))
+        chunk_keys = [k for k in server.objects if "/cas/" in k]
+        frozen_copies = [
+            k for k in chunk_keys if server.objects[k] == FROZEN.tobytes()
+        ]
+        assert len(frozen_copies) == 1, "frozen payload uploaded once"
+        for step in (1, 2, 3):
+            _assert_roundtrip(mgr, step)
+        referenced, orphan = mgr.chunk_classification()
+        assert orphan == []
+        assert len(referenced) == len(chunk_keys)
+    finally:
+        server.stop()
+
+
+@needs_native
+def test_prune_reclaims_only_unshared_chunks(tmp_path):
+    """Pruning a base step deletes only chunks no surviving committed
+    manifest references — and never breaks restore of a later step that
+    deduped against it."""
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, max_to_keep=2)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+        chunks_before = set(_chunk_files(root))
+        mgr.save(3, _state(3))  # prunes step_1
+    assert mgr.all_steps() == [2, 3]
+    chunks_after = set(_chunk_files(root))
+    # step_1's private optimizer chunk is gone; the shared frozen chunk —
+    # still referenced by steps 2-3 — survives.
+    removed = chunks_before - chunks_after
+    assert len(removed) == 1
+    assert os.path.basename(next(iter(removed))) not in {
+        os.path.basename(c) for c in chunks_after
+    }
+    frozen_copies = [
+        c for c in chunks_after if os.path.getsize(c) == FROZEN.nbytes
+    ]
+    assert len(frozen_copies) == 1
+    for step in (2, 3):
+        _assert_roundtrip(mgr, step)
+    # a full gc finds nothing further to reclaim
+    mgr.gc(apply=True)
+    assert set(_chunk_files(root)) == chunks_after
+    referenced, orphan = mgr.chunk_classification()
+    assert orphan == [] and len(referenced) == len(chunks_after)
+
+
+@needs_native
+def test_gc_sweeps_crashed_take_orphan_chunks(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+        with knobs.override_retry_base_s(0.001), knobs.override_faults(
+            # Chunk writes land, the commit is torn every time: the take
+            # aborts AFTER writing this step's new chunks.
+            "write:1+:terminal@.snapshot_metadata"
+        ):
+            with pytest.raises(Exception):
+                mgr.save(2, _state(2))
+    referenced, orphan = mgr.chunk_classification()
+    assert orphan, "the crashed take's unreferenced chunk should be orphan"
+    # dry run reports without removing; apply returns exactly what it swept
+    dry_steps, dry_chunks = mgr.gc_detail(apply=False)
+    assert dry_chunks == orphan
+    _, swept = mgr.gc_detail(apply=True)
+    assert swept == orphan
+    referenced2, orphan2 = mgr.chunk_classification()
+    assert orphan2 == []
+    assert set(referenced2) == set(referenced)
+    _assert_roundtrip(mgr, 1)
+
+
+@needs_native
+def test_async_take_dedups_and_restores(tmp_path):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+        pending = mgr.save(2, _state(2), async_=True)
+        pending.wait()
+    chunks = _chunk_files(root)
+    frozen_copies = [
+        c for c in chunks if os.path.getsize(c) == FROZEN.nbytes
+    ]
+    assert len(frozen_copies) == 1
+    assert mgr.snapshot(2).metadata.version == CAS_MANIFEST_VERSION
+    _assert_roundtrip(mgr, 2)
+
+
+@needs_native
+def test_repack_roundtrip_with_verify(tmp_path, capsys):
+    """A pre-existing 0.2.0 (compressed) root converts to CAS and back,
+    with ``verify`` passing on both layouts and restores bit-exact."""
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_batching_disabled(True), knobs.override_compression(
+        "zlib:1"
+    ):
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    assert mgr.snapshot(1).metadata.version == "0.2.0"
+
+    assert cli.main(["repack", root]) == 0
+    for step in (1, 2):
+        snap = Snapshot(f"{root}/step_{step}")
+        assert snap.metadata.version == CAS_MANIFEST_VERSION
+        assert cli.main(["verify", f"{root}/step_{step}"]) == 0
+        _assert_roundtrip(mgr, step)
+    # the shared frozen payload was deduplicated during the repack:
+    # 3 chunks (one frozen + two optimizers), not 4
+    assert len(_chunk_files(root)) == 3, _chunk_files(root)
+
+    assert cli.main(["repack", root, "--export"]) == 0
+    assert _chunk_files(root) == []
+    for step in (1, 2):
+        snap = Snapshot(f"{root}/step_{step}")
+        assert snap.metadata.version == "0.2.0"
+        assert cli.main(["verify", f"{root}/step_{step}"]) == 0
+        _assert_roundtrip(mgr, step)
+
+
+@needs_native
+def test_verify_reports_missing_shared_chunk_once_naming_referrers(
+    tmp_path, capsys
+):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    # Batching ON so several small payloads share one slab chunk.
+    state = {
+        "m": StateDict(
+            {
+                "a": np.arange(4096, dtype=np.float32),
+                "b": np.arange(4096, dtype=np.float32) + 1,
+            }
+        )
+    }
+    with knobs.override_cas(True):
+        mgr.save(1, state)
+    md = mgr.snapshot(1).metadata
+    loc_a = md.manifest["0/m/a"].location
+    assert cas.is_cas_location(loc_a)
+    assert md.manifest["0/m/b"].location == loc_a, "expected a shared slab"
+    os.unlink(os.path.join(root, cas.relpath_for_location(loc_a)))
+    rc = cli.main(["verify", f"{root}/step_1"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.count(f"UNREADABLE {loc_a}") == 1, out
+    assert "0/m/a" in out and "0/m/b" in out
+
+
+@needs_native
+def test_incremental_from_delegates_to_cas_index(tmp_path):
+    from torchsnapshot_tpu.incremental import IncrementalStoragePlugin
+
+    root = str(tmp_path / "ckpts")
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        Snapshot.take(f"{root}/step_1", _state(1))
+        snap2 = Snapshot.take(
+            f"{root}/step_2", _state(2), incremental_from=f"{root}/step_1"
+        )
+    # dedup happened through the CAS (one physical frozen chunk), not the
+    # incremental wrapper
+    chunks = _chunk_files(root)
+    assert (
+        len([c for c in chunks if os.path.getsize(c) == FROZEN.nbytes]) == 1
+    )
+    dst = _state(0)
+    snap2.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["frozen"], FROZEN)
+
+
+@needs_native
+def test_incremental_from_cas_base_without_cas_warns_and_skips(
+    tmp_path, caplog
+):
+    import logging
+
+    root = str(tmp_path / "ckpts")
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        Snapshot.take(f"{root}/step_1", _state(1))
+    with knobs.override_batching_disabled(True), caplog.at_level(
+        logging.WARNING, logger="torchsnapshot_tpu.incremental"
+    ):
+        snap2 = Snapshot.take(
+            f"{root}/step_2", _state(2), incremental_from=f"{root}/step_1"
+        )
+    assert any("CAS-mode snapshot" in r.message for r in caplog.records)
+    dst = _state(0)
+    snap2.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["frozen"], FROZEN)
+
+
+@needs_native
+def test_dedup_metrics_and_event(tmp_path):
+    from torchsnapshot_tpu import event_handlers
+    from torchsnapshot_tpu.telemetry import metrics
+
+    events = []
+    event_handlers.register_event_handler(events.append)
+    try:
+        with knobs.override_metrics(True):
+            metrics.reset()
+            root = str(tmp_path / "ckpts")
+            mgr = SnapshotManager(root)
+            with knobs.override_cas(True), knobs.override_batching_disabled(
+                True
+            ):
+                mgr.save(1, _state(1))
+                mgr.save(2, _state(2))
+            hits = metrics.counter("tpusnap_cas_dedup_hits_total").get()
+            saved = metrics.counter(
+                "tpusnap_cas_dedup_bytes_saved_total"
+            ).get()
+            assert hits >= 1
+            assert saved >= FROZEN.nbytes
+    finally:
+        event_handlers.unregister_event_handler(events.append)
+        metrics.uninstall_event_bridge()
+        metrics.reset()
+    dedup_events = [e for e in events if e.name == "cas.dedup"]
+    assert dedup_events, [e.name for e in events]
+    assert dedup_events[-1].metadata["bytes_saved"] >= FROZEN.nbytes
+
+
+@needs_native
+def test_sidecar_records_logical_vs_physical(tmp_path):
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    from torchsnapshot_tpu.telemetry import sidecar
+
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    storage = url_to_storage_plugin(f"{root}/step_2")
+    try:
+        docs = [
+            d for d in sidecar.read_all(storage) if d.get("action") == "take"
+        ]
+    finally:
+        storage.sync_close()
+    assert docs and "cas" in docs[0]
+    stats = docs[0]["cas"]
+    assert stats["dedup_hits"] >= 1
+    assert stats["logical_bytes"] == (
+        stats["physical_bytes_written"] + stats["dedup_bytes_saved"]
+    )
+    assert "dedup=" in sidecar.summarize(docs[0])
+
+
+@needs_native
+def test_cp_refuses_cas_snapshot(tmp_path):
+    from torchsnapshot_tpu.replication import copy_snapshot
+
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+    with pytest.raises(RuntimeError, match="repack"):
+        copy_snapshot(f"{root}/step_1", str(tmp_path / "copy"))
+
+
+def test_cas_degrades_without_digest(tmp_path, monkeypatch):
+    """Without the native hash there are no digests: the writer degrades to
+    plain per-step writes and the snapshot stays a valid pre-CAS one."""
+    from torchsnapshot_tpu.native_io import NativeFileIO
+
+    monkeypatch.setattr(NativeFileIO, "maybe_create", classmethod(lambda cls: None))
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+    assert _chunk_files(root) == []
+    md = mgr.snapshot(1).metadata
+    assert md.version != CAS_MANIFEST_VERSION
+    _assert_roundtrip(mgr, 1)
+
+
+def test_cas_location_grammar():
+    loc = cas.location_for("xxh64", "ab12cd34ef56ab78")
+    assert cas.is_cas_location(loc)
+    assert cas.parse_cas_location(loc) == ("xxh64", "ab12cd34ef56ab78")
+    assert (
+        cas.relpath_for_location(loc) == "cas/xxh64/ab/ab12cd34ef56ab78"
+    )
+    assert not cas.is_cas_location("0/m/frozen")
+    assert not cas.is_cas_location(None)
+    with pytest.raises(ValueError):
+        cas.parse_cas_location("cas://xxh64")
+    with pytest.raises(ValueError):
+        cas.parse_cas_location("cas://xxh64/ab/extra")
+
+
+def test_cas_algo_knob_validates():
+    with knobs.override_cas_algo("xxh64"):
+        assert knobs.get_cas_algo() == "xxh64"
+    with knobs.override_cas_algo("sha999"):
+        with pytest.raises(ValueError, match="unsupported digest"):
+            knobs.get_cas_algo()
+
+
+@needs_native
+def test_history_and_stats_render_dedup(tmp_path, capsys):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root)
+    with knobs.override_cas(True), knobs.override_batching_disabled(True):
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    assert cli.main(["stats", f"{root}/step_2"]) == 0
+    out = capsys.readouterr().out
+    assert "dedup=" in out
+    assert cli.main(["history", root]) == 0
+    out = capsys.readouterr().out
+    assert "dedup=" in out

@@ -46,14 +46,22 @@ _MENU = [
 ]
 
 
-def _run_chaos(root: str, seed: int, n_steps: int) -> None:
+def _run_chaos(root: str, seed: int, n_steps: int, cas_mode: bool = False) -> None:
     rng = random.Random(seed)
     mgr = SnapshotManager(root)
     committed = []
-    with knobs.override_retry_base_s(0.001), knobs.override_sidecar(False):
+    with knobs.override_retry_base_s(0.001), knobs.override_sidecar(
+        False
+    ), knobs.override_cas(cas_mode):
         for step in range(1, n_steps + 1):
             spec, must_commit = _MENU[rng.randrange(len(_MENU))]
             use_async = rng.random() < 0.25
+            if cas_mode:
+                # CAS mode changes the write COUNT per plugin instance
+                # (payloads divert to the root store, dedup hits write
+                # nothing), so count-pinned schedules lose their calibrated
+                # outcome — the invariant below must hold either way.
+                must_commit = None if spec.startswith("write:1:") else must_commit
             with knobs.override_faults(spec or None):
                 try:
                     if use_async:
@@ -81,11 +89,28 @@ def _run_chaos(root: str, seed: int, n_steps: int) -> None:
             else:
                 # Any leftover is an orphan `gc` can see; nothing else.
                 assert mgr.orphan_steps() in ([], [step]), (seed, step, spec)
+            if cas_mode:
+                # CAS invariant: a faulted take never leaves a chunk GC
+                # can't classify — every chunk present is referenced by a
+                # committed manifest or a sweepable orphan.
+                referenced, orphan = mgr.chunk_classification()
+                import torchsnapshot_tpu.cas as cas_mod
+
+                storage = url_to_storage_plugin(root)
+                try:
+                    present = cas_mod.list_chunk_relpaths(storage)
+                finally:
+                    storage.sync_close()
+                assert sorted(referenced + orphan) == present, (seed, step)
 
         # GC clears every orphan; committed steps are exactly what's left.
         mgr.gc(apply=True)
         assert mgr.orphan_steps() == []
         assert mgr.all_steps() == committed
+        if cas_mode:
+            # After GC, no orphan chunks survive and every referenced one
+            # is readable (restore below proves the bytes).
+            assert mgr.orphan_chunks() == []
 
         # restore_latest lands on the newest good step with intact bytes.
         if committed:
@@ -102,6 +127,38 @@ def test_chaos_fast(tmp_path):
     """Tier-1 variant: one fixed seed, short history — deterministic and
     quick, but drawing from the same schedule menu as the soak."""
     _run_chaos(str(tmp_path / "ckpts"), seed=20260803, n_steps=10)
+
+
+def test_chaos_cas_fast(tmp_path):
+    """CAS-mode tier-1 variant: same seeded schedule menu with the
+    content-addressed store on.  Adds the chunk-classification invariant
+    (referenced/orphan/absent covers everything a faulted take leaves) and
+    proves pruning/GC of shared chunks never breaks restore of a step that
+    deduped against an earlier one."""
+    import numpy as np
+
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("CAS digests require the native library")
+    root = str(tmp_path / "ckpts")
+    _run_chaos(root, seed=20260804, n_steps=10, cas_mode=True)
+    # Retention on a CAS root: pruning base steps reclaims only unshared
+    # chunks and later steps that deduped against them still restore.
+    mgr = SnapshotManager(root, max_to_keep=2)
+    with knobs.override_retry_base_s(0.001), knobs.override_sidecar(
+        False
+    ), knobs.override_cas(True):
+        last = (mgr.latest_step() or 0) + 1
+        for step in range(last, last + 3):
+            mgr.save(step, _state(step))
+        assert mgr.orphan_chunks() == []
+        newest = mgr.all_steps()[-1]
+        dst = _state(0)
+        assert mgr.restore_latest(dst) == newest
+        np.testing.assert_array_equal(
+            dst["m"]["w"], np.full((512,), float(newest))
+        )
 
 
 @pytest.mark.slow

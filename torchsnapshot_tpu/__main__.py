@@ -5,6 +5,7 @@
     python -m torchsnapshot_tpu info <snapshot-url>
     python -m torchsnapshot_tpu steps <manager-root-url>
     python -m torchsnapshot_tpu gc <manager-root-url> [--apply]
+    python -m torchsnapshot_tpu repack <manager-root-url> [--export]
     python -m torchsnapshot_tpu verify <snapshot-url>
     python -m torchsnapshot_tpu diff <snapshot-url-a> <snapshot-url-b>
     python -m torchsnapshot_tpu cp <src-url> <dst-url> [--verify]
@@ -105,6 +106,45 @@ def _compression_line(md) -> str:
     )
 
 
+def _cas_line(md) -> str:
+    """Dedup summary for a CAS-mode manifest: unique chunks, physical vs
+    logical bytes.  Physical size per chunk is the best manifest-derivable
+    bound (max byte-range end / entry size over its referents)."""
+    from . import cas
+    from .manifest import iter_payload_entries
+
+    chunk_bytes: dict = {}
+    logical = 0
+    seen = set()
+    for _, entry in iter_payload_entries(md.manifest):
+        if not cas.is_cas_location(entry.location):
+            continue
+        byte_range = getattr(entry, "byte_range", None)
+        key = (entry.location, tuple(byte_range) if byte_range else None)
+        if key in seen:
+            continue
+        seen.add(key)
+        nbytes = getattr(entry, "compressed_nbytes", None) or _entry_size(entry)
+        logical += nbytes
+        end = byte_range[1] if byte_range else nbytes
+        chunk_bytes[entry.location] = max(
+            chunk_bytes.get(entry.location, 0), end
+        )
+    if not chunk_bytes:
+        return ""
+    physical = sum(chunk_bytes.values())
+    if not physical:
+        # Only object (pickle) chunks, whose sizes the manifest doesn't
+        # record — a byte breakdown here would be a meaningless 0/0.
+        return f"cas:         {len(chunk_bytes)} chunk(s) referenced"
+    ratio = logical / physical
+    return (
+        f"cas:         {len(chunk_bytes)} chunk(s); this step references "
+        f"{_human(physical)} physical for {_human(logical)} logical "
+        f"(dedup {ratio:.2f}x within-step; cross-step sharing not counted)"
+    )
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     from .manifest import ShardedArrayEntry
     from .snapshot import Snapshot
@@ -132,6 +172,9 @@ def cmd_info(args: argparse.Namespace) -> int:
     print(f"entries:     {len(md.manifest)}")
     print(f"array bytes: {_human(total)}")
     print(_compression_line(md))
+    cas_line = _cas_line(md)
+    if cas_line:
+        print(cas_line)
     return 0
 
 
@@ -224,18 +267,29 @@ def cmd_gc(args: argparse.Namespace) -> int:
         storage.sync_close()
     mgr = SnapshotManager(args.path, pg=PGWrapper())
     if args.apply:
-        removed = mgr.gc(apply=True)
+        removed, removed_chunks = mgr.gc_detail(apply=True)
         for step in removed:
             print(f"removed step_{step} (uncommitted)")
         print(f"{len(removed)} orphaned snapshot dir(s) removed")
+        for chunk in removed_chunks:
+            print(f"removed orphan chunk {chunk}")
+        if removed_chunks:
+            print(f"{len(removed_chunks)} orphan CAS chunk(s) removed")
     else:
-        orphans = mgr.orphan_steps()
+        orphans, orphan_chunks = mgr.gc_detail(apply=False)
         for step in orphans:
             print(f"orphan step_{step} (no {SNAPSHOT_METADATA_FNAME})")
         print(
             f"{len(orphans)} orphaned snapshot dir(s); re-run with --apply "
             "to remove (only when no save is in flight)"
         )
+        for chunk in orphan_chunks:
+            print(f"orphan chunk {chunk} (referenced by no committed step)")
+        if orphan_chunks:
+            print(
+                f"{len(orphan_chunks)} orphan CAS chunk(s); --apply sweeps "
+                "them too"
+            )
     return 0
 
 
@@ -258,6 +312,11 @@ def cmd_verify(args: argparse.Namespace) -> int:
 
     md = Snapshot(args.path).metadata
     storage = url_to_storage_plugin(args.path)
+    # Digest references resolve into the root's cas/ store — without this,
+    # every CAS payload would audit as a missing step-relative file.
+    from . import cas
+
+    storage = cas.maybe_wrap_cas_reads(storage, args.path, md)
     try:
         ok, corrupt, unreadable, problems = integrity.audit(storage, md)
     finally:
@@ -268,6 +327,9 @@ def cmd_verify(args: argparse.Namespace) -> int:
     # Digests cover the stored (compressed) bytes, so the audit above
     # verified frames as-is; surface what the codec layer did to them.
     print(_compression_line(md))
+    cas_line = _cas_line(md)
+    if cas_line:
+        print(cas_line)
     print(
         f"verified {ok} payloads, {corrupt} corrupt, "
         f"{unreadable} unreadable{skipped}"
@@ -375,6 +437,46 @@ def cmd_diff(args: argparse.Namespace) -> int:
         )
     print(summary)
     return 1 if added or removed or changed else 0
+
+
+def cmd_repack(args: argparse.Namespace) -> int:
+    """Rewrite a SnapshotManager root between the per-step payload layout
+    and the content-addressed one (cas.py).  Default direction stores every
+    payload once under ``<root>/cas/`` and rewrites manifests to digest
+    references (version 0.4.0); ``--export`` materializes chunks back into
+    each step (``chunks/<digest>``) so steps are self-contained and
+    portable again (``cp``-able, readable by pre-CAS tooling).  Run only
+    when no save is in flight."""
+    from .cas import repack_root
+    from .snapshot import SNAPSHOT_METADATA_FNAME
+    from .storage_plugin import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(args.path)
+    try:
+        if storage.sync_exists(SNAPSHOT_METADATA_FNAME):
+            print(
+                f"{args.path} is a committed snapshot, not a manager root; "
+                "repack operates on the root that owns the cas/ store"
+            )
+            return 2
+    finally:
+        storage.sync_close()
+    stats = repack_root(args.path, to_cas=not args.export)
+    if args.export:
+        print(
+            f"exported {stats['steps']} step(s) from CAS layout; "
+            f"{stats['chunks_swept']} unreferenced chunk(s) swept"
+        )
+    else:
+        print(
+            f"repacked {stats['steps']} step(s) into CAS layout: "
+            f"{stats['chunks_written']} chunk(s) written "
+            f"({_human(stats['bytes_written'])}), "
+            f"{stats['dedup_hits']} deduplicated "
+            f"({_human(stats['bytes_saved'])} saved), "
+            f"{stats['files_removed']} per-step payload file(s) removed"
+        )
+    return 0
 
 
 def cmd_cp(args: argparse.Namespace) -> int:
@@ -563,6 +665,19 @@ def main(argv=None) -> int:
     p.add_argument("path_b")
     p.add_argument("--limit", type=int, default=20, help="paths shown per bucket")
     p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser(
+        "repack",
+        help="rewrite a manager root to/from the content-addressed layout",
+    )
+    p.add_argument("path")
+    p.add_argument(
+        "--export",
+        action="store_true",
+        help="materialize CAS chunks back into each step (self-contained, "
+        "cp-able steps) instead of packing into cas/",
+    )
+    p.set_defaults(fn=cmd_repack)
 
     p = sub.add_parser(
         "cp", help="replicate a snapshot to another storage backend"

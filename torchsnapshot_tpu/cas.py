@@ -1,0 +1,922 @@
+"""Content-addressed chunk store: cross-snapshot dedup + digest references.
+
+Beyond reference parity.  ``incremental.py`` already skips *re-uploading*
+payloads whose bytes match the previous step, but every step still owns a
+full physical copy (hard link / server-side copy), so a manager root with N
+steps costs N× the storage of one and pruning reclaims nothing shared.  This
+module promotes dedup to the storage layout itself:
+
+- Payload chunks live ONCE under the manager root at
+  ``<root>/cas/<algo>/<digest[:2]>/<digest>`` — content-addressed, so two
+  steps (or two thousand fine-tunes sharing one root) that save identical
+  bytes share one physical chunk.
+- Manifest entries reference digests (``location = "cas://<algo>/<digest>"``)
+  instead of per-step file paths; slab members keep their ``byte_range``
+  into the shared chunk.  CAS manifests declare version 0.4.0
+  (``manifest.CAS_MANIFEST_VERSION``) so pre-CAS readers fail cleanly.
+- Writes go through :class:`CASWriterPlugin`: the staged bytes are hashed
+  (the same xxh64 the manifest checksum uses), a digest index — seeded from
+  the root's committed manifests, maintained like ``incremental.py``'s
+  ``checksums_by_location`` — turns duplicate payloads into pure manifest
+  references (ZERO bytes written), and new chunks are written
+  ``durable=True`` (tmp+fsync+rename on fs, durable-on-ack on object
+  stores) so a chunk is immutable once visible and safe to share across
+  concurrent takes.
+- Reads go through :class:`CASReaderPlugin`, which resolves ``cas://``
+  locations against the root store transparently — restore, read_object,
+  verify, and the ranged/tiled read machinery all work unchanged on
+  fs/gcs/s3/memory.
+- ``SnapshotManager`` grows refcounting on top (manager.py): pruning a step
+  deletes only chunks no surviving committed manifest references, and the
+  ``gc`` CLI sweeps orphan chunks left by crashed takes.
+
+Correctness notes:
+
+- Content addressing trusts the digest the way incremental dedup does: an
+  xxh64 collision between distinct payloads would alias them.  The window
+  is the same one incremental.py accepted; a future algo rides the layout's
+  ``<algo>`` namespace.
+- A dedup hit against the seeded index trusts committed manifests — the
+  chunk was made durable by a committed take and chunks are immutable.  A
+  hit against an UNindexed existing chunk (a crashed take's orphan, a
+  concurrent writer) is read-verified first: the chunk's bytes must hash to
+  its name, else it is atomically overwritten with the correct content.
+- Sweeping chunks races a concurrent *uncommitted* take that deduped
+  against them; ``SnapshotManager`` therefore restricts prune-time sweeps
+  to chunks referenced by the steps being pruned (an in-flight take's new
+  chunks are never candidates) and defers async sweeps until the pending
+  snapshot commits.  The full orphan sweep (``gc``) keeps the same caveat
+  as orphan-step GC: run it only when no save is in flight.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .io_types import ReadIO, StoragePlugin, WriteIO, contiguous
+
+logger = logging.getLogger(__name__)
+
+CAS_DIR = "cas"
+CAS_SCHEME = "cas://"
+# Step-local directory ``repack --export`` materializes chunks into.
+EXPORT_DIR = "chunks"
+
+
+# --------------------------------------------------------------- references
+
+
+def is_cas_location(location: Any) -> bool:
+    """Whether a manifest ``location`` is a digest reference into the
+    content-addressed store (vs a step-relative file path)."""
+    return isinstance(location, str) and location.startswith(CAS_SCHEME)
+
+
+def parse_cas_location(location: str) -> Tuple[str, str]:
+    """``"cas://<algo>/<hexdigest>"`` → ``(algo, hexdigest)``."""
+    body = location[len(CAS_SCHEME) :]
+    algo, sep, hexdigest = body.partition("/")
+    if not sep or not algo or not hexdigest or "/" in hexdigest:
+        raise ValueError(f"malformed CAS location: {location!r}")
+    return algo, hexdigest
+
+
+def location_for(algo: str, hexdigest: str) -> str:
+    return f"{CAS_SCHEME}{algo}/{hexdigest}"
+
+
+def chunk_relpath(algo: str, hexdigest: str) -> str:
+    """Root-relative storage path of a chunk.  The two-hex-char fan-out
+    keeps any one directory's entry count bounded (65k chunks spread over
+    256 dirs) — posix readdir and object-store listings both degrade on
+    million-entry flat prefixes."""
+    return f"{CAS_DIR}/{algo}/{hexdigest[:2]}/{hexdigest}"
+
+
+def relpath_for_location(location: str) -> str:
+    algo, hexdigest = parse_cas_location(location)
+    return chunk_relpath(algo, hexdigest)
+
+
+def _digest_key(algo: str, hexdigest: str) -> str:
+    return f"{algo}/{hexdigest}"
+
+
+def parent_root_url(snapshot_url: str) -> Optional[str]:
+    """URL of the directory containing a snapshot — where its ``cas/``
+    store lives — or None when the path has no parent (a bare root such as
+    ``step_1`` or ``bkt``: CAS needs a shared level above the step)."""
+    from .storage_plugin import parse_url
+
+    protocol, path = parse_url(snapshot_url)
+    path = path.rstrip("/")
+    if "/" not in path:
+        return None
+    return f"{protocol}://{path.rsplit('/', 1)[0]}"
+
+
+def manifest_uses_cas(manifest: Dict[str, Any]) -> bool:
+    from .manifest import iter_payload_entries
+
+    return any(
+        is_cas_location(entry.location)
+        for _, entry in iter_payload_entries(manifest)
+    )
+
+
+def referenced_chunk_relpaths(manifest: Dict[str, Any]) -> Set[str]:
+    """Root-relative chunk paths a manifest's entries reference."""
+    from .manifest import iter_payload_entries
+
+    out: Set[str] = set()
+    for _, entry in iter_payload_entries(manifest):
+        if is_cas_location(entry.location):
+            out.add(relpath_for_location(entry.location))
+    return out
+
+
+# ------------------------------------------------------------- digest index
+
+
+class DigestIndex:
+    """Digests known to be durable chunks in the root's CAS store.
+
+    Seeded from the root's committed manifests (the CAS analogue of
+    ``incremental.checksums_by_location``) and maintained as this take
+    writes new chunks.  Thread-safe: the scheduler's event loop and the
+    sync repack path both consult it."""
+
+    def __init__(self, keys: Optional[Set[str]] = None) -> None:
+        self._keys: Set[str] = set(keys or ())
+        self._lock = threading.Lock()
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._keys
+
+    def add(self, key: str) -> None:
+        with self._lock:
+            self._keys.add(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._keys)
+
+
+def seed_digest_index(
+    root_url: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+    storage: Optional[StoragePlugin] = None,
+) -> DigestIndex:
+    """Build a :class:`DigestIndex` from every committed step manifest under
+    a manager root.  Unreadable roots/manifests degrade to an empty index —
+    dedup then falls back to per-chunk existence probes, never to
+    incorrectness.  Pass ``storage`` to reuse an open root plugin.
+
+    Cost: one list + one small manifest read per committed step, paid on
+    each take's entry — bounded by retention (``max_to_keep``) in the
+    normal manager setup.  An unbounded many-step root on an object store
+    pays O(steps) GETs per save; maintaining the index incrementally
+    across a manager's lifetime is a noted follow-up (ROADMAP item 1)."""
+    from .manifest import SnapshotMetadata
+    from .storage_plugin import url_to_storage_plugin
+
+    keys: Set[str] = set()
+    own = storage is None
+    if own:
+        try:
+            storage = url_to_storage_plugin(root_url, storage_options)
+        except Exception:
+            return DigestIndex()
+    try:
+        try:
+            names = storage.sync_list_dir("")
+        except (NotImplementedError, FileNotFoundError):
+            return DigestIndex(keys)
+        for name in names:
+            if not name.startswith("step_"):
+                continue
+            read_io = ReadIO(path=f"{name}/.snapshot_metadata")
+            try:
+                storage.sync_read(read_io)
+                metadata = SnapshotMetadata.from_json(
+                    bytes(read_io.buf).decode("utf-8")
+                )
+            except Exception:
+                continue  # torn/absent/foreign — contributes nothing
+            from .manifest import iter_payload_entries
+
+            for _, entry in iter_payload_entries(metadata.manifest):
+                if is_cas_location(entry.location):
+                    keys.add(_digest_key(*parse_cas_location(entry.location)))
+    finally:
+        if own:
+            storage.sync_close()
+    return DigestIndex(keys)
+
+
+# ---------------------------------------------------------- storage wrappers
+
+
+async def _read_via_root(root: StoragePlugin, read_io: ReadIO) -> None:
+    """Resolve one ``cas://`` read against the root store, copying the
+    result back into the caller's ReadIO — the shared resolution used by
+    both wrapper plugins."""
+    sub = ReadIO(
+        path=relpath_for_location(read_io.path),
+        byte_range=read_io.byte_range,
+        into=read_io.into,
+        want_hash=read_io.want_hash,
+    )
+    await root.read(sub)
+    read_io.buf = sub.buf
+    read_io.hash64 = sub.hash64
+
+
+async def _read_chunk_digest(
+    root: StoragePlugin, relpath: str, executor=None
+) -> Optional[str]:
+    """Digest of the chunk's bytes at ``relpath``, or None when the chunk
+    is absent/unreadable (or the native hash is unavailable).
+
+    THE content-trust primitive: every path that considers trusting an
+    unindexed existing chunk — the write-time probe, failed-write cleanup,
+    repack's dedup — compares this against the chunk's name, because
+    existence alone can be a crashed take's torn debris on a backend
+    without atomic visibility."""
+    import asyncio
+
+    from . import integrity
+
+    try:
+        read_io = ReadIO(path=relpath)
+        await root.read(read_io)
+    except Exception:
+        return None
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(executor, integrity.digest, read_io.buf)
+
+
+def _sync_chunk_matches(
+    root: StoragePlugin, relpath: str, digest: str
+) -> bool:
+    """Whether the chunk at ``relpath`` exists AND its bytes hash to
+    ``digest`` — the sync (repack) twin of the write-time probe."""
+    from .utils.loops import run_coro
+
+    try:
+        if not root.sync_exists(relpath):
+            return False
+    except Exception:
+        return False
+    return run_coro(lambda: _read_chunk_digest(root, relpath)) == digest
+
+
+class CASReaderPlugin(StoragePlugin):
+    """Resolves ``cas://`` locations against the root store; everything else
+    passes through to the snapshot's own (step-dir-rooted) plugin.  Installed
+    on the read side whenever a loaded manifest references CAS chunks — the
+    knob does not gate reads, so any reader can restore a CAS snapshot."""
+
+    def __init__(self, inner: StoragePlugin, root: StoragePlugin) -> None:
+        self._inner = inner
+        self._root = root
+        self.supports_scatter = getattr(inner, "supports_scatter", False)
+
+    def _get_executor(self):
+        getter = getattr(self._inner, "_get_executor", None)
+        return getter() if getter is not None else None
+
+    async def read(self, read_io: ReadIO) -> None:
+        if not is_cas_location(read_io.path):
+            await self._inner.read(read_io)
+            return
+        await _read_via_root(self._root, read_io)
+
+    async def write(self, write_io: WriteIO) -> None:
+        await self._inner.write(write_io)
+
+    async def exists(self, path: str) -> bool:
+        if is_cas_location(path):
+            return await self._root.exists(relpath_for_location(path))
+        return await self._inner.exists(path)
+
+    async def list_dir(self, path: str) -> List[str]:
+        return await self._inner.list_dir(path)
+
+    async def delete(self, path: str) -> None:
+        if is_cas_location(path):
+            await self._root.delete(relpath_for_location(path))
+            return
+        await self._inner.delete(path)
+
+    async def delete_dir(self, path: str) -> None:
+        await self._inner.delete_dir(path)
+
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        return await self._inner.copy_from_sibling(src_root, path)
+
+    async def close(self) -> None:
+        try:
+            await self._inner.close()
+        finally:
+            await self._root.close()
+
+
+class CASWriterPlugin(StoragePlugin):
+    """Diverts payload writes into the root's content-addressed store.
+
+    For every payload write: hash the staged bytes, consult the digest
+    index, and either record a pure manifest reference (dedup hit — zero
+    bytes written) or write the chunk durably under its digest.  The
+    ``path → cas://`` relocation map is applied to the manifest entries
+    after the pipeline drains (:func:`apply_relocations`) — entry locations
+    must not change while the batcher/scheduler still key on them.
+
+    Non-payload writes (dot-prefixed commit marker / rank sidecars,
+    ``telemetry/``) pass through to the step plugin untouched, so commit
+    semantics — the metadata marker's existence IS the committed signal —
+    are exactly the pre-CAS ones.
+    """
+
+    # Slab ScatterBuffers are joined before hashing (one digest names the
+    # whole slab), so the scatter fast path never applies and the batcher
+    # must keep the join allocation in the staging cost it declares.
+    supports_scatter = False
+
+    def __init__(
+        self,
+        inner: StoragePlugin,
+        root: StoragePlugin,
+        index: DigestIndex,
+        algo: str,
+    ) -> None:
+        self._inner = inner
+        self._root = root
+        self._index = index
+        self._algo = algo
+        self._lock = threading.Lock()
+        # path written this take → "cas://<algo>/<hex>"
+        self.relocations: Dict[str, str] = {}
+        self.dedup_hits = 0
+        self.bytes_saved = 0  # logical bytes deduplicated (not written)
+        self.chunks_written = 0
+        self.bytes_written = 0  # physical chunk bytes written
+        self._closed = False
+
+    def _get_executor(self):
+        getter = getattr(self._inner, "_get_executor", None)
+        return getter() if getter is not None else None
+
+    @staticmethod
+    def _is_payload_path(path: str) -> bool:
+        # Dot-prefixed files are protocol metadata (.snapshot_metadata,
+        # .manifest_rank_N); telemetry/ is the sidecar namespace.  Payloads
+        # are <rank>/..., replicated/..., sharded/..., batched/... — but
+        # classify by exclusion so a future payload namespace can't silently
+        # bypass the CAS.
+        name = path.rsplit("/", 1)[-1]
+        return not (
+            path.startswith(".")
+            or name.startswith(".")
+            or path.startswith("telemetry/")
+        )
+
+    async def write(self, write_io: WriteIO) -> None:
+        if not self._is_payload_path(write_io.path):
+            await self._inner.write(write_io)
+            return
+
+        import asyncio
+
+        from . import integrity
+
+        buf = write_io.buf
+
+        def _hash() -> Optional[str]:
+            # contiguous() joins a slab ScatterBuffer once; the join is
+            # covered by the staging cost (supports_scatter=False above).
+            nonlocal buf
+            buf = contiguous(buf)
+            # digest(), not compute(): content addressing must work even
+            # when save-side checksum RECORDING is knobbed off.
+            return integrity.digest(buf)
+
+        executor = self._get_executor()
+        loop = asyncio.get_running_loop()
+        digest = await loop.run_in_executor(executor, _hash)
+        if digest is None:
+            # Native hash unavailable: no digest, no content addressing.
+            # Degrade to a plain step-local write — the entry keeps its
+            # original location and the snapshot stays valid (mixed
+            # manifests are legal; only cas:// entries bump the version).
+            logger.warning(
+                "CAS disabled for %s: native hash unavailable; writing "
+                "into the step directory",
+                write_io.path,
+            )
+            await self._inner.write(write_io)
+            return
+        _, _, hexdigest = digest.partition(":")
+        key = _digest_key(self._algo, hexdigest)
+        relpath = chunk_relpath(self._algo, hexdigest)
+        nbytes = memoryview(buf).nbytes
+
+        if key in self._index:
+            # Referenced by a committed manifest (or written earlier this
+            # take): the chunk is durable and immutable — pure dedup.
+            self._record_hit(write_io.path, hexdigest, nbytes)
+            return
+        if await self._probe_existing(relpath, digest, executor):
+            self._index.add(key)
+            self._record_hit(write_io.path, hexdigest, nbytes)
+            return
+        try:
+            # durable=True: tmp+fsync+rename on fs — a chunk is only ever
+            # visible complete, which is what makes sharing it across
+            # concurrent takes safe (PR 3's commit machinery).
+            await self._root.write(
+                WriteIO(path=relpath, buf=buf, durable=True)
+            )
+        except BaseException:
+            # A failed attempt may have left debris (a torn write through a
+            # fault wrapper / non-atomic backend).  Remove it best-effort —
+            # but only after CONTENT-checking: a concurrent writer of the
+            # same digest may have landed a valid chunk at this very path
+            # (possibly already referenced), and blind deletion would turn
+            # their commit into a dangling reference.  A chunk whose bytes
+            # hash to its name is kept regardless of who wrote it; our own
+            # retry then dedups against it.
+            try:
+                await self._delete_if_mismatched(relpath, digest, executor)
+            except Exception:
+                pass
+            raise
+        self._index.add(key)
+        with self._lock:
+            self.chunks_written += 1
+            self.bytes_written += nbytes
+            self.relocations[write_io.path] = location_for(
+                self._algo, hexdigest
+            )
+
+    async def _delete_if_mismatched(
+        self, relpath: str, digest: str, executor
+    ) -> None:
+        """Remove the chunk at ``relpath`` only when its content does NOT
+        hash to its name (torn debris); valid chunks — ours or a concurrent
+        writer's — are never deleted."""
+        actual = await _read_chunk_digest(self._root, relpath, executor)
+        if actual is not None and actual != digest:
+            await self._root.delete(relpath)
+
+    async def _probe_existing(
+        self, relpath: str, digest: str, executor
+    ) -> bool:
+        """Whether a chunk not in the index already holds the right bytes.
+
+        Unindexed-but-present chunks are orphans of crashed takes or a
+        concurrent writer's fresh chunks; unlike indexed ones they were
+        never blessed by a committed manifest, so their CONTENT is verified
+        before dedup trusts them (``_read_chunk_digest``).  A content
+        mismatch returns False — the caller's durable write atomically
+        heals the chunk."""
+        try:
+            if not await self._root.exists(relpath):
+                return False
+        except Exception:
+            return False
+        actual = await _read_chunk_digest(self._root, relpath, executor)
+        if actual is None:
+            return False
+        if actual != digest:
+            logger.warning(
+                "CAS chunk %s exists with mismatched content (%s != %s); "
+                "rewriting",
+                relpath,
+                actual,
+                digest,
+            )
+            return False
+        return True
+
+    def _record_hit(self, path: str, hexdigest: str, nbytes: int) -> None:
+        with self._lock:
+            self.dedup_hits += 1
+            self.bytes_saved += nbytes
+            self.relocations[path] = location_for(self._algo, hexdigest)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            physical = self.bytes_written
+            saved = self.bytes_saved
+            return {
+                "dedup_hits": self.dedup_hits,
+                "dedup_bytes_saved": saved,
+                "chunks_written": self.chunks_written,
+                "physical_bytes_written": physical,
+                "logical_bytes": physical + saved,
+            }
+
+    # ------------------------------------------------------------ plugin API
+
+    async def read(self, read_io: ReadIO) -> None:
+        if is_cas_location(read_io.path):
+            await _read_via_root(self._root, read_io)
+            return
+        await self._inner.read(read_io)
+
+    async def exists(self, path: str) -> bool:
+        return await self._inner.exists(path)
+
+    async def list_dir(self, path: str) -> List[str]:
+        return await self._inner.list_dir(path)
+
+    async def delete(self, path: str) -> None:
+        await self._inner.delete(path)
+
+    async def delete_dir(self, path: str) -> None:
+        await self._inner.delete_dir(path)
+
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        return await self._inner.copy_from_sibling(src_root, path)
+
+    async def close(self) -> None:
+        self._emit_summary()
+        try:
+            await self._inner.close()
+        finally:
+            await self._root.close()
+
+    def _emit_summary(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            hits, saved = self.dedup_hits, self.bytes_saved
+            written, wbytes = self.chunks_written, self.bytes_written
+        if not (hits or written):
+            return
+        from .event import Event
+        from .event_handlers import log_event
+        from .telemetry import metrics as tmetrics
+
+        tmetrics.record_cas_dedup(hits, saved)
+        log_event(
+            Event(
+                name="cas.dedup",
+                metadata={
+                    "dedup_hits": hits,
+                    "bytes_saved": saved,
+                    "chunks_written": written,
+                    "bytes_written": wbytes,
+                },
+            )
+        )
+        logger.info(
+            "CAS: %d payloads deduplicated (%.1f MB saved), %d new chunks "
+            "(%.1f MB written)",
+            hits,
+            saved / 1e6,
+            written,
+            wbytes / 1e6,
+        )
+
+
+# ----------------------------------------------------------------- wiring
+
+
+def maybe_wrap_cas_writes(
+    storage: StoragePlugin,
+    path: str,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> StoragePlugin:
+    """Wrap a take's storage for content-addressed writes when the
+    ``TPUSNAP_CAS`` knob is on and the snapshot has a parent directory to
+    host the shared store; otherwise return ``storage`` unchanged."""
+    from . import knobs
+    from .storage_plugin import url_to_storage_plugin
+
+    if not knobs.cas_enabled():
+        return storage
+    algo = knobs.get_cas_algo()
+    root_url = parent_root_url(path)
+    if root_url is None:
+        logger.warning(
+            "TPUSNAP_CAS ignored for %s: the snapshot path has no parent "
+            "directory to host the shared cas/ store",
+            path,
+        )
+        return storage
+    root = url_to_storage_plugin(root_url, storage_options)
+    # Seed through the writer's own root plugin: one plugin (one thread
+    # pool / session set) per take, not two.
+    index = seed_digest_index(root_url, storage_options, storage=root)
+    logger.debug(
+        "CAS writes enabled for %s (root %s, %d indexed digests)",
+        path,
+        root_url,
+        len(index),
+    )
+    return CASWriterPlugin(inner=storage, root=root, index=index, algo=algo)
+
+
+def maybe_wrap_cas_reads(
+    storage: StoragePlugin,
+    snapshot_path: str,
+    metadata,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> StoragePlugin:
+    """Wrap a snapshot's storage so ``cas://`` manifest locations resolve,
+    when (and only when) its manifest references the content-addressed
+    store.  Knob-independent: reading a CAS snapshot must always work."""
+    if not manifest_uses_cas(metadata.manifest):
+        return storage
+    from .storage_plugin import url_to_storage_plugin
+
+    root_url = parent_root_url(snapshot_path)
+    if root_url is None:
+        raise RuntimeError(
+            f"{snapshot_path} references content-addressed chunks but has "
+            "no parent directory to resolve the cas/ store from — a CAS "
+            "snapshot must live one level under the root that owns its "
+            "chunks (use 'tpusnap repack --export' before relocating one)"
+        )
+    root = url_to_storage_plugin(root_url, storage_options)
+    return CASReaderPlugin(inner=storage, root=root)
+
+
+def find_writer(storage: StoragePlugin) -> Optional[CASWriterPlugin]:
+    """The :class:`CASWriterPlugin` in a (possibly wrapped) storage stack,
+    or None.  Follows ``_inner`` links so an outer wrapper (incremental,
+    faults) can't hide it."""
+    seen = 0
+    while storage is not None and seen < 8:
+        if isinstance(storage, CASWriterPlugin):
+            return storage
+        storage = getattr(storage, "_inner", None)
+        seen += 1
+    return None
+
+
+def apply_relocations(storage: StoragePlugin, entries: Dict[str, Any]) -> None:
+    """Rewrite manifest entries whose payloads were diverted into the CAS
+    to reference their chunks.  Must run after the write pipeline drains
+    (every relocation recorded) and before the manifest is gathered /
+    committed.  No-op when the storage stack has no CAS writer."""
+    writer = find_writer(storage)
+    if writer is None or not writer.relocations:
+        return
+    from .manifest import iter_payload_entries
+
+    with writer._lock:
+        relocations = dict(writer.relocations)
+    rewritten = 0
+    for _, entry in iter_payload_entries(entries):
+        new_location = relocations.get(entry.location)
+        if new_location is not None:
+            entry.location = new_location
+            rewritten += 1
+    logger.debug("CAS: rewrote %d manifest entry locations", rewritten)
+
+
+def writer_stats(storage: StoragePlugin) -> Optional[Dict[str, int]]:
+    writer = find_writer(storage)
+    return writer.stats() if writer is not None else None
+
+
+# --------------------------------------------------------------- chunk sweep
+
+
+def list_chunk_relpaths(storage: StoragePlugin) -> List[str]:
+    """Every chunk present under a root plugin's ``cas/`` directory, as
+    root-relative paths (``cas/<algo>/<p2>/<digest>``)."""
+    out: List[str] = []
+    try:
+        algos = storage.sync_list_dir(CAS_DIR)
+    except (NotImplementedError, FileNotFoundError):
+        return out
+    for algo in algos:
+        try:
+            prefixes = storage.sync_list_dir(f"{CAS_DIR}/{algo}")
+        except FileNotFoundError:
+            continue
+        for prefix in prefixes:
+            try:
+                names = storage.sync_list_dir(f"{CAS_DIR}/{algo}/{prefix}")
+            except FileNotFoundError:
+                continue
+            for name in names:
+                out.append(f"{CAS_DIR}/{algo}/{prefix}/{name}")
+    return sorted(out)
+
+
+# -------------------------------------------------------------------- repack
+
+
+def repack_root(
+    root_url: str,
+    to_cas: bool = True,
+    storage_options: Optional[Dict[str, Any]] = None,
+) -> Dict[str, int]:
+    """Rewrite every committed step under a manager root between the
+    per-step layout and the content-addressed one.
+
+    ``to_cas=True``: payloads are read, hashed, stored once under
+    ``cas/`` (deduplicated across steps as they go), manifests rewritten to
+    digest references (version 0.4.0), and the original per-step payload
+    files removed.  ``to_cas=False`` (export): every referenced chunk is
+    materialized back into its step directory (``chunks/<digest>``),
+    manifests rewritten to step-relative locations, and chunks no longer
+    referenced by any committed step swept — each step is self-contained
+    again and portable with ``cp``.
+
+    Per step, the new manifest is committed durably BEFORE any old payload
+    is deleted, so a crash mid-repack leaves every step restorable from
+    whichever manifest is visible (stale files/chunks are reclaimed by
+    re-running repack or ``gc``).  Requires the native hash (content
+    addressing without digests is impossible)."""
+    from . import knobs
+    from .manifest import SnapshotMetadata
+    from .storage_plugin import url_to_storage_plugin
+
+    algo = knobs.get_cas_algo()
+    stats = {
+        "steps": 0,
+        "chunks_written": 0,
+        "bytes_written": 0,
+        "dedup_hits": 0,
+        "bytes_saved": 0,
+        "files_removed": 0,
+        "chunks_swept": 0,
+    }
+    root = url_to_storage_plugin(root_url, storage_options)
+    index = DigestIndex()
+    try:
+        try:
+            names = sorted(root.sync_list_dir(""))
+        except (NotImplementedError, FileNotFoundError):
+            names = []
+        steps = [
+            n
+            for n in names
+            if n.startswith("step_")
+            and root.sync_exists(f"{n}/.snapshot_metadata")
+        ]
+        for step_name in steps:
+            marker = f"{step_name}/.snapshot_metadata"
+            read_io = ReadIO(path=marker)
+            root.sync_read(read_io)
+            metadata = SnapshotMetadata.from_json(
+                bytes(read_io.buf).decode("utf-8")
+            )
+            if to_cas:
+                removed = _repack_step_to_cas(
+                    root, step_name, metadata, algo, index, stats
+                )
+                stats["files_removed"] += removed
+            else:
+                _export_step_from_cas(root, step_name, metadata, stats)
+            stats["steps"] += 1
+        if not to_cas:
+            # Every step is self-contained now; chunks referenced by no
+            # committed manifest are garbage.
+            referenced: Set[str] = set()
+            for step_name in steps:
+                read_io = ReadIO(path=f"{step_name}/.snapshot_metadata")
+                root.sync_read(read_io)
+                metadata = SnapshotMetadata.from_json(
+                    bytes(read_io.buf).decode("utf-8")
+                )
+                referenced |= referenced_chunk_relpaths(metadata.manifest)
+            for relpath in list_chunk_relpaths(root):
+                if relpath not in referenced:
+                    root.sync_delete(relpath)
+                    stats["chunks_swept"] += 1
+    finally:
+        root.sync_close()
+    return stats
+
+
+def _repack_step_to_cas(
+    root: StoragePlugin,
+    step_name: str,
+    metadata,
+    algo: str,
+    index: DigestIndex,
+    stats: Dict[str, int],
+) -> int:
+    from . import integrity
+    from .manifest import (
+        SnapshotMetadata,
+        iter_payload_entries,
+        manifest_version_for,
+    )
+
+    # location → entries sharing it (slab members, replicated references).
+    by_location: Dict[str, List[Any]] = {}
+    for _, entry in iter_payload_entries(metadata.manifest):
+        if not is_cas_location(entry.location):
+            by_location.setdefault(entry.location, []).append(entry)
+    relocated: List[str] = []
+    for location, entries in sorted(by_location.items()):
+        read_io = ReadIO(path=f"{step_name}/{location}")
+        root.sync_read(read_io)
+        digest = integrity.digest(read_io.buf)
+        if digest is None:
+            raise RuntimeError(
+                "repack requires the native xxh64 library (content "
+                "addressing is impossible without digests)"
+            )
+        hexdigest = digest.partition(":")[2]
+        key = _digest_key(algo, hexdigest)
+        relpath = chunk_relpath(algo, hexdigest)
+        nbytes = memoryview(read_io.buf).nbytes
+        # Existence alone must not be trusted here: repack DELETES the
+        # per-step originals afterwards, so deduplicating against a torn
+        # chunk (a crashed take's debris) would destroy the only good copy.
+        # Content-verify like the write path's probe does; a mismatched
+        # chunk is atomically healed by the durable rewrite below.
+        if key in index or _sync_chunk_matches(root, relpath, digest):
+            stats["dedup_hits"] += 1
+            stats["bytes_saved"] += nbytes
+        else:
+            root.sync_write(
+                WriteIO(path=relpath, buf=read_io.buf, durable=True)
+            )
+            stats["chunks_written"] += 1
+            stats["bytes_written"] += nbytes
+        index.add(key)
+        for entry in entries:
+            entry.location = location_for(algo, hexdigest)
+        relocated.append(location)
+    if not relocated:
+        return 0
+    new_metadata = SnapshotMetadata(
+        version=manifest_version_for(metadata.manifest),
+        world_size=metadata.world_size,
+        manifest=metadata.manifest,
+    )
+    # Commit point: the durable manifest rewrite flips the step to CAS
+    # atomically; only then are the now-unreferenced originals removed.
+    root.sync_write(
+        WriteIO(
+            path=f"{step_name}/.snapshot_metadata",
+            buf=new_metadata.to_json().encode("utf-8"),
+            durable=True,
+        )
+    )
+    removed = 0
+    for location in relocated:
+        try:
+            root.sync_delete(f"{step_name}/{location}")
+            removed += 1
+        except Exception:
+            logger.warning(
+                "repack: could not remove superseded payload %s/%s",
+                step_name,
+                location,
+                exc_info=True,
+            )
+    return removed
+
+
+def _export_step_from_cas(
+    root: StoragePlugin, step_name: str, metadata, stats: Dict[str, int]
+) -> None:
+    from .manifest import (
+        SnapshotMetadata,
+        iter_payload_entries,
+        manifest_version_for,
+    )
+
+    by_location: Dict[str, List[Any]] = {}
+    for _, entry in iter_payload_entries(metadata.manifest):
+        if is_cas_location(entry.location):
+            by_location.setdefault(entry.location, []).append(entry)
+    if not by_location:
+        return
+    for location, entries in sorted(by_location.items()):
+        _, hexdigest = parse_cas_location(location)
+        read_io = ReadIO(path=relpath_for_location(location))
+        root.sync_read(read_io)
+        dst = f"{EXPORT_DIR}/{hexdigest}"
+        root.sync_write(
+            WriteIO(path=f"{step_name}/{dst}", buf=read_io.buf, durable=True)
+        )
+        for entry in entries:
+            entry.location = dst
+    new_metadata = SnapshotMetadata(
+        version=manifest_version_for(metadata.manifest),
+        world_size=metadata.world_size,
+        manifest=metadata.manifest,
+    )
+    root.sync_write(
+        WriteIO(
+            path=f"{step_name}/.snapshot_metadata",
+            buf=new_metadata.to_json().encode("utf-8"),
+            durable=True,
+        )
+    )

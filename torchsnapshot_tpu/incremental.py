@@ -33,13 +33,7 @@ import logging
 from typing import Dict, Optional
 
 from .io_types import ReadIO, StoragePlugin, WriteIO, contiguous
-from .manifest import (
-    ChunkedTensorEntry,
-    ObjectEntry,
-    ShardedArrayEntry,
-    SnapshotMetadata,
-    TensorEntry,
-)
+from .manifest import SnapshotMetadata, iter_payload_entries
 
 logger = logging.getLogger(__name__)
 
@@ -48,30 +42,21 @@ def checksums_by_location(metadata: SnapshotMetadata) -> Dict[str, object]:
     """location → expected digest(s) for every payload in a manifest:
     a plain checksum string for whole-file payloads, or a
     {(start, end): checksum} dict for slab locations shared by several
-    byte-ranged members."""
+    byte-ranged members.  Walks the manifest through the one shared
+    payload iterator (``manifest.iter_payload_entries``) so this dedup
+    path and the CAS digest index (cas.py) can never disagree about what
+    counts as a payload."""
     out: Dict[str, object] = {}
-
-    def _add(entry: TensorEntry) -> None:
+    for _, entry in iter_payload_entries(metadata.manifest):
         if entry.checksum is None:
-            return
-        if entry.byte_range is None:
+            continue
+        byte_range = getattr(entry, "byte_range", None)
+        if byte_range is None:
             out[entry.location] = entry.checksum
-            return
+            continue
         ranges = out.setdefault(entry.location, {})
         if isinstance(ranges, dict):
-            ranges[tuple(entry.byte_range)] = entry.checksum
-
-    for entry in metadata.manifest.values():
-        if isinstance(entry, TensorEntry):
-            _add(entry)
-        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
-            shards = (
-                entry.shards if isinstance(entry, ShardedArrayEntry) else entry.chunks
-            )
-            for shard in shards:
-                _add(shard.tensor)
-        elif isinstance(entry, ObjectEntry) and entry.checksum is not None:
-            out[entry.location] = entry.checksum
+            ranges[tuple(byte_range)] = entry.checksum
     return out
 
 
@@ -198,6 +183,21 @@ def maybe_wrap_incremental(
     snapshot on the same backend; otherwise return ``storage`` unchanged."""
     if base_path is None:
         return storage
+    from . import cas
+
+    if cas.find_writer(storage) is not None:
+        # CAS mode subsumes incremental dedup: the digest index was seeded
+        # from every committed manifest under the root (the base included),
+        # and content addressing dedups by BYTES rather than by same-path —
+        # strictly stronger.  Wrapping again would hash every payload twice
+        # and attempt meaningless server-side copies of cas:// locations.
+        logger.info(
+            "incremental_from=%s delegated to the CAS digest index "
+            "(TPUSNAP_CAS is on; content addressing already dedups "
+            "against every committed step)",
+            base_path,
+        )
+        return storage
     if target_path is not None and _scheme(base_path) != _scheme(target_path):
         logger.warning(
             "incremental_from ignored: base scheme %s != target scheme %s",
@@ -227,6 +227,18 @@ def maybe_wrap_incremental(
     except Exception as e:  # noqa: BLE001
         logger.warning(
             "incremental_from ignored: base metadata unreadable (%s)", e
+        )
+        return storage
+    if cas.manifest_uses_cas(base_metadata.manifest):
+        # The base's locations are digest references, which can never match
+        # this take's step-relative write paths — the wrapper would hash
+        # every payload and dedup nothing.  CAS-mode roots get their dedup
+        # from the CAS layer itself (enable TPUSNAP_CAS for the take).
+        logger.warning(
+            "incremental_from ignored: base %s is a CAS-mode snapshot; "
+            "enable TPUSNAP_CAS=1 so the take dedups through the "
+            "content-addressed store instead",
+            base_path,
         )
         return storage
     base_checksums = checksums_by_location(base_metadata)

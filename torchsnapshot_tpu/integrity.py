@@ -91,12 +91,13 @@ def payload_checksums(metadata) -> dict:
     """``{(location, byte_range_tuple_or_None): checksum_or_None}`` for every
     payload a snapshot's manifest references, deduplicated (replicated
     entries and slab members point at shared durable payloads).  The file
-    set of a snapshot is exactly these locations plus the commit marker."""
-    from .manifest import ChunkedTensorEntry, ObjectEntry, ShardedArrayEntry, TensorEntry
+    set of a snapshot is exactly these locations plus the commit marker.
+    Walks the manifest through the one shared payload iterator
+    (``manifest.iter_payload_entries``)."""
+    from .manifest import iter_payload_entries
 
     payloads: dict = {}
-
-    def _add(entry) -> None:
+    for _, entry in iter_payload_entries(metadata.manifest):
         byte_range = getattr(entry, "byte_range", None)
         key = (entry.location, tuple(byte_range) if byte_range else None)
         # A digest-carrying reference must win over a checksum-less
@@ -104,18 +105,19 @@ def payload_checksums(metadata) -> dict:
         # durable file) — the audit would otherwise silently skip it.
         if payloads.get(key) is None:
             payloads[key] = entry.checksum
-    for entry in metadata.manifest.values():
-        if isinstance(entry, (TensorEntry, ObjectEntry)):
-            _add(entry)
-        elif isinstance(entry, (ShardedArrayEntry, ChunkedTensorEntry)):
-            shards = (
-                entry.shards
-                if isinstance(entry, ShardedArrayEntry)
-                else entry.chunks
-            )
-            for shard in shards:
-                _add(shard.tensor)
     return payloads
+
+
+def payload_referrers(metadata) -> dict:
+    """``{location: sorted manifest keys referencing it}`` — who to name
+    when a shared payload (a slab, a CAS chunk deduplicated across entries)
+    turns up missing or corrupt."""
+    from .manifest import iter_payload_entries
+
+    referrers: dict = {}
+    for key, entry in iter_payload_entries(metadata.manifest):
+        referrers.setdefault(entry.location, set()).add(key)
+    return {loc: sorted(keys) for loc, keys in referrers.items()}
 
 
 def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
@@ -128,7 +130,13 @@ def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
     Reads fan across ``io_concurrency`` threads (round-3 advisor finding:
     a strictly sequential audit re-downloaded cloud snapshots one payload
     at a time, making ``cp --verify`` much slower than the copy it
-    checked); results are aggregated in deterministic payload order."""
+    checked); results are aggregated in deterministic payload order.
+
+    An unreadable SHARED payload — a slab or a CAS chunk several entries
+    reference — is reported once per location (not once per byte range),
+    naming every referencing manifest entry, so "one missing chunk" reads
+    as one problem instead of a wall of duplicate lines.  The
+    ``unreadable`` COUNT stays per payload item, consistent with ``ok``."""
     from concurrent.futures import ThreadPoolExecutor
 
     from .io_types import ReadIO
@@ -147,21 +155,22 @@ def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
         try:
             storage.sync_read(read_io)
         except Exception as e:  # noqa: BLE001
-            return "unreadable", f"UNREADABLE {location}: {e}"
+            return "unreadable", location, str(e)
         try:
             verify(read_io.buf, checksum, location, precomputed=read_io.hash64)
-            return "ok", None
+            return "ok", location, None
         except ChecksumError as e:
-            return "corrupt", f"CORRUPT {e}"
+            return "corrupt", location, f"CORRUPT {e}"
 
     ok = corrupt = unreadable = 0
     problems = []
+    unreadable_locations: dict = {}
     if not items:
         return ok, corrupt, unreadable, problems
     with ThreadPoolExecutor(
         max_workers=max(1, io_concurrency), thread_name_prefix="snap_audit"
     ) as pool:
-        for status, problem in pool.map(_check_one, items):
+        for status, location, problem in pool.map(_check_one, items):
             if status == "ok":
                 ok += 1
             elif status == "corrupt":
@@ -169,7 +178,18 @@ def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
                 problems.append(problem)
             else:
                 unreadable += 1
-                problems.append(problem)
+                unreadable_locations.setdefault(location, problem)
+    if unreadable_locations:
+        referrers = payload_referrers(metadata)
+        for location in sorted(unreadable_locations):
+            refs = referrers.get(location, [])
+            named = ", ".join(refs[:8]) + (
+                f", ... {len(refs) - 8} more" if len(refs) > 8 else ""
+            )
+            problems.append(
+                f"UNREADABLE {location}: {unreadable_locations[location]}"
+                + (f" (referenced by: {named})" if refs else "")
+            )
     return ok, corrupt, unreadable, problems
 
 

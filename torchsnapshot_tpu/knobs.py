@@ -44,6 +44,13 @@ STALL_ESCALATE_ENV_VAR = _ENV_PREFIX + "STALL_ESCALATE"
 HEARTBEAT_FILE_ENV_VAR = _ENV_PREFIX + "HEARTBEAT_FILE"
 REGRESSION_FACTOR_ENV_VAR = _ENV_PREFIX + "REGRESSION_FACTOR"
 REGRESSION_WINDOW_ENV_VAR = _ENV_PREFIX + "REGRESSION_WINDOW"
+CAS_ENV_VAR = _ENV_PREFIX + "CAS"
+CAS_ALGO_ENV_VAR = _ENV_PREFIX + "CAS_ALGO"
+
+# Digest algorithms the CAS layout supports.  One today; the layout
+# namespaces chunks by algorithm (cas/<algo>/...) so adding another is a
+# new directory, not a migration.
+_SUPPORTED_CAS_ALGOS = ("xxh64",)
 
 _DEFAULT_MAX_CHUNK_SIZE_BYTES = 512 * 1024 * 1024
 _DEFAULT_MAX_SHARD_SIZE_BYTES = 512 * 1024 * 1024
@@ -415,6 +422,41 @@ def override_metrics(enabled: bool) -> Generator[None, None, None]:
 @contextmanager
 def override_sidecar(enabled: bool) -> Generator[None, None, None]:
     with _override_env(SIDECAR_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+def cas_enabled() -> bool:
+    """Whether takes write payloads into the content-addressed chunk store
+    (``cas.py``): chunks live once under ``<root>/cas/<algo>/...`` and
+    manifest entries reference digests, so bytes shared across steps are
+    stored once and saves of unchanged payloads write nothing.  Off by
+    default — CAS snapshots declare manifest version 0.4.0, which pre-CAS
+    readers reject."""
+    return _get_bool_env(CAS_ENV_VAR)
+
+
+def get_cas_algo() -> str:
+    """Digest algorithm naming CAS chunks (``TPUSNAP_CAS_ALGO``).  Only
+    ``xxh64`` is implemented; an unknown value fails loudly rather than
+    silently storing chunks a reader can't verify."""
+    val = os.environ.get(CAS_ALGO_ENV_VAR, "").strip().lower() or "xxh64"
+    if val not in _SUPPORTED_CAS_ALGOS:
+        raise ValueError(
+            f"{CAS_ALGO_ENV_VAR}={val!r}: unsupported digest algorithm "
+            f"(supported: {', '.join(_SUPPORTED_CAS_ALGOS)})"
+        )
+    return val
+
+
+@contextmanager
+def override_cas(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(CAS_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_cas_algo(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(CAS_ALGO_ENV_VAR, value):
         yield
 
 

@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import logging
 import re
-from typing import List, Optional, Union
+import threading
+from typing import List, Optional, Set, Tuple, Union
 
+from . import cas as cas_mod
 from . import retry
 from .event import Event
 from .event_handlers import log_event
@@ -45,6 +47,14 @@ class SnapshotManager:
         self.root = root.rstrip("/")
         self.max_to_keep = max_to_keep
         self._pg = pg or PGWrapper.from_jax()
+        # CAS chunk reclamation state: pruned steps' chunk references wait
+        # here until NO async save of this manager is in flight — an
+        # uncommitted take may have dedup-HIT a candidate chunk (not just
+        # written fresh ones), and sweeping before its manifest commits
+        # would leave it referencing a deleted chunk.
+        self._chunk_gc_lock = threading.Lock()
+        self._inflight_async_saves = 0
+        self._deferred_chunk_candidates: Set[str] = set()
 
     # ----------------------------------------------------------------- paths
 
@@ -103,27 +113,48 @@ class SnapshotManager:
             if latest is not None and latest != step:
                 base = self.path_for_step(latest)
         if async_:
-            pending = Snapshot.async_take(
-                path,
-                app_state,
-                pg=self._pg,
-                replicated=replicated,
-                incremental_from=base,
-            )
-            # Step history is appended only once the snapshot COMMITS —
-            # the done-callback runs on the completion thread (storage
-            # ops only, no collectives) and a failed save records nothing.
-            pending.add_done_callback(
-                lambda p: (
-                    self._record_history(step, action="async_take")
-                    if p.exception is None
-                    else None
+            # Count the save in flight BEFORE pruning enqueues candidates,
+            # so the enqueue can never sweep under this (or any sibling)
+            # uncommitted take.
+            with self._chunk_gc_lock:
+                self._inflight_async_saves += 1
+            try:
+                pending = Snapshot.async_take(
+                    path,
+                    app_state,
+                    pg=self._pg,
+                    replicated=replicated,
+                    incremental_from=base,
                 )
-            )
+            except BaseException:
+                with self._chunk_gc_lock:
+                    self._inflight_async_saves -= 1
+                raise
             # The in-flight snapshot must not count toward retention: if it
             # never commits, the previously committed ones are still the
             # only restore points — deleting them now could leave zero.
-            self._maybe_prune(exclude_step=step, include_current=False)
+            # Chunk reclamation is DEFERRED: pruned steps' chunk references
+            # are computed now (before deletion) but only swept once every
+            # async save of this manager has completed — an uncommitted
+            # take may have deduplicated against a chunk whose only
+            # committed referent was pruned right here.
+            candidates = self._maybe_prune(
+                exclude_step=step, include_current=False
+            )
+            if candidates:
+                self._enqueue_chunk_candidates(candidates)
+
+            # Step history is appended only once the snapshot COMMITS —
+            # the done-callback runs on the completion thread (storage
+            # ops only, no collectives) and a failed save records nothing.
+            def _on_done(p) -> None:
+                if p.exception is None:
+                    self._record_history(step, action="async_take")
+                with self._chunk_gc_lock:
+                    self._inflight_async_saves -= 1
+                self._maybe_sweep_deferred_chunks()
+
+            pending.add_done_callback(_on_done)
             return pending
         snapshot = Snapshot.take(
             path,
@@ -133,7 +164,9 @@ class SnapshotManager:
             incremental_from=base,
         )
         self._record_history(step, action="take")
-        self._maybe_prune(exclude_step=step, include_current=True)
+        candidates = self._maybe_prune(exclude_step=step, include_current=True)
+        if candidates:
+            self._enqueue_chunk_candidates(candidates)
         return snapshot
 
     def _record_history(self, step: int, action: str) -> None:
@@ -267,15 +300,32 @@ class SnapshotManager:
                 storage.sync_close()
 
     def gc(self, apply: bool = True) -> List[int]:
-        """Remove uncommitted (orphaned) step directories; returns the
-        steps removed (or, with ``apply=False``, the steps that WOULD be).
+        """Remove uncommitted (orphaned) step directories and sweep orphan
+        CAS chunks (chunks no committed manifest references — debris of
+        crashed CAS-mode takes or interrupted prunes); returns the steps
+        removed (or, with ``apply=False``, the steps that WOULD be).  Use
+        :meth:`gc_detail` for the swept chunk list, :meth:`orphan_chunks`
+        for the chunk-side dry run.
 
         Caller's caveat: an async save that hasn't committed yet is
-        indistinguishable from a crashed one — run GC only when no save is
-        in flight (the CLI defaults to a dry run for the same reason)."""
+        indistinguishable from a crashed one — and its fresh chunks from an
+        orphan — so run GC only when no save is in flight (the CLI
+        defaults to a dry run for the same reason)."""
+        return self.gc_detail(apply=apply)[0]
+
+    def gc_detail(self, apply: bool = True) -> Tuple[List[int], List[str]]:
+        """:meth:`gc` plus the orphan chunk relpaths swept (or, dry-run,
+        that WOULD be) — one scan of the root, not one per report line."""
         orphans = self.orphan_steps()
         if not apply:
-            return orphans
+            try:
+                return orphans, self.orphan_chunks()
+            except Exception:
+                logger.warning(
+                    "chunk classification failed; reporting steps only",
+                    exc_info=True,
+                )
+                return orphans, []
         storage = url_to_storage_plugin(self.root)
         try:
             for step in orphans:
@@ -290,15 +340,159 @@ class SnapshotManager:
                         metadata={"step": step, "root": self.root},
                     )
                 )
+            # Orphan steps gone: every chunk is now either referenced by a
+            # committed manifest or garbage.  Best-effort — a committed
+            # step whose manifest won't parse makes classification refuse,
+            # and skipping the sweep is the conservative outcome.
+            swept: List[str] = []
+            try:
+                swept = self._sweep_orphan_chunks(storage)
+            except Exception:
+                logger.warning(
+                    "orphan-chunk sweep skipped (chunk classification "
+                    "failed)",
+                    exc_info=True,
+                )
         finally:
             storage.sync_close()
+        return orphans, swept
+
+    # -------------------------------------------------------------- chunk gc
+
+    def _referenced_chunks(self, storage, steps: List[int]) -> Set[str]:
+        """Union of CAS chunk relpaths the given committed steps' manifests
+        reference.  A step whose manifest turns unreadable mid-scan makes
+        reclamation REFUSE (raise) rather than classify its chunks orphan."""
+        from .io_types import ReadIO
+        from .manifest import SnapshotMetadata
+
+        referenced: Set[str] = set()
+        for step in steps:
+            read_io = ReadIO(path=f"step_{step}/{SNAPSHOT_METADATA_FNAME}")
+            storage.sync_read(read_io)
+            metadata = SnapshotMetadata.from_json(
+                bytes(read_io.buf).decode("utf-8")
+            )
+            referenced |= cas_mod.referenced_chunk_relpaths(metadata.manifest)
+        return referenced
+
+    def chunk_classification(self, storage=None):
+        """``(referenced, orphan)`` CAS chunk relpath lists: every chunk
+        present under ``<root>/cas/`` is exactly one of the two (the
+        invariant the chaos suite asserts).  Both empty for non-CAS roots."""
+        own = storage is None
+        if own:
+            storage = url_to_storage_plugin(self.root)
+        try:
+            present = cas_mod.list_chunk_relpaths(storage)
+            if not present:
+                return [], []
+            referenced = self._referenced_chunks(
+                storage, self.all_steps(storage=storage)
+            )
+            return (
+                [p for p in present if p in referenced],
+                [p for p in present if p not in referenced],
+            )
+        finally:
+            if own:
+                storage.sync_close()
+
+    def orphan_chunks(self, storage=None) -> List[str]:
+        """CAS chunks referenced by no committed step — a crashed CAS-mode
+        take's debris, or leftovers of an interrupted prune.  Same caveat
+        as :meth:`orphan_steps`: an async save in flight makes its fresh
+        chunks look orphaned."""
+        return self.chunk_classification(storage=storage)[1]
+
+    def _sweep_orphan_chunks(self, storage) -> List[str]:
+        orphans = self.orphan_chunks(storage=storage)
+        for relpath in orphans:
+            storage.sync_delete(relpath)
+            tmetrics.record_gc("chunk_removed")
+            log_event(
+                Event(
+                    name="gc.chunk_removed",
+                    metadata={"chunk": relpath, "root": self.root},
+                )
+            )
+        if orphans:
+            logger.info("GC: removed %d orphan CAS chunk(s)", len(orphans))
         return orphans
+
+    def _sweep_chunk_candidates(self, candidates: Set[str]) -> None:
+        """Delete the chunks in ``candidates`` that no committed manifest
+        references anymore — the deferred half of a prune (refcounted
+        reclamation).  Restricting the sweep to candidates referenced by
+        the PRUNED steps keeps a concurrent take's fresh chunks out of
+        reach by construction.  Best-effort: a failure leaves orphan
+        chunks for ``gc``, never a broken snapshot."""
+        try:
+            storage = url_to_storage_plugin(self.root)
+            try:
+                survivors = self._referenced_chunks(
+                    storage, self.all_steps(storage=storage)
+                )
+                for relpath in sorted(candidates - survivors):
+                    try:
+                        storage.sync_delete(relpath)
+                    except FileNotFoundError:
+                        continue
+                    tmetrics.record_gc("chunk_removed")
+                    log_event(
+                        Event(
+                            name="gc.chunk_removed",
+                            metadata={"chunk": relpath, "root": self.root},
+                        )
+                    )
+            finally:
+                storage.sync_close()
+        except Exception:
+            logger.warning(
+                "CAS chunk reclamation failed; orphan chunks remain "
+                "GC-able (python -m torchsnapshot_tpu gc)",
+                exc_info=True,
+            )
 
     # ---------------------------------------------------------------- prune
 
-    def _maybe_prune(self, exclude_step: int, include_current: bool) -> None:
+    def _enqueue_chunk_candidates(self, candidates: Set[str]) -> None:
+        with self._chunk_gc_lock:
+            self._deferred_chunk_candidates |= candidates
+        self._maybe_sweep_deferred_chunks()
+
+    def _maybe_sweep_deferred_chunks(self) -> None:
+        """Sweep accumulated prune candidates iff no async save of this
+        manager is in flight — an uncommitted take's manifest isn't visible
+        to the survivor scan, and it may reference (via dedup hits, not
+        just fresh writes) exactly the chunks queued here."""
+        with self._chunk_gc_lock:
+            if (
+                self._inflight_async_saves > 0
+                or not self._deferred_chunk_candidates
+            ):
+                return
+            candidates = set(self._deferred_chunk_candidates)
+            self._deferred_chunk_candidates.clear()
+        self._sweep_chunk_candidates(candidates)
+
+    def _maybe_prune(
+        self,
+        exclude_step: int,
+        include_current: bool,
+    ) -> Optional[Set[str]]:
+        """Retention pruning with refcounted CAS chunk reclamation:
+        pruning a step may reclaim only chunks no surviving committed
+        manifest references.  Candidates — the PRUNED steps' chunk
+        references, read before their directories go — are RETURNED, not
+        swept: the caller routes them through the deferred-sweep queue,
+        which waits out this manager's in-flight async saves (their
+        commits may reference candidates).  Saves driven by other
+        managers/processes keep the same caveat as ``gc``: don't reclaim
+        while they run."""
         if self.max_to_keep is None:
-            return
+            return None
+        deferred: Optional[Set[str]] = None
         # Single deleter: rank 0 prunes between barriers so no rank is still
         # reading a pruned snapshot mid-restore; prune failures are logged,
         # never propagated past the closing barrier (peers are blocked in it).
@@ -314,9 +508,26 @@ class SnapshotManager:
                     ]
                     budget = self.max_to_keep - (1 if include_current else 0)
                     excess = len(committed) - budget
-                    for step in committed[: max(excess, 0)]:
+                    to_prune = committed[: max(excess, 0)]
+                    candidates: Set[str] = set()
+                    if to_prune:
+                        try:
+                            candidates = self._referenced_chunks(
+                                storage, to_prune
+                            )
+                        except Exception:
+                            # Unreadable manifest: prune the dirs, leave the
+                            # chunks (they become gc-able orphans at worst).
+                            logger.warning(
+                                "chunk refcount scan failed; pruned steps' "
+                                "chunks left for gc",
+                                exc_info=True,
+                            )
+                    for step in to_prune:
                         logger.info("Pruning snapshot step_%d", step)
                         storage.sync_delete_dir(f"step_{step}")
+                    if candidates:
+                        deferred = candidates
                 finally:
                     storage.sync_close()
         except NotImplementedError:
@@ -325,3 +536,4 @@ class SnapshotManager:
             logger.exception("Retention pruning failed; continuing")
         finally:
             self._pg.barrier()
+        return deferred

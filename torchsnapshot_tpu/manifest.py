@@ -486,29 +486,54 @@ MANIFEST_VERSION = "0.1.0"
 # "upgrade to restore" error instead.  Uncompressed snapshots keep declaring
 # 0.1.0 — their on-disk format is byte-identical to the pre-codec one.
 FRAMED_MANIFEST_VERSION = "0.2.0"
-SUPPORTED_MANIFEST_VERSIONS = (MANIFEST_VERSION, FRAMED_MANIFEST_VERSION)
+# Snapshots whose entries reference content-addressed chunks (``cas://``
+# locations resolved under the root's shared ``cas/`` store, cas.py) declare
+# 0.4.0: a pre-CAS reader would treat the reference as a step-relative file
+# path and fail with a misleading not-found.  0.1–0.3 readers reject it
+# cleanly via the from_json version validation below.  (0.3.0 was reserved
+# by an earlier roadmap draft of this feature and never shipped.)
+CAS_MANIFEST_VERSION = "0.4.0"
+SUPPORTED_MANIFEST_VERSIONS = (
+    MANIFEST_VERSION,
+    FRAMED_MANIFEST_VERSION,
+    CAS_MANIFEST_VERSION,
+)
 
 
-def _iter_tensor_entries(manifest: "Manifest"):
-    for entry in manifest.values():
-        if isinstance(entry, TensorEntry):
-            yield entry
+def iter_payload_entries(manifest: "Manifest"):
+    """Yield ``(manifest_key, leaf_entry)`` for every payload-carrying entry
+    — ``TensorEntry``/``ObjectEntry``, including the tensors nested inside
+    sharded and chunked entries (their manifest key is the parent's).
+
+    The ONE manifest walk shared by incremental dedup
+    (``incremental.checksums_by_location``), integrity auditing
+    (``integrity.payload_checksums``), and the CAS digest index (cas.py) —
+    so the three can never disagree about what counts as a payload."""
+    for key, entry in manifest.items():
+        if isinstance(entry, (TensorEntry, ObjectEntry)):
+            yield key, entry
         elif isinstance(entry, ShardedArrayEntry):
             for shard in entry.shards:
-                yield shard.tensor
+                yield key, shard.tensor
         elif isinstance(entry, ChunkedTensorEntry):
             for chunk in entry.chunks:
-                yield chunk.tensor
+                yield key, chunk.tensor
 
 
 def manifest_version_for(manifest: "Manifest") -> str:
-    """The version a manifest must declare: ``FRAMED_MANIFEST_VERSION`` when
-    any payload is frame-encoded, else the base ``MANIFEST_VERSION``."""
+    """The version a manifest must declare: ``CAS_MANIFEST_VERSION`` when any
+    payload is a digest reference into the content-addressed store,
+    ``FRAMED_MANIFEST_VERSION`` when any payload is frame-encoded, else the
+    base ``MANIFEST_VERSION``."""
+    from .cas import is_cas_location
     from .compression import is_framed
 
-    if any(is_framed(t) for t in _iter_tensor_entries(manifest)):
-        return FRAMED_MANIFEST_VERSION
-    return MANIFEST_VERSION
+    framed = False
+    for _, entry in iter_payload_entries(manifest):
+        if is_cas_location(entry.location):
+            return CAS_MANIFEST_VERSION
+        framed = framed or is_framed(entry)
+    return FRAMED_MANIFEST_VERSION if framed else MANIFEST_VERSION
 
 
 @dataclass

@@ -165,6 +165,19 @@ def copy_snapshot(
     dst = url_to_storage_plugin(dst_path)
     try:
         metadata = Snapshot(src_path).metadata  # validates src is committed
+        from . import cas
+
+        if cas.manifest_uses_cas(metadata.manifest):
+            # A CAS step is NOT self-contained: its payloads live in the
+            # root's shared cas/ store, and copying the step dir alone
+            # would yield a committed-looking snapshot with every chunk
+            # missing.  Materialize first.
+            raise RuntimeError(
+                f"{src_path} references content-addressed chunks (manifest "
+                f"{metadata.version}); run 'python -m torchsnapshot_tpu "
+                "repack <root> --export' to make steps self-contained "
+                "before copying them individually"
+            )
         if dst.sync_exists(SNAPSHOT_METADATA_FNAME):
             if not overwrite:
                 raise RuntimeError(

@@ -123,6 +123,14 @@ class Snapshot:
                 path, pg, replicated or []
             )
             storage = url_to_storage_plugin(path, storage_options)
+            # CAS first, incremental second: with content addressing on,
+            # maybe_wrap_incremental detects the CAS writer and delegates
+            # (the digest index dedups strictly more than same-path copies).
+            from . import cas as cas_mod
+
+            storage = cas_mod.maybe_wrap_cas_writes(
+                storage, path, storage_options
+            )
             if incremental_from is not None:
                 from .incremental import maybe_wrap_incremental
 
@@ -131,7 +139,7 @@ class Snapshot:
                 )
             try:
                 try:
-                    pending_io_work, metadata, _ = cls._take_impl(
+                    pending_io_work, entries, _ = cls._take_impl(
                         path=path,
                         app_state=app_state,
                         replicated_patterns=replicated_patterns,
@@ -140,6 +148,17 @@ class Snapshot:
                         is_async_snapshot=False,
                     )
                     pending_io_work.sync_complete()
+                    # All payload writes landed: rewrite CAS-diverted
+                    # entries to their digest references (no-op outside CAS
+                    # mode) BEFORE the manifest is gathered — the gathered
+                    # copy is what rank 0 commits.
+                    cas_mod.apply_relocations(storage, entries)
+                    global_manifest = cls._gather_manifest(entries, pg)
+                    metadata = SnapshotMetadata(
+                        version=manifest_version_for(global_manifest),
+                        world_size=pg.get_world_size(),
+                        manifest=global_manifest,
+                    )
                     # All ranks' payloads durable → rank 0 commits
                     # (reference :202-209).
                     pg.barrier()
@@ -158,6 +177,15 @@ class Snapshot:
                 # the payloads it describes (best-effort, opt-out via
                 # TPUSNAP_SIDECAR=0).
                 if tsidecar.enabled():
+                    extra = {
+                        "world_size": pg.get_world_size(),
+                        "rss_high_water_bytes": health.rss_high_water(),
+                    }
+                    cas_stats = cas_mod.writer_stats(storage)
+                    if cas_stats is not None:
+                        # Logical-vs-physical bytes: what the save would
+                        # have written without dedup vs what it did.
+                        extra["cas"] = cas_stats
                     tsidecar.write(
                         storage,
                         tsidecar.build(
@@ -167,12 +195,7 @@ class Snapshot:
                             duration_s=time.monotonic() - begin,
                             phases=phase_stats.delta(phases_before),
                             nbytes=pending_io_work.bytes_total,
-                            extra={
-                                "world_size": pg.get_world_size(),
-                                "rss_high_water_bytes": (
-                                    health.rss_high_water()
-                                ),
-                            },
+                            extra=extra,
                         ),
                     )
             finally:
@@ -242,6 +265,11 @@ class Snapshot:
                 path, pg, replicated or []
             )
             storage = url_to_storage_plugin(path, storage_options)
+            from . import cas as cas_mod
+
+            storage = cas_mod.maybe_wrap_cas_writes(
+                storage, path, storage_options
+            )
             if incremental_from is not None:
                 from .incremental import maybe_wrap_incremental
 
@@ -294,7 +322,7 @@ class Snapshot:
         storage: StoragePlugin,
         pg: PGWrapper,
         is_async_snapshot: bool,
-    ) -> Tuple[Any, Optional[SnapshotMetadata], Optional["_ManifestFinalizer"]]:
+    ) -> Tuple[Any, Optional[Manifest], Optional["_ManifestFinalizer"]]:
         rank = pg.get_rank()
         world_size = pg.get_world_size()
 
@@ -450,17 +478,12 @@ class Snapshot:
             memory_budget_bytes=memory_budget_bytes,
             rank=rank,
         )
-        # Gather the manifest AFTER staging (sync_execute_write_reqs returns
-        # once staging is drained): stagers annotate their entries with
-        # payload checksums, which must reach the gathered copy.  Still on
-        # the main thread — collectives are forbidden off it.
-        global_manifest = cls._gather_manifest(entries, pg)
-        metadata = SnapshotMetadata(
-            version=manifest_version_for(global_manifest),
-            world_size=world_size,
-            manifest=global_manifest,
-        )
-        return pending_io_work, metadata, None
+        # The caller (take) gathers the manifest AFTER the pipeline fully
+        # drains: stagers annotate their entries with payload checksums
+        # during staging, and CAS relocations (digest references) only
+        # exist once every write executed.  The gather stays on the main
+        # thread — collectives are forbidden off it.
+        return pending_io_work, entries, None
 
     # --------------------------------------------------------------- restore
 
@@ -498,6 +521,14 @@ class Snapshot:
             storage = url_to_storage_plugin(self.path, self._storage_options)
             try:
                 metadata = self._get_metadata(storage)
+                # Digest references (manifest 0.4.0) resolve against the
+                # root's cas/ store transparently; a no-op for per-step
+                # layouts.
+                from . import cas as cas_mod
+
+                storage = cas_mod.maybe_wrap_cas_reads(
+                    storage, self.path, metadata, self._storage_options
+                )
                 app_state = dict(app_state)
                 rng_state_item = self._pop_rng_state(app_state)
                 global_keys = self._gather_keys(app_state, pg)
@@ -703,6 +734,11 @@ class Snapshot:
             storage = url_to_storage_plugin(self.path, self._storage_options)
             try:
                 metadata = self._get_metadata(storage)
+                from . import cas as cas_mod
+
+                storage = cas_mod.maybe_wrap_cas_reads(
+                    storage, self.path, metadata, self._storage_options
+                )
                 manifest, _ = get_manifest_for_rank(metadata, int(rank_str))
                 if logical_path not in manifest:
                     raise RuntimeError(
@@ -778,6 +814,11 @@ class Snapshot:
         storage = url_to_storage_plugin(self.path, self._storage_options)
         try:
             metadata = self._get_metadata(storage)
+            from . import cas as cas_mod
+
+            storage = cas_mod.maybe_wrap_cas_reads(
+                storage, self.path, metadata, self._storage_options
+            )
             rank = 0 if replicate_from_rank0 else self._pg.get_rank()
             local_manifest, _ = get_manifest_for_rank(metadata, rank)
             prefix = key + "/"
@@ -1036,6 +1077,13 @@ class _ManifestFinalizer:
         self.staging_mode = staging_mode
         self.staging_stats = staging_stats or {}
 
+    @property
+    def entries(self) -> Manifest:
+        """This rank's (mutable) manifest entries — the CAS relocation pass
+        rewrites their locations in place after the background pipeline
+        drains, before the sidecar exchange serializes them."""
+        return self._entries
+
     def write_sidecar(self, storage: StoragePlugin) -> None:
         """Ranks ≠ 0: persist this rank's (checksum-annotated) entries for
         rank 0 to merge.  Must run before the commit barrier's arrive."""
@@ -1169,6 +1217,12 @@ class PendingSnapshot:
         try:
             pending_io_work.sync_complete()
             self._bytes_total = getattr(pending_io_work, "bytes_total", 0)
+            # Pipeline drained: rewrite CAS-diverted entries to digest
+            # references (no-op outside CAS mode) before they are
+            # serialized into the cross-rank sidecar exchange below.
+            from . import cas as cas_mod
+
+            cas_mod.apply_relocations(self._storage, self._finalizer.entries)
             # Payloads durable; exchange checksum-annotated manifests via
             # storage sidecars (no collectives on this thread) — the arrive
             # barrier orders rank 0's merge after every sidecar landed.
@@ -1185,6 +1239,19 @@ class PendingSnapshot:
             # Committed: persist this rank's telemetry summary (still on
             # the background thread — storage-only, no collectives).
             if tsidecar.enabled():
+                extra = {
+                    "world_size": self.pg.get_world_size(),
+                    "staging_mode": self._finalizer.staging_mode,
+                    "stall_s": round(self.stall_s, 4),
+                    "rss_high_water_bytes": (
+                        self._monitor.rss_high_water()
+                        if self._monitor is not None
+                        else None
+                    ),
+                }
+                cas_stats = cas_mod.writer_stats(self._storage)
+                if cas_stats is not None:
+                    extra["cas"] = cas_stats
                 tsidecar.write(
                     self._storage,
                     tsidecar.build(
@@ -1194,16 +1261,7 @@ class PendingSnapshot:
                         duration_s=time.monotonic() - self._begin,
                         phases=phase_stats.delta(self._phases_before),
                         nbytes=self._bytes_total,
-                        extra={
-                            "world_size": self.pg.get_world_size(),
-                            "staging_mode": self._finalizer.staging_mode,
-                            "stall_s": round(self.stall_s, 4),
-                            "rss_high_water_bytes": (
-                                self._monitor.rss_high_water()
-                                if self._monitor is not None
-                                else None
-                            ),
-                        },
+                        extra=extra,
                     ),
                 )
             self._storage.sync_close()

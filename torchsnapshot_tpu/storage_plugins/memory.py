@@ -24,6 +24,19 @@ class MemoryStoragePlugin(StoragePlugin):
         with _LOCK:
             self._files = _REGISTRY.setdefault(root, {})
 
+    def _resolve(self, path: str):
+        """(files_dict, key) owning ``path`` — the nested registry whose
+        root prefixes it (a root-rooted plugin addressing
+        ``step_1/.snapshot_metadata`` must hit the same storage a
+        step-rooted plugin created), else this plugin's own files.  Must
+        be called under ``_LOCK``."""
+        if path not in self._files:
+            full = f"{self.root}/{path}"
+            for reg_root, files in _REGISTRY.items():
+                if reg_root != self.root and full.startswith(reg_root + "/"):
+                    return files, full[len(reg_root) + 1 :]
+        return self._files, path
+
     async def write(self, write_io: WriteIO) -> None:
         from .. import phase_stats
 
@@ -38,13 +51,17 @@ class MemoryStoragePlugin(StoragePlugin):
         ):
             data = bytes(contiguous(write_io.buf))
             with _LOCK:
-                self._files[write_io.path] = data
+                files, key = self._resolve(write_io.path)
+                files[key] = data
 
     async def read(self, read_io: ReadIO) -> None:
         from .. import phase_stats
 
         with _LOCK:
-            data = self._files[read_io.path]
+            files, key = self._resolve(read_io.path)
+            data = files.get(key)
+            if data is None:
+                raise KeyError(read_io.path)
         if read_io.byte_range is not None:
             offset, end = read_io.byte_range
             data = data[offset:end]
@@ -84,7 +101,8 @@ class MemoryStoragePlugin(StoragePlugin):
 
     async def delete(self, path: str) -> None:
         with _LOCK:
-            self._files.pop(path, None)
+            files, key = self._resolve(path)
+            files.pop(key, None)
 
     async def delete_dir(self, path: str) -> None:
         prefix = path.rstrip("/") + "/"

@@ -77,7 +77,7 @@ def summarize_sidecar(
             name: round(v.get("wall", v.get("s", 0.0)), 4) for name, v in top
         },
     }
-    for key in ("rss_high_water_bytes", "staging_mode", "stall_s"):
+    for key in ("rss_high_water_bytes", "staging_mode", "stall_s", "cas"):
         if key in doc:
             entry[key] = doc[key]
     return entry
@@ -215,9 +215,16 @@ def render(entries: List[Dict[str, Any]], limit: int = 50) -> str:
         bar = "#" * int(round(20 * dur / max_dur)) if max_dur > 0 else ""
         gbps = e.get("throughput_gbps")
         flag = ""
+        cas = e.get("cas")
+        if isinstance(cas, dict) and cas.get("logical_bytes"):
+            physical = cas.get("physical_bytes_written", 0)
+            if physical:
+                flag = f"  dedup={cas['logical_bytes'] / physical:.1f}x"
+            else:
+                flag = "  dedup=all"  # every payload hit the CAS
         if "regression" in e:
             reg = e["regression"]
-            flag = f"  << REGRESSION {reg.get('ratio', '?')}x median"
+            flag += f"  << REGRESSION {reg.get('ratio', '?')}x median"
         lines.append(
             f"{str(e.get('step', '-')):>8} {e.get('action', '?'):>10} "
             f"{dur:>8.2f}s {(e.get('bytes') or 0) / 1e9:>8.2f}G "

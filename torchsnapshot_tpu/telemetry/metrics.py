@@ -369,6 +369,22 @@ def record_fault(op: str, kind: str) -> None:
     ).inc(op=op, kind=kind)
 
 
+def record_cas_dedup(hits: int, bytes_saved: int) -> None:
+    """Content-addressed dedup outcome of one take (cas.py): payload
+    writes satisfied by an existing chunk, and the logical bytes those
+    hits did NOT write."""
+    if not enabled() or not (hits or bytes_saved):
+        return
+    counter(
+        "tpusnap_cas_dedup_hits_total",
+        "Payload writes deduplicated against the content-addressed store",
+    ).inc(hits)
+    counter(
+        "tpusnap_cas_dedup_bytes_saved_total",
+        "Logical payload bytes not written thanks to CAS dedup",
+    ).inc(bytes_saved)
+
+
 def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
     """One framed payload's in/out byte counts; ratio derives at query
     time as uncompressed_total / compressed_total."""
@@ -411,8 +427,10 @@ DIRECT_METRIC_EVENTS = frozenset(
         "scheduler.write_retry",  # record_pipeline_retry("write")
         "restore_latest.fallback",  # record_restore_fallback
         "gc.orphan_removed",  # record_gc("orphan_removed")
+        "gc.chunk_removed",  # record_gc("chunk_removed")
         "take.cleanup",  # record_gc("take_cleanup")
         "async_take.cleanup",  # record_gc("take_cleanup")
+        "cas.dedup",  # record_cas_dedup
     }
 )
 

@@ -45,6 +45,7 @@ def _knob_values() -> Dict[str, Any]:
     codec, level = knobs.get_compression()
     return {
         "compression": codec if level is None else f"{codec}:{level}",
+        "cas": knobs.cas_enabled(),
         "compression_min_bytes": knobs.get_compression_min_bytes(),
         "max_per_rank_io_concurrency": knobs.get_max_per_rank_io_concurrency(),
         "slab_size_threshold_bytes": knobs.get_slab_size_threshold_bytes(),
@@ -151,10 +152,22 @@ def summarize(doc: Dict[str, Any]) -> str:
     top_str = " ".join(
         "{}={:.2f}s".format(ph, v.get("wall", v.get("s", 0.0))) for ph, v in top
     )
-    return (
+    line = (
         f"{doc.get('action', '?'):>10}  rank {doc.get('rank', '?')}  "
         f"{doc.get('duration_s', 0.0):7.2f}s  "
         f"{(doc.get('bytes') or 0) / 1e9:8.3f}GB  "
         f"{gbps if gbps is not None else '-':>7} GB/s  "
         f"[{'ok' if doc.get('success', True) else 'ERR'}] {top_str}"
     )
+    cas = doc.get("cas")
+    if isinstance(cas, dict) and cas.get("logical_bytes"):
+        # Logical vs physical: what the save represents vs what it wrote.
+        logical = cas["logical_bytes"]
+        physical = cas.get("physical_bytes_written", logical)
+        ratio = logical / physical if physical else float("inf")
+        ratio_str = f"{ratio:.2f}x" if physical else "inf"
+        line += (
+            f" dedup={ratio_str} ({physical / 1e9:.3f}GB physical of "
+            f"{logical / 1e9:.3f}GB logical)"
+        )
+    return line
