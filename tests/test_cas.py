@@ -176,9 +176,9 @@ def test_gc_sweeps_crashed_take_orphan_chunks(tmp_path):
     referenced, orphan = mgr.chunk_classification()
     assert orphan, "the crashed take's unreferenced chunk should be orphan"
     # dry run reports without removing; apply returns exactly what it swept
-    dry_steps, dry_chunks = mgr.gc_detail(apply=False)
+    dry_steps, dry_chunks, _ = mgr.gc_detail(apply=False)
     assert dry_chunks == orphan
-    _, swept = mgr.gc_detail(apply=True)
+    _, swept, _ = mgr.gc_detail(apply=True)
     assert swept == orphan
     referenced2, orphan2 = mgr.chunk_classification()
     assert orphan2 == []

@@ -167,3 +167,141 @@ def test_chaos_soak(tmp_path):
     leaves a GC-able orphan, and restore_latest always lands good."""
     for seed in range(8):
         _run_chaos(str(tmp_path / f"ckpts_{seed}"), seed=seed, n_steps=25)
+
+
+# ------------------------------------------------------------- journal mode
+
+
+def _journal_restore_point_exists(root: str, step: int) -> bool:
+    """Whether step N owns a committed restore point — a full step dir or a
+    journal segment.  Compaction may legally fold seg_N into step_N
+    between the save and this check, so either marker counts."""
+    storage = url_to_storage_plugin(root)
+    try:
+        return storage.sync_exists(
+            f"step_{step}/{SNAPSHOT_METADATA_FNAME}"
+        ) or storage.sync_exists(f"seg_{step}/{SNAPSHOT_METADATA_FNAME}")
+    finally:
+        storage.sync_close()
+
+
+def _run_journal_chaos(root: str, seed: int, n_steps: int) -> None:
+    """Journal-mode chaos: seeded faults kill takes mid-segment, mid-base,
+    and mid-compaction (the fault env wraps EVERY plugin instance,
+    compaction's included).  Invariants after every step:
+
+    - commit marker (step_N or seg_N) present iff the save reported success
+    - a failed save leaves at most GC-able debris (orphan dir + marker)
+    - every CAS chunk on disk is classifiable (referenced or orphan)
+
+    and at the end: forced gc clears all debris, every byte on disk is
+    accounted for, and restore_latest lands on the newest committed step
+    with intact bytes."""
+    import torchsnapshot_tpu.cas as cas_mod
+    from torchsnapshot_tpu import journal as journal_mod
+
+    rng = random.Random(seed)
+    committed = []
+    with knobs.override_retry_base_s(0.001), knobs.override_sidecar(
+        False
+    ), knobs.override_slab_size_threshold_bytes(
+        64
+    ), knobs.override_journal_max_segments(3):
+        mgr = SnapshotManager(root, journal=True)
+        for step in range(1, n_steps + 1):
+            spec, must_commit = _MENU[rng.randrange(len(_MENU))]
+            # Journal mode changes per-plugin write counts (delta manifests,
+            # CAS diversion, compaction I/O), so only the schedule-
+            # independent outcomes stay calibrated.
+            if spec not in ("", "write:1+:transient", "write:1:terminal"):
+                must_commit = None
+            use_async = rng.random() < 0.25
+            with knobs.override_faults(spec or None):
+                try:
+                    if use_async:
+                        mgr.save(step, _state(step), async_=True).wait()
+                    else:
+                        mgr.save(step, _state(step))
+                    took = True
+                except Exception:
+                    took = False
+            if must_commit is not None:
+                assert took is must_commit, (seed, step, spec, use_async)
+            assert _journal_restore_point_exists(root, step) is took, (
+                seed,
+                step,
+                spec,
+                use_async,
+            )
+            if took:
+                committed.append(step)
+            else:
+                # Debris is at most this step's own orphan dir.
+                assert mgr.orphan_steps() in ([], [step]), (seed, step, spec)
+                assert mgr.orphan_segments() in ([], [step]), (
+                    seed,
+                    step,
+                    spec,
+                )
+            # Chunk invariant: everything under cas/ is classifiable.
+            referenced, orphan = mgr.chunk_classification()
+            storage = url_to_storage_plugin(root)
+            try:
+                present = cas_mod.list_chunk_relpaths(storage)
+            finally:
+                storage.sync_close()
+            assert sorted(referenced + orphan) == present, (seed, step)
+
+        # Forced gc (failed saves may have leaked advisory markers whose
+        # pid — ours — is alive): every orphan dir, stale segment, marker,
+        # and orphan chunk goes; committed restore points survive.
+        mgr.gc(apply=True, force=True)
+        assert mgr.orphan_steps() == []
+        assert mgr.orphan_segments() == []
+        assert mgr.stale_segments() == []
+        assert mgr.inflight_markers() == []
+        assert mgr.orphan_chunks() == []
+        storage = url_to_storage_plugin(root)
+        try:
+            live = set(mgr.all_steps(storage=storage)) | set(
+                journal_mod.committed_segments(storage)
+            )
+        finally:
+            storage.sync_close()
+        # gc removes only non-restore-point debris: the newest committed
+        # save is still restorable (earlier ones may legally be folded or
+        # pruned into newer points).
+        if committed:
+            assert max(committed) in live, (seed, committed, live)
+            dst = _state(0)
+            assert mgr.restore_latest(dst) == committed[-1], (seed, committed)
+            np.testing.assert_array_equal(
+                dst["m"]["w"], np.full((512,), float(committed[-1]))
+            )
+        else:
+            assert mgr.restore_latest(_state(0)) is None
+
+
+def test_chaos_journal_fast(tmp_path):
+    """Journal-mode tier-1 variant: one fixed seed over the same schedule
+    menu, with compaction every 3 segments so mid-compaction faults are
+    exercised inside the run."""
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("journal digests require the native library")
+    _run_journal_chaos(str(tmp_path / "ckpts"), seed=20260804, n_steps=12)
+
+
+@pytest.mark.slow
+def test_chaos_journal_soak(tmp_path):
+    """Multi-seed journal soak: >= 50 faulted journal-mode takes total
+    (the acceptance bar), every one ending classifiable and restorable."""
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("journal digests require the native library")
+    for seed in range(3):
+        _run_journal_chaos(
+            str(tmp_path / f"ckpts_{seed}"), seed=seed, n_steps=20
+        )

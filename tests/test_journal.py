@@ -1,0 +1,403 @@
+"""Delta-journal checkpointing (journal.py + manager journal mode).
+
+Covers the journal lifecycle end to end: delta segments carry only changed
+entries, replay resolves every entry to its newest segment, compaction
+folds segments into full steps without rewriting payloads, recovery falls
+back past corrupt segments/chains, the digest index is maintained
+incrementally (persisted sidecar, no per-take re-seed), and the gc
+in-flight guard refuses while a save looks live.
+"""
+
+import json
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import StateDict, knobs
+from torchsnapshot_tpu import cas as cas_mod
+from torchsnapshot_tpu import journal as journal_mod
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.snapshot import Snapshot
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+
+def _native_available() -> bool:
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    return get_native_lib_path() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="journal digests require the native lib"
+)
+
+
+def _state(v, frozen=None, drop=False):
+    d = {"hot": np.full((128,), float(v), np.float32), "step": v}
+    if frozen is not None:
+        d["frozen"] = frozen
+    if not drop:
+        d["extra"] = np.full((16,), 7.0, np.float32)
+    return {"m": StateDict(d)}
+
+
+@pytest.fixture
+def journal_env():
+    """Small slabs so distinct leaves stay distinct CAS chunks (the
+    documented slab-granularity caveat would otherwise rewrite a frozen
+    leaf riding a churning slab), sidecars off for speed."""
+    with knobs.override_sidecar(False), knobs.override_slab_size_threshold_bytes(
+        64
+    ), knobs.override_retry_base_s(0.001):
+        yield
+
+
+def test_journal_roundtrip_and_delta_shape(tmp_path, journal_env):
+    frozen = np.arange(8192, dtype=np.float32)
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    for step in (1, 2, 3):
+        mgr.save(step, _state(step, frozen))
+    # First save is the full base; later saves are delta segments.
+    assert mgr.all_steps() == [1]
+    assert mgr.restore_points() == [(1, "full"), (2, "seg"), (3, "seg")]
+
+    storage = url_to_storage_plugin(root)
+    try:
+        md = journal_mod.read_segment_metadata(storage, 3)
+    finally:
+        storage.sync_close()
+    assert md.version == "0.5.0"
+    info = md.journal
+    assert info["base_step"] == 1
+    assert info["prior_segments"] == [2]
+    # Only the churning leaves changed: the frozen array and the unchanged
+    # extra leaf (and their container) stay OUT of the delta.
+    assert info["entries_delta"] < info["entries_total"]
+    assert not any("frozen" in path for path in md.manifest)
+    # Appended logical bytes track the changed fraction, not total size.
+    assert info["delta_bytes"] < frozen.nbytes
+
+    dst = _state(0, np.zeros_like(frozen))
+    assert mgr.restore_latest(dst) == 3
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 3.0))
+    np.testing.assert_array_equal(dst["m"]["frozen"], frozen)
+    assert dst["m"]["step"] == 3
+
+    # restore_at replays an intermediate segment exactly.
+    assert mgr.restore_at(2, dst) == 2
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 2.0))
+    np.testing.assert_array_equal(dst["m"]["frozen"], frozen)
+
+    with pytest.raises(ValueError, match="no committed snapshot"):
+        mgr.restore_at(99, dst)
+
+
+def test_journal_async_and_deleted_paths(tmp_path, journal_env):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    mgr.save(1, _state(1))
+    pending = mgr.save(2, _state(2), async_=True)
+    pending.wait()
+    # Step 3 drops the "extra" leaf: the delta must record the deletion and
+    # replay must not resurrect it.
+    mgr.save(3, _state(3, drop=True))
+    storage = url_to_storage_plugin(root)
+    try:
+        md = journal_mod.read_segment_metadata(storage, 3)
+        merged, _ = journal_mod.merged_metadata(storage, 3)
+    finally:
+        storage.sync_close()
+    assert any("extra" in p for p in md.journal["deleted"])
+    assert not any("extra" in p for p in merged.manifest)
+    # A fresh manager (no in-memory state) replays identically.
+    dst = _state(0)
+    assert SnapshotManager(root, journal=True).restore_latest(dst) == 3
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 3.0))
+
+
+def test_overlapping_async_saves_defer_compaction(tmp_path, journal_env):
+    """Compaction must not rewrite the chain while journal saves are in
+    flight: launch several async saves without waiting (each captures the
+    pre-fold chain), with the compaction trigger low enough to trip
+    mid-burst.  Every commit must stay replayable and the deferred fold
+    must land once the burst drains."""
+    root = str(tmp_path / "ckpts")
+    with knobs.override_journal_max_segments(2):
+        mgr = SnapshotManager(root, journal=True)
+        mgr.save(1, _state(1))
+        pendings = [
+            mgr.save(step, _state(step), async_=True) for step in (2, 3, 4)
+        ]
+        for p in pendings:
+            p.wait()
+        dst = _state(0)
+        assert mgr.restore_latest(dst) == 4
+        np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 4.0))
+        # The deferred compaction ran after the burst: the newest restore
+        # point is a full step (or a replayable segment if the fold raced
+        # the last wait) and nothing is orphaned.
+        assert mgr.orphan_segments() == []
+        assert mgr.orphan_chunks() == []
+
+
+def test_direct_restore_of_delta_segment_refuses(tmp_path, journal_env):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    with pytest.raises(RuntimeError, match="journal delta segment"):
+        Snapshot(f"{root}/seg_2").restore(_state(0))
+
+
+def test_compaction_folds_segments(tmp_path, journal_env):
+    frozen = np.arange(4096, dtype=np.float32)
+    root = str(tmp_path / "ckpts")
+    with knobs.override_journal_max_segments(3), knobs.override_metrics(True):
+        from torchsnapshot_tpu.telemetry import metrics
+
+        metrics.reset()
+        mgr = SnapshotManager(root, journal=True)
+        for step in range(1, 8):
+            mgr.save(step, _state(step, frozen))
+        # 1 is base; segments 2,3,4 trip the count knob -> folded into
+        # step_4; then 5,6,7 fold into step_7.
+        assert mgr.all_steps() == [1, 4, 7]
+        storage = url_to_storage_plugin(root)
+        try:
+            assert journal_mod.committed_segments(storage) == []
+        finally:
+            storage.sync_close()
+        # The folded step is pure metadata over CAS chunks and restores.
+        dst = _state(0, np.zeros_like(frozen))
+        assert mgr.restore_at(4, dst) == 4
+        np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 4.0))
+        np.testing.assert_array_equal(dst["m"]["frozen"], frozen)
+        # Every chunk on disk is accounted for after the folds.
+        referenced, orphan = mgr.chunk_classification()
+        storage = url_to_storage_plugin(root)
+        try:
+            present = cas_mod.list_chunk_relpaths(storage)
+        finally:
+            storage.sync_close()
+        assert sorted(referenced + orphan) == present
+        text = metrics.render_prometheus()
+        assert "tpusnap_journal_compactions_total 2" in text
+        assert "tpusnap_journal_segments_total 6" in text
+
+
+def test_crashed_compaction_rerun_and_stale_segment_gc(
+    tmp_path, journal_env
+):
+    """A compaction that committed its folded step but crashed before the
+    segment sweep leaves stale (subsumed) segments; recovery still lands
+    on the folded step, and gc sweeps the leftovers."""
+    root = str(tmp_path / "ckpts")
+    with knobs.override_journal_max_segments(100):
+        mgr = SnapshotManager(root, journal=True)
+        for step in (1, 2, 3):
+            mgr.save(step, _state(step))
+        # Simulate the crash point: fold manually (as _maybe_compact_journal
+        # would) by committing the merged manifest as step_3, but "crash"
+        # before removing seg_2/seg_3.
+        storage = url_to_storage_plugin(root)
+        try:
+            merged, _ = journal_mod.merged_metadata(storage, 3)
+            from torchsnapshot_tpu.io_types import WriteIO
+
+            storage.sync_write(
+                WriteIO(
+                    path="step_3/.snapshot_metadata",
+                    buf=merged.to_json().encode("utf-8"),
+                    durable=True,
+                )
+            )
+        finally:
+            storage.sync_close()
+    fresh = SnapshotManager(root, journal=True)
+    assert fresh.stale_segments() == [2, 3]
+    # The full step wins the tie at step 3 — even with its subsumed
+    # segment's replay chain BROKEN, recovery must go straight to step_3
+    # without a fallback.
+    (tmp_path / "ckpts" / "seg_2" / ".snapshot_metadata").write_text("{bad")
+    dst = _state(0)
+    with knobs.override_metrics(True):
+        from torchsnapshot_tpu.telemetry import metrics
+
+        metrics.reset()
+        assert fresh.restore_latest(dst) == 3
+        assert "tpusnap_journal_fallbacks_total" not in (
+            metrics.render_prometheus()
+        )
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 3.0))
+    _, _, removed_segs = fresh.gc_detail(apply=True)
+    assert removed_segs == [2, 3]
+    assert fresh.stale_segments() == []
+    # Sweeping the stale segments lost no restorability.
+    assert fresh.restore_latest(dst) == 3
+
+
+def test_replay_fallback_past_corrupt_segments(tmp_path, journal_env):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, _state(step))
+    # Newest segment corrupt -> fall back to seg_3.
+    (tmp_path / "ckpts" / "seg_4" / ".snapshot_metadata").write_text("{bad")
+    dst = _state(0)
+    assert mgr.restore_latest(dst) == 3
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 3.0))
+    # A broken CHAIN piece (seg_2) invalidates every later segment; the
+    # base remains the last good restore point.
+    (tmp_path / "ckpts" / "seg_2" / ".snapshot_metadata").write_text("{bad")
+    assert mgr.restore_latest(dst) == 1
+    np.testing.assert_array_equal(dst["m"]["hot"], np.full((128,), 1.0))
+    # restore_at of a chain-broken segment refuses instead of falling back.
+    with pytest.raises(journal_mod.JournalReplayError):
+        mgr.restore_at(3, dst)
+
+
+def test_digest_index_incremental_and_persisted(tmp_path, journal_env):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    for step in (1, 2):
+        mgr.save(step, _state(step))
+    sidecar = tmp_path / "ckpts" / cas_mod.INDEX_SIDECAR_FNAME
+    assert sidecar.exists()
+    doc = json.loads(sidecar.read_text())
+    assert doc["algo"] == "xxh64"
+    assert "step_1/.snapshot_metadata" in doc["committed"]
+    assert "seg_2/.snapshot_metadata" in doc["committed"]
+
+    # A fresh process trusts the validated sidecar — the O(steps) manifest
+    # re-seed never runs.
+    def _boom(*a, **k):  # pragma: no cover - must not be called
+        raise AssertionError("full re-seed ran despite a fresh sidecar")
+
+    import torchsnapshot_tpu.cas as cas_module
+
+    orig = cas_module.seed_digest_index
+    cas_module.seed_digest_index = _boom
+    try:
+        fresh = SnapshotManager(root, journal=True)
+        with knobs.override_cas(True):
+            idx = fresh._digest_index_for_save()
+        assert len(idx) > 0
+    finally:
+        cas_module.seed_digest_index = orig
+
+    # A stale sidecar (committed set changed behind its back) falls back
+    # to the full seed instead of trusting wrong keys.
+    doc["committed"] = []
+    sidecar.write_text(json.dumps(doc))
+    storage = url_to_storage_plugin(root)
+    try:
+        reseeded = cas_mod.load_or_seed_index(root, storage, "xxh64")
+    finally:
+        storage.sync_close()
+    assert len(reseeded) == len(idx)
+
+
+def test_indexless_gc_drops_stale_index_sidecar(tmp_path, journal_env):
+    """A gc-only process (no in-memory index) that sweeps orphan chunks
+    must DROP the persisted index sidecar: the committed-marker set it
+    validates against didn't change, so a later save would otherwise
+    trust it and dedup-hit the deleted chunk — committing an
+    unrestorable manifest."""
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    mgr.save(1, _state(1))
+    mgr.save(2, _state(2))
+    sidecar = tmp_path / "ckpts" / cas_mod.INDEX_SIDECAR_FNAME
+    assert sidecar.exists()
+    # Simulate a crashed take's leftover: an orphan chunk whose digest the
+    # persisted index (via a shared in-memory index at crash time) lists.
+    orphan_dir = tmp_path / "ckpts" / "cas" / "xxh64" / "de"
+    orphan_dir.mkdir(parents=True, exist_ok=True)
+    (orphan_dir / "deadbeefdeadbeef").write_bytes(b"orphan bytes")
+    doc = json.loads(sidecar.read_text())
+    doc["keys"].append("xxh64/deadbeefdeadbeef")
+    sidecar.write_text(json.dumps(doc))
+    # Fresh manager, gc only: never builds an index.
+    swept = SnapshotManager(root, journal=True).gc_detail(apply=True)[1]
+    assert "cas/xxh64/de/deadbeefdeadbeef" in swept
+    assert not sidecar.exists()
+
+
+def test_gc_inflight_guard(tmp_path, journal_env):
+    root = str(tmp_path / "ckpts")
+    mgr = SnapshotManager(root, journal=True)
+    mgr.save(1, _state(1))
+    # A committed save leaves no marker behind.
+    assert mgr.inflight_markers() == []
+    # Live-looking marker (this pid) over an uncommitted dir: refuse.
+    os.makedirs(f"{root}/seg_9")
+    marker = {
+        "step": 9,
+        "kind": "seg",
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "started": 0,
+    }
+    with open(f"{root}/.inflight_seg_9.json", "w") as f:
+        json.dump(marker, f)
+    with pytest.raises(RuntimeError, match="in-flight save marker"):
+        mgr.gc(apply=True)
+    assert os.path.exists(f"{root}/seg_9")  # nothing was removed
+    # Dry run never refuses.
+    _, _, segs = mgr.gc_detail(apply=False)
+    assert 9 in segs
+    # --force overrides and cleans both debris and marker.
+    mgr.gc(apply=True, force=True)
+    assert not os.path.exists(f"{root}/seg_9")
+    assert not os.path.exists(f"{root}/.inflight_seg_9.json")
+    # A dead-pid marker on this host is stale: gc proceeds without force.
+    os.makedirs(f"{root}/step_11")
+    marker.update(step=11, kind="step", pid=2**22 + 999983)
+    with open(f"{root}/.inflight_step_11.json", "w") as f:
+        json.dump(marker, f)
+    removed = mgr.gc(apply=True)
+    assert removed == [11]
+    assert not os.path.exists(f"{root}/.inflight_step_11.json")
+
+
+def test_journal_degrades_without_native_hash(tmp_path, monkeypatch):
+    from torchsnapshot_tpu import integrity
+
+    monkeypatch.setattr(integrity, "digest", lambda buf: None)
+    root = str(tmp_path / "ckpts")
+    with knobs.override_sidecar(False):
+        mgr = SnapshotManager(root, journal=True)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+        # No segments: every save fell back to a plain full snapshot.
+        assert mgr.all_steps() == [1, 2]
+        assert mgr.restore_points() == [(1, "full"), (2, "full")]
+        dst = _state(0)
+        assert mgr.restore_latest(dst) == 2
+
+
+def test_journal_sidecar_records_delta_bytes(tmp_path):
+    root = str(tmp_path / "ckpts")
+    with knobs.override_slab_size_threshold_bytes(64), knobs.override_retry_base_s(
+        0.001
+    ):
+        mgr = SnapshotManager(root, journal=True)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    from torchsnapshot_tpu.telemetry import sidecar
+
+    storage = url_to_storage_plugin(f"{root}/seg_2")
+    try:
+        docs = sidecar.read_all(storage)
+    finally:
+        storage.sync_close()
+    (doc,) = [d for d in docs if d.get("action") == "take"]
+    journal_extra = doc["journal"]
+    assert journal_extra["base_step"] == 1
+    assert journal_extra["entries_delta"] <= journal_extra["entries_total"]
+    assert journal_extra["delta_bytes"] > 0
+    # Logical-vs-physical: the CAS stats sit alongside.
+    assert "cas" in doc

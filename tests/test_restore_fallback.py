@@ -93,7 +93,7 @@ def test_all_snapshots_bad_raises(tmp_path):
     mgr = SnapshotManager(str(root))
     mgr.save(1, _state(1))
     (root / "step_1" / ".snapshot_metadata").write_bytes(b"{torn garbage")
-    with pytest.raises(RuntimeError, match="all 1 committed snapshots"):
+    with pytest.raises(RuntimeError, match="all 1 committed restore points"):
         mgr.restore_latest(_state(0))
 
 

@@ -145,6 +145,20 @@ def _cas_line(md) -> str:
     )
 
 
+def _journal_line(md) -> str:
+    """Delta-segment summary for a journal manifest (version 0.5.0)."""
+    info = md.journal
+    if info is None:
+        return ""
+    return (
+        f"journal:     delta segment over step_{info.get('base_step')} "
+        f"(+{len(info.get('prior_segments', []))} prior segment(s)); "
+        f"{info.get('entries_delta')} of {info.get('entries_total')} "
+        f"entries changed, {len(info.get('deleted', []))} deleted, "
+        f"{_human(info.get('delta_bytes') or 0)} logical delta"
+    )
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     from .manifest import ShardedArrayEntry
     from .snapshot import Snapshot
@@ -175,6 +189,9 @@ def cmd_info(args: argparse.Namespace) -> int:
     cas_line = _cas_line(md)
     if cas_line:
         print(cas_line)
+    journal_line = _journal_line(md)
+    if journal_line:
+        print(journal_line)
     return 0
 
 
@@ -233,23 +250,28 @@ def cmd_steps(args: argparse.Namespace) -> int:
     from .pg_wrapper import PGWrapper
 
     mgr = SnapshotManager(args.path, pg=PGWrapper())
-    steps = mgr.all_steps()
-    if not steps:
+    points = mgr.restore_points()
+    if not points:
         print("no committed steps")
         return 0
-    for step in steps:
-        print(f"step_{step}")
-    print(f"latest: {steps[-1]}")
+    for step, kind in points:
+        if kind == "full":
+            print(f"step_{step}")
+        else:
+            print(f"seg_{step} (journal delta)")
+    print(f"latest: {points[-1][0]}")
     return 0
 
 
 def cmd_gc(args: argparse.Namespace) -> int:
-    """List (default) or remove (``--apply``) uncommitted snapshot
-    directories under a SnapshotManager root: ``step_*`` dirs without a
-    ``.snapshot_metadata`` commit marker — what a crashed take leaves when
-    its cleanup never ran.  Dry run by default because an async save still
-    in flight is indistinguishable from a crashed one; apply only when no
-    save is running."""
+    """List (default) or remove (``--apply``) uncommitted snapshot/segment
+    directories under a SnapshotManager root: ``step_*``/``seg_*`` dirs
+    without a ``.snapshot_metadata`` commit marker — what a crashed take
+    leaves when its cleanup never ran — plus compaction-subsumed journal
+    segments and orphan CAS chunks.  Dry run by default; ``--apply``
+    additionally refuses while an advisory in-flight save marker looks
+    live (``--force`` overrides, for markers orphaned by a crash the
+    liveness heuristics can't classify)."""
     from .manager import SnapshotManager
     from .pg_wrapper import PGWrapper
     from .snapshot import SNAPSHOT_METADATA_FNAME
@@ -267,28 +289,50 @@ def cmd_gc(args: argparse.Namespace) -> int:
         storage.sync_close()
     mgr = SnapshotManager(args.path, pg=PGWrapper())
     if args.apply:
-        removed, removed_chunks = mgr.gc_detail(apply=True)
+        try:
+            removed, removed_chunks, removed_segs = mgr.gc_detail(
+                apply=True, force=args.force
+            )
+        except RuntimeError as e:
+            print(str(e))
+            return 3
         for step in removed:
             print(f"removed step_{step} (uncommitted)")
         print(f"{len(removed)} orphaned snapshot dir(s) removed")
+        for seg in removed_segs:
+            print(f"removed seg_{seg} (journal)")
+        if removed_segs:
+            print(f"{len(removed_segs)} journal segment(s) removed")
         for chunk in removed_chunks:
             print(f"removed orphan chunk {chunk}")
         if removed_chunks:
             print(f"{len(removed_chunks)} orphan CAS chunk(s) removed")
     else:
-        orphans, orphan_chunks = mgr.gc_detail(apply=False)
+        orphans, orphan_chunks, orphan_segs = mgr.gc_detail(apply=False)
         for step in orphans:
             print(f"orphan step_{step} (no {SNAPSHOT_METADATA_FNAME})")
         print(
             f"{len(orphans)} orphaned snapshot dir(s); re-run with --apply "
             "to remove (only when no save is in flight)"
         )
+        for seg in orphan_segs:
+            print(f"orphan/stale journal segment seg_{seg}")
+        if orphan_segs:
+            print(
+                f"{len(orphan_segs)} journal segment(s); --apply sweeps "
+                "them too"
+            )
         for chunk in orphan_chunks:
             print(f"orphan chunk {chunk} (referenced by no committed step)")
         if orphan_chunks:
             print(
                 f"{len(orphan_chunks)} orphan CAS chunk(s); --apply sweeps "
                 "them too"
+            )
+        for doc in mgr.inflight_markers():
+            print(
+                f"in-flight marker {doc['name']} "
+                f"(pid {doc.get('pid')} on {doc.get('host')})"
             )
     return 0
 
@@ -655,6 +699,12 @@ def main(argv=None) -> int:
         "--apply",
         action="store_true",
         help="remove the orphans (default: dry-run listing)",
+    )
+    p.add_argument(
+        "--force",
+        action="store_true",
+        help="override the in-flight save guard (only when certain no "
+        "save is running)",
     )
     p.set_defaults(fn=cmd_gc)
 

@@ -104,6 +104,16 @@ def _digest_key(algo: str, hexdigest: str) -> str:
     return f"{algo}/{hexdigest}"
 
 
+def key_for_relpath(relpath: str) -> Optional[str]:
+    """``"cas/<algo>/<p2>/<digest>"`` → the index key ``"<algo>/<digest>"``,
+    or None for paths outside the chunk layout — lets chunk sweeps keep the
+    digest index in lockstep with what is actually on disk."""
+    parts = relpath.split("/")
+    if len(parts) != 4 or parts[0] != CAS_DIR:
+        return None
+    return _digest_key(parts[1], parts[3])
+
+
 def parent_root_url(snapshot_url: str) -> Optional[str]:
     """URL of the directory containing a snapshot — where its ``cas/``
     store lives — or None when the path has no parent (a bare root such as
@@ -160,6 +170,17 @@ class DigestIndex:
         with self._lock:
             self._keys.add(key)
 
+    def discard(self, key: str) -> None:
+        """Forget a digest whose chunk was swept (prune/gc) — a later take
+        of the same bytes must re-probe/rewrite instead of dedup-hitting a
+        deleted chunk."""
+        with self._lock:
+            self._keys.discard(key)
+
+    def snapshot_keys(self) -> Set[str]:
+        with self._lock:
+            return set(self._keys)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._keys)
@@ -175,11 +196,12 @@ def seed_digest_index(
     dedup then falls back to per-chunk existence probes, never to
     incorrectness.  Pass ``storage`` to reuse an open root plugin.
 
-    Cost: one list + one small manifest read per committed step, paid on
-    each take's entry — bounded by retention (``max_to_keep``) in the
-    normal manager setup.  An unbounded many-step root on an object store
-    pays O(steps) GETs per save; maintaining the index incrementally
-    across a manager's lifetime is a noted follow-up (ROADMAP item 1)."""
+    Cost: one list + one small manifest read per committed step/segment,
+    paid on each take's entry — bounded by retention (``max_to_keep``) in
+    the normal manager setup.  ``SnapshotManager`` avoids even that by
+    maintaining one index incrementally across its lifetime and persisting
+    it as a validated sidecar (:func:`load_or_seed_index`); this full seed
+    is the fallback and the validation baseline."""
     from .manifest import SnapshotMetadata
     from .storage_plugin import url_to_storage_plugin
 
@@ -191,14 +213,8 @@ def seed_digest_index(
         except Exception:
             return DigestIndex()
     try:
-        try:
-            names = storage.sync_list_dir("")
-        except (NotImplementedError, FileNotFoundError):
-            return DigestIndex(keys)
-        for name in names:
-            if not name.startswith("step_"):
-                continue
-            read_io = ReadIO(path=f"{name}/.snapshot_metadata")
+        for marker in committed_marker_relpaths(storage):
+            read_io = ReadIO(path=marker)
             try:
                 storage.sync_read(read_io)
                 metadata = SnapshotMetadata.from_json(
@@ -215,6 +231,106 @@ def seed_digest_index(
         if own:
             storage.sync_close()
     return DigestIndex(keys)
+
+
+# ------------------------------------------------------- persisted index
+
+
+# Root-level sidecar caching the digest index between processes: one GET +
+# one LIST per take instead of one GET per committed step/segment.  Dot-
+# prefixed so it is protocol metadata, never a step dir or payload.
+INDEX_SIDECAR_FNAME = ".digest_index.json"
+_INDEX_SIDECAR_VERSION = 1
+
+
+def committed_marker_relpaths(storage: StoragePlugin) -> List[str]:
+    """Root-relative ``.snapshot_metadata`` paths of every committed step
+    AND journal segment under a manager root, sorted — the definition of
+    "what references chunks" shared by seeding, index validation, and the
+    manager's refcount scans."""
+    try:
+        names = storage.sync_list_dir("")
+    except (NotImplementedError, FileNotFoundError):
+        return []
+    out: List[str] = []
+    for name in sorted(names):
+        if not (name.startswith("step_") or name.startswith("seg_")):
+            continue
+        marker = f"{name}/.snapshot_metadata"
+        try:
+            if storage.sync_exists(marker):
+                out.append(marker)
+        except Exception:
+            continue
+    return out
+
+
+def persist_index_sidecar(
+    storage: StoragePlugin, index: DigestIndex, algo: str
+) -> None:
+    """Write the index sidecar recording the digest set AND the committed
+    marker set it was derived from (the load-time validation baseline).
+    Durable so a torn sidecar can't half-parse; callers treat any failure
+    as best-effort (the sidecar is a cache — the manifests stay the source
+    of truth)."""
+    import json
+
+    doc = {
+        "version": _INDEX_SIDECAR_VERSION,
+        "algo": algo,
+        "keys": sorted(index.snapshot_keys()),
+        "committed": committed_marker_relpaths(storage),
+    }
+    storage.sync_write(
+        WriteIO(
+            path=INDEX_SIDECAR_FNAME,
+            buf=json.dumps(doc).encode("utf-8"),
+            durable=True,
+        )
+    )
+
+
+def drop_index_sidecar(storage: StoragePlugin) -> None:
+    """Remove the persisted index (best-effort) — required after any
+    operation that rewrites manifests in place (``repack``), which changes
+    digests without changing the committed marker set the validation
+    compares."""
+    try:
+        storage.sync_delete(INDEX_SIDECAR_FNAME)
+    except Exception:
+        pass
+
+
+def load_or_seed_index(
+    root_url: str,
+    storage: StoragePlugin,
+    algo: str,
+) -> DigestIndex:
+    """The digest index for a root: the persisted sidecar when its recorded
+    committed-marker set still matches reality (O(1) reads), else a full
+    re-seed from the committed manifests.  A sidecar that is unreadable,
+    wrong-algo, or stale (markers added/removed since it was written —
+    another writer, a prune, a crashed take's commit) silently degrades to
+    the seed path: correctness never depends on the cache."""
+    import json
+
+    try:
+        read_io = ReadIO(path=INDEX_SIDECAR_FNAME)
+        storage.sync_read(read_io)
+        doc = json.loads(bytes(read_io.buf).decode("utf-8"))
+        if (
+            doc.get("version") == _INDEX_SIDECAR_VERSION
+            and doc.get("algo") == algo
+            and isinstance(doc.get("keys"), list)
+            and doc.get("committed") == committed_marker_relpaths(storage)
+        ):
+            return DigestIndex(set(doc["keys"]))
+        logger.debug(
+            "digest index sidecar stale/invalid for %s; re-seeding", root_url
+        )
+    except Exception:
+        pass
+    return seed_digest_index(root_url, storage=storage)
 
 
 # ---------------------------------------------------------- storage wrappers
@@ -592,10 +708,18 @@ def maybe_wrap_cas_writes(
     storage: StoragePlugin,
     path: str,
     storage_options: Optional[Dict[str, Any]] = None,
+    index: Optional[DigestIndex] = None,
 ) -> StoragePlugin:
     """Wrap a take's storage for content-addressed writes when the
     ``TPUSNAP_CAS`` knob is on and the snapshot has a parent directory to
-    host the shared store; otherwise return ``storage`` unchanged."""
+    host the shared store; otherwise return ``storage`` unchanged.
+
+    ``index``: a caller-maintained :class:`DigestIndex` (``SnapshotManager``
+    threads its incrementally-maintained one through every take, so the
+    per-take seeding cost disappears and the take's fresh digests land back
+    in the manager's index by reference).  Without it, the persisted root
+    sidecar is tried first (one read + one validation listing) and only a
+    stale/absent sidecar pays the full manifest re-seed."""
     from . import knobs
     from .storage_plugin import url_to_storage_plugin
 
@@ -611,9 +735,10 @@ def maybe_wrap_cas_writes(
         )
         return storage
     root = url_to_storage_plugin(root_url, storage_options)
-    # Seed through the writer's own root plugin: one plugin (one thread
-    # pool / session set) per take, not two.
-    index = seed_digest_index(root_url, storage_options, storage=root)
+    if index is None:
+        # Resolve through the writer's own root plugin: one plugin (one
+        # thread pool / session set) per take, not two.
+        index = load_or_seed_index(root_url, root, algo)
     logger.debug(
         "CAS writes enabled for %s (root %s, %d indexed digests)",
         path,
@@ -759,6 +884,21 @@ def repack_root(
             names = sorted(root.sync_list_dir(""))
         except (NotImplementedError, FileNotFoundError):
             names = []
+        segments = [
+            n
+            for n in names
+            if n.startswith("seg_")
+            and root.sync_exists(f"{n}/.snapshot_metadata")
+        ]
+        if segments:
+            # Repack only understands the step layout: exporting would
+            # sweep chunks the delta manifests still reference, and
+            # packing would leave the segments' cas:// chain dangling.
+            raise RuntimeError(
+                f"{root_url} has committed journal segments "
+                f"({', '.join(segments[:5])}...); compact or gc them "
+                "before repacking (journal roots are CAS-native)"
+            )
         steps = [
             n
             for n in names
@@ -795,6 +935,10 @@ def repack_root(
                 if relpath not in referenced:
                     root.sync_delete(relpath)
                     stats["chunks_swept"] += 1
+        # Repack rewrote manifests in place: the committed marker set the
+        # persisted index validates against is unchanged while the digests
+        # are not — the cache must not survive.
+        drop_index_sidecar(root)
     finally:
         root.sync_close()
     return stats

@@ -46,6 +46,9 @@ REGRESSION_FACTOR_ENV_VAR = _ENV_PREFIX + "REGRESSION_FACTOR"
 REGRESSION_WINDOW_ENV_VAR = _ENV_PREFIX + "REGRESSION_WINDOW"
 CAS_ENV_VAR = _ENV_PREFIX + "CAS"
 CAS_ALGO_ENV_VAR = _ENV_PREFIX + "CAS_ALGO"
+JOURNAL_ENV_VAR = _ENV_PREFIX + "JOURNAL"
+JOURNAL_MAX_SEGMENTS_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_SEGMENTS"
+JOURNAL_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_BYTES"
 
 # Digest algorithms the CAS layout supports.  One today; the layout
 # namespaces chunks by algorithm (cas/<algo>/...) so adding another is a
@@ -69,6 +72,13 @@ _DEFAULT_REGRESSION_WINDOW = 50
 # Matches PendingSnapshot's historical DEFAULT_BARRIER_TIMEOUT_S and the
 # KV stores' wait default.
 _DEFAULT_BARRIER_TIMEOUT_S = 1800.0
+# Journal compaction triggers (journal.py): fold base + segments into a
+# fresh full step once this many delta segments accumulated, or once their
+# summed logical delta bytes exceed the byte knob (0 = count-only).  8 keeps
+# worst-case replay short (restore reads base + ≤8 small delta manifests)
+# while amortizing the full-manifest commit over several steps.
+_DEFAULT_JOURNAL_MAX_SEGMENTS = 8
+_DEFAULT_JOURNAL_MAX_BYTES = 0
 # Payloads below this stay raw even with compression on: tiny leaves keep
 # their slab batching (compressed payloads can't pre-assign slab offsets —
 # their size is unknown at plan time) and skip per-chunk codec overhead
@@ -457,6 +467,54 @@ def override_cas(enabled: bool) -> Generator[None, None, None]:
 @contextmanager
 def override_cas_algo(value: Optional[str]) -> Generator[None, None, None]:
     with _override_env(CAS_ALGO_ENV_VAR, value):
+        yield
+
+
+def journal_enabled() -> bool:
+    """Whether ``SnapshotManager.save`` runs in delta-journal mode
+    (``journal.py``): each step appends a segment carrying only the entries
+    whose content changed since the last committed base, with a background
+    compactor folding segments into fresh full steps.  Off by default —
+    journal segments declare manifest version 0.5.0, which pre-journal
+    readers reject, and restoring them requires the journal-aware replay
+    path.  ``SnapshotManager(journal=...)`` overrides the env var."""
+    return _get_bool_env(JOURNAL_ENV_VAR)
+
+
+def get_journal_max_segments() -> int:
+    """Segment-count compaction trigger: once this many committed delta
+    segments accumulated since the base, the next committed save folds them
+    (plus the base) into a fresh full step.  Minimum 1."""
+    return max(
+        1,
+        _get_int_env(
+            JOURNAL_MAX_SEGMENTS_ENV_VAR, _DEFAULT_JOURNAL_MAX_SEGMENTS
+        ),
+    )
+
+
+def get_journal_max_bytes() -> int:
+    """Byte-volume compaction trigger: compact once the committed segments'
+    summed logical delta bytes exceed this.  0 (the default) disables the
+    byte trigger — the count trigger alone decides."""
+    return max(0, _get_int_env(JOURNAL_MAX_BYTES_ENV_VAR, _DEFAULT_JOURNAL_MAX_BYTES))
+
+
+@contextmanager
+def override_journal(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(JOURNAL_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_journal_max_segments(value: int) -> Generator[None, None, None]:
+    with _override_env(JOURNAL_MAX_SEGMENTS_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_journal_max_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(JOURNAL_MAX_BYTES_ENV_VAR, str(value)):
         yield
 
 

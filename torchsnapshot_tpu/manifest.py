@@ -493,10 +493,19 @@ FRAMED_MANIFEST_VERSION = "0.2.0"
 # cleanly via the from_json version validation below.  (0.3.0 was reserved
 # by an earlier roadmap draft of this feature and never shipped.)
 CAS_MANIFEST_VERSION = "0.4.0"
+# Journal delta segments (journal.py) declare 0.5.0: their manifest is a
+# DELTA — only the entries whose content changed since the chain recorded in
+# the ``journal`` metadata block — so a pre-journal reader that restored one
+# directly would silently produce partial state.  0.1–0.4 readers reject it
+# cleanly via the from_json version validation; journal-aware readers refuse
+# to restore a delta outside the replay path (Snapshot.restore guards on
+# ``metadata.journal``).
+JOURNAL_MANIFEST_VERSION = "0.5.0"
 SUPPORTED_MANIFEST_VERSIONS = (
     MANIFEST_VERSION,
     FRAMED_MANIFEST_VERSION,
     CAS_MANIFEST_VERSION,
+    JOURNAL_MANIFEST_VERSION,
 )
 
 
@@ -538,24 +547,34 @@ def manifest_version_for(manifest: "Manifest") -> str:
 
 @dataclass
 class SnapshotMetadata:
-    """Top-level snapshot metadata (reference manifest.py:425-475)."""
+    """Top-level snapshot metadata (reference manifest.py:425-475).
+
+    ``journal``: set only on journal delta segments (journal.py) — a dict
+    recording the replay chain (``base_step``, ``prior_segments``), the
+    paths ``deleted`` since the prior merged view, and delta size counters.
+    ``None`` (the default, and the only value full snapshots carry) means
+    the manifest is self-contained.
+    """
 
     version: str
     world_size: int
     manifest: Manifest = field(default_factory=dict)
+    journal: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "version": self.version,
-                "world_size": self.world_size,
-                "manifest": {
-                    path: _entry_to_dict(entry)
-                    for path, entry in self.manifest.items()
-                },
+        doc: Dict[str, Any] = {
+            "version": self.version,
+            "world_size": self.world_size,
+            "manifest": {
+                path: _entry_to_dict(entry)
+                for path, entry in self.manifest.items()
             },
-            sort_keys=True,
-        )
+        }
+        # Emitted only when set: full snapshots serialize byte-identically
+        # to the pre-journal format.
+        if self.journal is not None:
+            doc["journal"] = self.journal
+        return json.dumps(doc, sort_keys=True)
 
     @classmethod
     def from_json(cls, s: str) -> "SnapshotMetadata":
@@ -573,6 +592,7 @@ class SnapshotMetadata:
             manifest={
                 path: _entry_from_dict(ed) for path, ed in d["manifest"].items()
             },
+            journal=d.get("journal"),
         )
 
     # Back-compat aliases matching the reference API names

@@ -102,12 +102,23 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         incremental_from: Optional[str] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        manifest_transform: Optional[Any] = None,
+        cas_index: Optional[Any] = None,
     ) -> "Snapshot":
         """``incremental_from``: path of a committed base snapshot on the
         same backend — payloads whose bytes are unchanged are deduplicated
         instead of rewritten (hard links on fs, server-side copies on
         s3/gs; see incremental.py).  ``storage_options``: per-plugin
-        configuration overriding env vars (reference snapshot.py:697)."""
+        configuration overriding env vars (reference snapshot.py:697).
+
+        ``manifest_transform``: rank 0 only, applied to the gathered
+        ``SnapshotMetadata`` immediately before the commit write — the hook
+        journal mode (journal.py) uses to commit a delta manifest while
+        every other rank (and the returned handle) keeps the full view.
+        Must be pure computation; an exception fails the take.
+        ``cas_index``: a caller-maintained ``cas.DigestIndex`` threaded to
+        the CAS writer so per-take index seeding is skipped (the manager's
+        incrementally-maintained index)."""
         pg = pg or PGWrapper.from_jax()
         unique_id = _gen_unique_id(pg)
         tmetrics.maybe_install_bridge()
@@ -129,7 +140,7 @@ class Snapshot:
             from . import cas as cas_mod
 
             storage = cas_mod.maybe_wrap_cas_writes(
-                storage, path, storage_options
+                storage, path, storage_options, index=cas_index
             )
             if incremental_from is not None:
                 from .incremental import maybe_wrap_incremental
@@ -160,10 +171,15 @@ class Snapshot:
                         manifest=global_manifest,
                     )
                     # All ranks' payloads durable → rank 0 commits
-                    # (reference :202-209).
+                    # (reference :202-209).  The transform (journal delta
+                    # filtering) applies to exactly what is written; the
+                    # in-memory handle keeps the full view.
                     pg.barrier()
+                    committed_md = metadata
                     if pg.get_rank() == 0:
-                        cls._write_snapshot_metadata(metadata, storage)
+                        if manifest_transform is not None:
+                            committed_md = manifest_transform(metadata)
+                        cls._write_snapshot_metadata(committed_md, storage)
                     pg.barrier()
                 except BaseException:
                     # Crash consistency: a take that dies before the commit
@@ -186,6 +202,12 @@ class Snapshot:
                         # Logical-vs-physical bytes: what the save would
                         # have written without dedup vs what it did.
                         extra["cas"] = cas_stats
+                    if committed_md.journal is not None:
+                        from . import journal as journal_mod
+
+                        extra["journal"] = journal_mod.sidecar_summary(
+                            committed_md.journal
+                        )
                     tsidecar.write(
                         storage,
                         tsidecar.build(
@@ -226,6 +248,8 @@ class Snapshot:
         replicated: Optional[List[str]] = None,
         incremental_from: Optional[str] = None,
         storage_options: Optional[Dict[str, Any]] = None,
+        manifest_transform: Optional[Any] = None,
+        cas_index: Optional[Any] = None,
     ) -> "PendingSnapshot":
         """Returns once the app state is snapshot-stable; storage I/O and the
         metadata commit continue on a background thread (reference :229-317).
@@ -268,7 +292,7 @@ class Snapshot:
             from . import cas as cas_mod
 
             storage = cas_mod.maybe_wrap_cas_writes(
-                storage, path, storage_options
+                storage, path, storage_options, index=cas_index
             )
             if incremental_from is not None:
                 from .incremental import maybe_wrap_incremental
@@ -311,6 +335,7 @@ class Snapshot:
             trace_op=trace_op,
             phases_before=phases_before,
             monitor=health,
+            manifest_transform=manifest_transform,
         )
 
     @classmethod
@@ -521,6 +546,18 @@ class Snapshot:
             storage = url_to_storage_plugin(self.path, self._storage_options)
             try:
                 metadata = self._get_metadata(storage)
+                if metadata.journal is not None:
+                    # A delta segment alone is PARTIAL state — restoring it
+                    # directly would silently leave every unchanged entry
+                    # at its in-memory value.  The replay path
+                    # (SnapshotManager.restore_latest/restore_at) builds
+                    # the merged metadata and pre-sets it on the handle.
+                    raise RuntimeError(
+                        f"{self.path} is a journal delta segment (manifest "
+                        f"version {metadata.version}); restore it via "
+                        "SnapshotManager.restore_latest()/restore_at(), "
+                        "which replay the journal over its base snapshot"
+                    )
                 # Digest references (manifest 0.4.0) resolve against the
                 # root's cas/ store transparently; a no-op for per-step
                 # layouts.
@@ -1168,10 +1205,12 @@ class PendingSnapshot:
         trace_op: Optional[object] = None,
         phases_before: Optional[Dict[str, Dict[str, float]]] = None,
         monitor: Optional[tmonitor.OpMonitor] = None,
+        manifest_transform: Optional[Any] = None,
     ) -> None:
         self.path = path
         self.pg = pg
         self._storage_options = storage_options
+        self._manifest_transform = manifest_transform
         self._finalizer = finalizer
         self.stall_s = stall_s
         self._metadata: Optional[SnapshotMetadata] = None
@@ -1230,9 +1269,16 @@ class PendingSnapshot:
             barrier_timeout_s = knobs.get_barrier_timeout_s()
             if barrier is not None:
                 barrier.arrive(timeout_s=barrier_timeout_s)
+            committed_md = None
             if self.pg.get_rank() == 0:
+                # The handle keeps the FULL built metadata (restorable
+                # as-is via its cas:// references); the transform (journal
+                # delta filtering) shapes only what is committed to disk.
                 self._metadata = self._finalizer.build_global(self._storage)
-                Snapshot._write_snapshot_metadata(self._metadata, self._storage)
+                committed_md = self._metadata
+                if self._manifest_transform is not None:
+                    committed_md = self._manifest_transform(self._metadata)
+                Snapshot._write_snapshot_metadata(committed_md, self._storage)
                 self._finalizer.cleanup_sidecars(self._storage)
             if barrier is not None:
                 barrier.depart(timeout_s=barrier_timeout_s)
@@ -1252,6 +1298,15 @@ class PendingSnapshot:
                 cas_stats = cas_mod.writer_stats(self._storage)
                 if cas_stats is not None:
                     extra["cas"] = cas_stats
+                if (
+                    committed_md is not None
+                    and committed_md.journal is not None
+                ):
+                    from . import journal as journal_mod
+
+                    extra["journal"] = journal_mod.sidecar_summary(
+                        committed_md.journal
+                    )
                 tsidecar.write(
                     self._storage,
                     tsidecar.build(

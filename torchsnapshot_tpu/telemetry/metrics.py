@@ -385,6 +385,53 @@ def record_cas_dedup(hits: int, bytes_saved: int) -> None:
     ).inc(bytes_saved)
 
 
+def record_journal_segment(delta_entries: int, delta_bytes: int) -> None:
+    """One committed journal delta segment (journal.py): how many manifest
+    entries changed and their logical payload bytes — the per-step append
+    cost the journal mode exists to minimize."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_journal_segments_total",
+        "Journal delta segments committed",
+    ).inc()
+    counter(
+        "tpusnap_journal_delta_entries_total",
+        "Manifest entries carried by committed journal segments",
+    ).inc(max(0, int(delta_entries)))
+    counter(
+        "tpusnap_journal_appended_bytes_total",
+        "Logical payload bytes appended by committed journal segments",
+    ).inc(max(0, int(delta_bytes)))
+
+
+def record_journal_compaction(folded_segments: int) -> None:
+    """One background compaction: base + segments folded into a fresh full
+    step (pure metadata — every payload already lives in the CAS)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_journal_compactions_total",
+        "Journal compactions (segments folded into a full step)",
+    ).inc()
+    counter(
+        "tpusnap_journal_folded_segments_total",
+        "Journal segments removed by compactions",
+    ).inc(max(0, int(folded_segments)))
+
+
+def record_journal_fallback(reason: str) -> None:
+    """restore skipped a journal segment whose replay chain failed (missing
+    base, corrupt prior segment, bad delta) and fell back to an older
+    restore point."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_journal_fallbacks_total",
+        "Journal segments skipped by restore's replay fallback",
+    ).inc(reason=reason)
+
+
 def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
     """One framed payload's in/out byte counts; ratio derives at query
     time as uncompressed_total / compressed_total."""
@@ -431,6 +478,10 @@ DIRECT_METRIC_EVENTS = frozenset(
         "take.cleanup",  # record_gc("take_cleanup")
         "async_take.cleanup",  # record_gc("take_cleanup")
         "cas.dedup",  # record_cas_dedup
+        "gc.segment_removed",  # record_gc("segment_removed")
+        "journal.commit",  # record_journal_segment
+        "journal.compaction",  # record_journal_compaction
+        "journal.fallback",  # record_journal_fallback
     }
 )
 
