@@ -377,11 +377,14 @@ def test_cp_refuses_cas_snapshot(tmp_path):
 
 
 def test_cas_degrades_without_digest(tmp_path, monkeypatch):
-    """Without the native hash there are no digests: the writer degrades to
-    plain per-step writes and the snapshot stays a valid pre-CAS one."""
+    """Without ANY hash backend (native lib AND the xxhash fallback both
+    absent) there are no digests: the writer degrades to plain per-step
+    writes and the snapshot stays a valid pre-CAS one."""
+    from torchsnapshot_tpu import integrity
     from torchsnapshot_tpu.native_io import NativeFileIO
 
     monkeypatch.setattr(NativeFileIO, "maybe_create", classmethod(lambda cls: None))
+    monkeypatch.setattr(integrity, "_xxhash_mod", lambda: None)
     root = str(tmp_path / "ckpts")
     mgr = SnapshotManager(root)
     with knobs.override_cas(True), knobs.override_batching_disabled(True):

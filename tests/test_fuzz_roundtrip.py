@@ -49,6 +49,14 @@ def _random_state(rng: random.Random, depth: int = 0):
 
 
 @pytest.mark.parametrize(
+    "native_env",
+    # The pure-Python data plane (TPUSNAP_NATIVE=0) must round-trip byte-
+    # identically to the native one; the parity suite proves bytes match,
+    # this proves both planes restore every fuzzed shape.
+    ["1", "0"],
+    ids=["native", "pyfallback"],
+)
+@pytest.mark.parametrize(
     "compression_env",
     [
         None,
@@ -61,10 +69,11 @@ def _random_state(rng: random.Random, depth: int = 0):
     ids=["raw", "zstd", "zlib"],
 )
 @pytest.mark.parametrize("seed", range(5))
-def test_fuzz_roundtrip(tmp_path, seed, compression_env, monkeypatch):
+def test_fuzz_roundtrip(tmp_path, seed, compression_env, native_env, monkeypatch):
     if compression_env is not None:
         monkeypatch.setenv("TPUSNAP_COMPRESSION", compression_env)
         monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
+    monkeypatch.setenv("TPUSNAP_NATIVE", native_env)
     rng = random.Random(seed)
     state = {f"top{i}": _random_state(rng) for i in range(4)}
     app_state = {"s": StateDict(state)}

@@ -308,12 +308,21 @@ def test_take_restore_write_sidecars_matching_phase_stats(tmp_path):
     assert take_doc["rank"] == 0
     assert take_doc["bytes"] == 128 * 256 * 4
     assert take_doc["duration_s"] > 0
-    # Sidecar phases ARE a phase_stats delta: the storage write phase must
-    # account for at least the payload bytes, within rounding.
-    fs_write = take_doc["phases"].get("fs_write")
-    assert fs_write is not None
-    assert fs_write["bytes"] >= 128 * 256 * 4
-    assert 0 < fs_write["wall"] <= take_doc["duration_s"]
+    # Sidecar phases ARE a phase_stats delta: the storage write phases must
+    # account for at least the payload bytes, within rounding.  Payload
+    # writes land under native_write_hash (the fused write+hash call) when
+    # the native data plane is on, fs_write otherwise — the two together
+    # are the storage write story either way.
+    write_phases = [
+        take_doc["phases"][p]
+        for p in ("fs_write", "native_write_hash")
+        if p in take_doc["phases"]
+    ]
+    assert write_phases
+    assert sum(p["bytes"] for p in write_phases) >= 128 * 256 * 4
+    assert all(
+        0 < p["wall"] <= take_doc["duration_s"] for p in write_phases
+    )
     # Knob values captured for longitudinal diffs.
     assert take_doc["knobs"]["compression"] == "raw"
     assert take_doc["knobs"]["max_per_rank_io_concurrency"] == 16

@@ -277,3 +277,69 @@ def test_native_fused_read_hash_matches_oneshot(tmp_path):
             lo, hi = 8, n - 7
             ranged, rh = io.read_file(path, [lo, hi], want_hash=True)
             assert rh == io.xxhash64(data[lo:hi])
+
+
+def test_native_worker_pool_configured():
+    """The off-GIL worker pool exists and TPUSNAP_NATIVE_THREADS shaped it
+    before first use (0 = auto, clamped to [2, 16])."""
+    from torchsnapshot_tpu.native_io import NativeFileIO
+
+    io = NativeFileIO.maybe_create()
+    assert io is not None
+    if not io.has_pool:
+        import pytest
+
+        pytest.skip("pool symbols unavailable (stale library)")
+    io._lib.tpusnap_pool_size.restype = __import__("ctypes").c_int
+    size = io._lib.tpusnap_pool_size()
+    assert 2 <= size <= 16
+
+
+def test_native_zlib_encode_matches_python_zlib(tmp_path):
+    """The native deflate-into-frame must be byte-identical to
+    zlib.compress at the same level (both are compress2 with defaults) —
+    the byte-identity contract the codec offload rides on."""
+    import zlib
+
+    from torchsnapshot_tpu.native_io import NativeFileIO
+
+    io = NativeFileIO.maybe_create()
+    assert io is not None
+    if not io.has_zlib:
+        import pytest
+
+        pytest.skip("native built without zlib")
+    src = (b"compressible payload " * 65536)
+    for level in (1, 6):
+        dst = bytearray(len(src))
+        n = io.zlib_encode_into(src, memoryview(dst), level)
+        assert n is not None
+        assert bytes(dst[:n]) == zlib.compress(src, level)
+    # incompressible at cap len-1 -> None (caller stores raw)
+    import numpy as np
+
+    rnd = np.random.default_rng(0).integers(0, 256, 200_000, np.uint8).tobytes()
+    assert io.zlib_encode_into(rnd, memoryview(bytearray(len(rnd) - 1)), 1) is None
+
+
+def test_native_zlib_frames_decode_and_match_python_frames(monkeypatch):
+    """compression.encode produces identical frames with and without the
+    native zlib offload, and both decode back to the payload."""
+    import numpy as np
+
+    from torchsnapshot_tpu import compression
+    from torchsnapshot_tpu.native_io import NativeFileIO
+
+    io = NativeFileIO.maybe_create()
+    if io is None or not io.has_zlib:
+        import pytest
+
+        pytest.skip("native zlib unavailable")
+    payload = np.arange(1 << 19, dtype=np.float32).tobytes()  # 2 MiB, compressible
+    native_frame, native_codec = compression.encode(payload, "zlib", 1)
+    monkeypatch.setenv("TPUSNAP_NATIVE", "0")
+    py_frame, py_codec = compression.encode(payload, "zlib", 1)
+    monkeypatch.delenv("TPUSNAP_NATIVE")
+    assert native_codec == py_codec == "zlib"
+    assert bytes(native_frame) == bytes(py_frame)
+    assert bytes(compression.decode(native_frame, len(payload))) == payload

@@ -341,16 +341,16 @@ def cmd_verify(args: argparse.Namespace) -> int:
     """Audit every payload checksum without restoring: catches bit rot /
     truncation before a resume depends on the snapshot."""
     from . import integrity
-    from .native_io import NativeFileIO
     from .snapshot import Snapshot
     from .storage_plugin import url_to_storage_plugin
 
     # A no-op audit must not masquerade as a clean one: verification needs
-    # checksums enabled AND the native hash.
-    if not integrity.checksums_enabled() or NativeFileIO.maybe_create() is None:
+    # checksums enabled AND a hash backend (native library or the xxhash
+    # wheel — the pure-Python path verifies too).
+    if not integrity.checksums_enabled() or not integrity.hashing_available():
         print(
             "cannot verify: checksums disabled (TPUSNAP_CHECKSUM=0) or "
-            "native library unavailable"
+            "no hash backend available (native library and xxhash missing)"
         )
         return 2
 

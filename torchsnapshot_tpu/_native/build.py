@@ -1,8 +1,18 @@
 """Lazy build of the native library (g++ → libtpusnap.so).
 
 Built on first use and cached next to the source; rebuilt when the source is
-newer than the .so.  No pybind11 — the library exposes a C ABI consumed via
-ctypes.
+newer than the .so (the rebuild-staleness guard: a source edit must never be
+silently served by yesterday's binary).  When the rebuild cannot run — no
+compiler on the host image — a STALE library is still returned with a
+warning: the old entry points keep working and ``native_io`` probes each
+newer symbol individually, degrading feature-by-feature instead of losing
+the whole data plane.  No pybind11 — the library exposes a C ABI consumed
+via ctypes.
+
+zlib support (the native codec-encode offload) is probed at build time:
+the first compile attempt links ``-lz`` with ``-DTPUSNAP_WITH_ZLIB``; if
+that fails (no zlib dev files), the library builds without it and
+``tpusnap_has_zlib()`` reports 0.
 """
 
 from __future__ import annotations
@@ -20,29 +30,56 @@ _SRC = os.path.join(_HERE, "tpustore.cc")
 _LIB = os.path.join(_HERE, "libtpusnap.so")
 _LOCK = threading.Lock()
 
+_BASE_CMD = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+
+
+def _build() -> None:
+    """Compile _SRC → _LIB atomically; raises on failure."""
+    tmp = _LIB + ".tmp"
+    attempts = (
+        _BASE_CMD + ["-DTPUSNAP_WITH_ZLIB", _SRC, "-o", tmp, "-lz"],
+        _BASE_CMD + [_SRC, "-o", tmp],
+    )
+    last_error: Optional[Exception] = None
+    for cmd in attempts:
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+            return
+        except Exception as e:  # noqa: BLE001
+            last_error = e
+    raise RuntimeError(f"native build failed: {last_error}")
+
+
+def lib_is_stale() -> bool:
+    """Whether ``tpustore.cc`` is newer than the built ``libtpusnap.so``
+    (or the library is missing entirely)."""
+    try:
+        return os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    except OSError:
+        return True
+
 
 def get_native_lib_path() -> Optional[str]:
-    """Path to the built library, building if needed; None if unavailable."""
+    """Path to the built library, rebuilding when the source is newer;
+    None only when nothing loadable exists.  A stale library that cannot
+    be rebuilt is returned with a warning — callers (native_io) probe the
+    symbols they need and degrade per-feature."""
     with _LOCK:
+        have_lib = os.path.exists(_LIB)
+        if have_lib and not lib_is_stale():
+            return _LIB
         try:
-            if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(
-                _SRC
-            ):
-                return _LIB
-            cmd = [
-                "g++",
-                "-O2",
-                "-std=c++17",
-                "-shared",
-                "-fPIC",
-                "-pthread",
-                _SRC,
-                "-o",
-                _LIB + ".tmp",
-            ]
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(_LIB + ".tmp", _LIB)
+            _build()
             return _LIB
         except Exception as e:  # noqa: BLE001
+            if have_lib:
+                logger.warning(
+                    "tpustore.cc is newer than libtpusnap.so and the rebuild "
+                    "failed (%s); using the stale library — newer native "
+                    "fast paths may be unavailable",
+                    e,
+                )
+                return _LIB
             logger.warning("Native library unavailable (%s); using fallbacks", e)
             return None

@@ -27,12 +27,16 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <fcntl.h>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <atomic>
+#include <new>
+#include <pthread.h>
 #include <string>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -40,6 +44,10 @@
 #include <thread>
 #include <unistd.h>
 #include <vector>
+
+#ifdef TPUSNAP_WITH_ZLIB
+#include <zlib.h>
+#endif
 
 namespace {
 
@@ -568,6 +576,219 @@ uint64_t tpusnap_xxhash64(const void* data, int64_t len, uint64_t seed) {
   return xx_finalize(&s, seed, p + consumed, len - consumed, len);
 }
 
+}  // extern "C"
+
+namespace {
+
+// ------------------------------------------------------- worker pool
+// Off-GIL data plane: a process-wide pool of C++ threads executing the
+// stripe/part tasks of the fused write+hash, striped hash, and multi-range
+// read calls.  The calling (Python) thread has already dropped the GIL via
+// ctypes, so it participates in draining the task set — progress is
+// guaranteed even when every pool worker is busy with another call's tasks,
+// and a pool of size 0 simply degrades to inline execution.
+
+struct WorkPool {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::function<void()>> q;
+  std::vector<std::thread> threads;
+  bool stopping = false;
+
+  explicit WorkPool(int n) {
+    for (int i = 0; i < n; ++i) {
+      threads.emplace_back([this] { worker(); });
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      std::function<void()> task;
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv.wait(lock, [&] { return stopping || !q.empty(); });
+        if (stopping && q.empty()) return;
+        task = std::move(q.front());
+        q.pop_front();
+      }
+      task();
+    }
+  }
+
+  void submit(std::function<void()> task) {
+    {
+      std::lock_guard<std::mutex> lock(mu);
+      q.push_back(std::move(task));
+    }
+    cv.notify_one();
+  }
+};
+
+std::mutex g_pool_mu;
+WorkPool* g_pool = nullptr;
+int g_pool_threads_requested = 0;  // 0 = auto, set before first use
+
+// Fork safety: a fork()ed child (multiprocessing ranks in tests, jax
+// multi-process launchers) inherits g_pool but NOT its threads — a submit
+// in the child would enqueue work nobody ever runs and a TaskSet would
+// wait forever for helpers that never start.  The atfork child handler
+// drops the inherited pool (leaking its memory — a fork costs one empty
+// struct) and re-initializes the guarding mutex, which may have been held
+// mid-fork by another parent thread; the child then lazily builds a fresh
+// pool on first use.
+struct PoolForkGuard {
+  PoolForkGuard() {
+    ::pthread_atfork(nullptr, nullptr, [] {
+      new (&g_pool_mu) std::mutex();
+      g_pool = nullptr;
+    });
+  }
+};
+PoolForkGuard g_pool_fork_guard;
+
+int pool_auto_threads() {
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 4;
+  int n = static_cast<int>(hw);
+  if (n > 16) n = 16;
+  if (n < 2) n = 2;
+  return n;
+}
+
+WorkPool* get_pool() {
+  std::lock_guard<std::mutex> lock(g_pool_mu);
+  if (g_pool == nullptr) {
+    int n = g_pool_threads_requested;
+    if (n <= 0) n = pool_auto_threads();
+    g_pool = new WorkPool(n);  // lives for the process (never churned)
+  }
+  return g_pool;
+}
+
+// A set of independent tasks drained cooperatively by pool workers and the
+// calling thread (atomic work-stealing index).  Two usage shapes:
+//   run_all()            — helpers + caller drain together, returns when
+//                          every task finished;
+//   launch(); <caller does other work>; finish()
+//                        — helpers start immediately, the caller overlaps
+//                          its own work (the sequential file write of the
+//                          fused write+hash), then joins the drain.
+struct TaskSet {
+  std::vector<std::function<void()>> tasks;
+  std::atomic<size_t> next{0};
+  std::mutex done_mu;
+  std::condition_variable done_cv;
+  size_t done_count = 0;
+  std::atomic<int> helpers_live{0};
+
+  void drain() {
+    for (;;) {
+      size_t i = next.fetch_add(1);
+      if (i >= tasks.size()) return;
+      tasks[i]();
+      std::lock_guard<std::mutex> lock(done_mu);
+      if (++done_count == tasks.size()) done_cv.notify_all();
+    }
+  }
+
+  void launch() {
+    if (tasks.empty()) return;
+    WorkPool* pool = get_pool();
+    size_t helpers = tasks.size();
+    if (helpers > pool->threads.size()) helpers = pool->threads.size();
+    // Helpers only touch the TaskSet's counters; finish() does not return
+    // until every helper exited its drain(), so the (stack-allocated) set
+    // strictly outlives them.  The exit handshake is cv-based, never a
+    // spin: under concurrent calls a queued helper can sit behind OTHER
+    // calls' tasks for milliseconds before it even starts, and a yield
+    // spin across 16 waiting callers measurably burned CPU-seconds.
+    for (size_t h = 0; h < helpers; ++h) {
+      helpers_live.fetch_add(1);
+      pool->submit([this] {
+        drain();
+        // Notify UNDER the lock: with it released, a sibling helper's
+        // decrement could satisfy finish()'s predicate and let the caller
+        // destroy this stack-allocated set while our notify_all is still
+        // pending on the freed condition_variable.
+        std::lock_guard<std::mutex> lock(done_mu);
+        helpers_live.fetch_sub(1);
+        done_cv.notify_all();
+      });
+    }
+  }
+
+  void finish() {
+    if (tasks.empty()) return;
+    drain();  // help with whatever the pool hasn't claimed yet
+    std::unique_lock<std::mutex> lock(done_mu);
+    done_cv.wait(lock, [&] {
+      return done_count == tasks.size() && helpers_live.load() == 0;
+    });
+  }
+
+  void run_all() {
+    if (tasks.empty()) return;
+    if (tasks.size() == 1) {
+      tasks[0]();
+      return;
+    }
+    launch();
+    finish();
+  }
+};
+
+int pwrite_full(int fd, const void* buf, int64_t n, int64_t offset) {
+  const char* p = static_cast<const char*>(buf);
+  int64_t put = 0;
+  while (put < n) {
+    ssize_t r = ::pwrite(fd, p + put, static_cast<size_t>(n - put),
+                         offset + put);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    put += r;
+  }
+  return 0;
+}
+
+int pread_full(int fd, void* buf, int64_t n, int64_t offset) {
+  char* p = static_cast<char*>(buf);
+  int64_t got = 0;
+  while (got < n) {
+    ssize_t r = ::pread(fd, p + got, static_cast<size_t>(n - got),
+                        offset + got);
+    if (r == 0) return -EIO;  // short file: the range must exist in full
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    got += r;
+  }
+  return 0;
+}
+
+// Combine per-stripe xxh64 digests into the striped ("xxh64s") digest:
+// xxh64 over the little-endian u64 digest stream, same seed.  The Python
+// fallback (integrity.py) implements the identical combination — the two
+// must never diverge, they name chunks and fill manifests.
+uint64_t combine_stripe_digests(const std::vector<uint64_t>& digests,
+                                uint64_t seed) {
+  std::vector<uint8_t> packed(digests.size() * 8);
+  for (size_t i = 0; i < digests.size(); ++i) {
+    uint64_t d = digests[i];
+    for (int b = 0; b < 8; ++b) {
+      packed[i * 8 + b] = static_cast<uint8_t>((d >> (8 * b)) & 0xff);
+    }
+  }
+  return tpusnap_xxhash64(packed.data(),
+                          static_cast<int64_t>(packed.size()), seed);
+}
+
+}  // namespace
+
+extern "C" {
+
 // Fused ranged read + xxh64: each block is hashed right after its pread,
 // while it is still cache-resident — the restore path pays one memory pass
 // for read+verify instead of two (a full extra traversal of the checkpoint
@@ -611,6 +832,265 @@ int tpusnap_read_range_hash(const char* path, void* buf, int64_t offset,
   ::close(fd);
   *out_hash = xx_finalize(&s, seed, base + hashed, nbytes - hashed, nbytes);
   return 0;
+}
+
+// --------------------------------------------------- off-GIL data plane
+
+// ABI generation of the data-plane entry points, mirrored by
+// native_io.NATIVE_ABI_VERSION.  Bump BOTH whenever any existing entry
+// point's observable behavior changes (hash semantics, stripe
+// combination, return conventions): a stale .so that still exports every
+// symbol must be detectable, or it would silently fill manifests with
+// divergent digests on hosts that cannot rebuild.
+int tpusnap_abi_version() { return 1; }
+
+// Sizes the worker pool BEFORE its lazy creation (TPUSNAP_NATIVE_THREADS);
+// once threads exist the request is ignored — pools are per-process, not
+// churned.  n <= 0 selects auto (min(16, hardware_concurrency)).
+void tpusnap_pool_configure(int n) {
+  std::lock_guard<std::mutex> lock(g_pool_mu);
+  if (g_pool == nullptr) g_pool_threads_requested = n;
+}
+
+int tpusnap_pool_size() { return static_cast<int>(get_pool()->threads.size()); }
+
+// Striped xxh64 ("xxh64s"): independent xxh64 per stripe_bytes window,
+// computed in parallel on the pool, combined via xxh64 over the
+// little-endian digest stream.  NOT equal to plain xxh64 of the buffer —
+// the manifest records which algorithm a digest used ("xxh64s:" tag), and
+// integrity.py's pure-Python fallback computes the identical value.
+uint64_t tpusnap_xxhash64_striped(const void* data, int64_t len,
+                                  uint64_t seed, int64_t stripe_bytes) {
+  if (stripe_bytes <= 0 || len <= stripe_bytes) {
+    return tpusnap_xxhash64(data, len, seed);
+  }
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  int64_t n = (len + stripe_bytes - 1) / stripe_bytes;
+  std::vector<uint64_t> digests(static_cast<size_t>(n));
+  TaskSet ts;
+  ts.tasks.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t off = i * stripe_bytes;
+    int64_t sz = len - off < stripe_bytes ? len - off : stripe_bytes;
+    ts.tasks.emplace_back([p, off, sz, seed, i, &digests] {
+      digests[static_cast<size_t>(i)] = tpusnap_xxhash64(p + off, sz, seed);
+    });
+  }
+  ts.run_all();
+  return combine_stripe_digests(digests, seed);
+}
+
+// Fused write + per-part hash: the member buffers of a slab (or a single
+// whole payload, n == 1) land sequentially in one file while each part's
+// digest is computed concurrently on the pool — serialize / checksum /
+// write stop being separate Python passes over the payload.  Parts at or
+// above striped_min_bytes hash stripewise (out digest = xxh64s); smaller
+// parts hash plain.  Division of labor measured, not guessed: hashing is
+// embarrassingly parallel (128 MB stripes across the pool in ~5 ms) while
+// concurrent pwrites to ONE file serialize on the inode lock and burn
+// ~10x the CPU of a sequential writer for the same wall — so the pool
+// hashes while THIS thread writes the parts in order, and the call
+// returns when both are done (wall = max(write, hash) ≈ the write).
+// Returns 0 or -errno; out_hashes[i] = part i's digest (callers map
+// size >= striped_min_bytes to the "xxh64s" tag, below to "xxh64").
+int tpusnap_write_parts_hash(const char* path, const void** bufs,
+                             const int64_t* sizes, int n, uint64_t seed,
+                             int64_t stripe_bytes, int64_t striped_min_bytes,
+                             uint64_t* out_hashes) {
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -errno;
+  // Per-part stripe digest storage for striped parts (index aligned).
+  std::vector<std::vector<uint64_t>> stripes(static_cast<size_t>(n));
+  TaskSet ts;
+  for (int i = 0; i < n; ++i) {
+    const uint8_t* buf = static_cast<const uint8_t*>(bufs[i]);
+    int64_t sz = sizes[i];
+    bool striped = striped_min_bytes > 0 && stripe_bytes > 0 &&
+                   sz >= striped_min_bytes && sz > stripe_bytes;
+    if (!striped) {
+      ts.tasks.emplace_back(
+          [=] { out_hashes[i] = tpusnap_xxhash64(buf, sz, seed); });
+      continue;
+    }
+    int64_t n_stripes = (sz + stripe_bytes - 1) / stripe_bytes;
+    stripes[static_cast<size_t>(i)].resize(static_cast<size_t>(n_stripes));
+    std::vector<uint64_t>* out = &stripes[static_cast<size_t>(i)];
+    for (int64_t j = 0; j < n_stripes; ++j) {
+      int64_t s_off = j * stripe_bytes;
+      int64_t s_sz = sz - s_off < stripe_bytes ? sz - s_off : stripe_bytes;
+      ts.tasks.emplace_back([=] {
+        (*out)[static_cast<size_t>(j)] =
+            tpusnap_xxhash64(buf + s_off, s_sz, seed);
+      });
+    }
+  }
+  // Hashers start on the pool; this thread writes sequentially meanwhile.
+  ts.launch();
+  int write_err = 0;
+  int64_t file_off = 0;
+  for (int i = 0; i < n && write_err == 0; ++i) {
+    if (sizes[i]) {
+      write_err = pwrite_full(fd, bufs[i], sizes[i], file_off);
+    }
+    file_off += sizes[i];
+  }
+  ts.finish();  // digests all landed (must complete even on write error)
+  if (write_err != 0) {
+    ::close(fd);
+    return write_err;
+  }
+  for (int i = 0; i < n; ++i) {
+    if (!stripes[static_cast<size_t>(i)].empty()) {
+      out_hashes[i] =
+          combine_stripe_digests(stripes[static_cast<size_t>(i)], seed);
+    }
+  }
+  if (::close(fd) < 0) return -errno;
+  return 0;
+}
+
+// Parallel multi-range read with optional fused per-range hashing: the
+// restore/audit fan-out that replaces the per-range Python loop.  Each
+// range lands in its own destination buffer; with want_hash, each range's
+// digest is computed fused with its reads (striped ranges hash per stripe
+// in parallel — the xxh64s path that lets CHECKSUMMED large reads use
+// parallelism; plain xxh64 is order-dependent, so sub-striped-min ranges
+// hash sequentially within the range while ranges still parallelize
+// against each other).  Returns 0 or -errno (first failure wins; a short
+// range is -EIO).
+int tpusnap_read_ranges_hash(const char* path, int n, const int64_t* offsets,
+                             const int64_t* lengths, void** bufs,
+                             int want_hash, uint64_t seed,
+                             int64_t stripe_bytes, int64_t striped_min_bytes,
+                             uint64_t* out_hashes) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  std::atomic<int> first_err{0};
+  std::vector<std::vector<uint64_t>> stripes(static_cast<size_t>(n));
+  const int64_t CHUNK = 8 << 20;  // unhashed split granularity
+  TaskSet ts;
+  for (int i = 0; i < n; ++i) {
+    uint8_t* dst = static_cast<uint8_t*>(bufs[i]);
+    int64_t off = offsets[i];
+    int64_t len = lengths[i];
+    if (len <= 0) {
+      if (want_hash && out_hashes != nullptr) {
+        out_hashes[i] = tpusnap_xxhash64(dst, 0, seed);
+      }
+      continue;
+    }
+    if (!want_hash) {
+      // Split big ranges for intra-file parallelism; no digests.
+      for (int64_t c_off = 0; c_off < len; c_off += CHUNK) {
+        int64_t c_sz = len - c_off < CHUNK ? len - c_off : CHUNK;
+        ts.tasks.emplace_back([=, &first_err] {
+          if (first_err.load() != 0) return;
+          int rc = pread_full(fd, dst + c_off, c_sz, off + c_off);
+          if (rc != 0) {
+            int expected = 0;
+            first_err.compare_exchange_strong(expected, rc);
+          }
+        });
+      }
+      continue;
+    }
+    bool striped = striped_min_bytes > 0 && stripe_bytes > 0 &&
+                   len >= striped_min_bytes && len > stripe_bytes;
+    if (!striped) {
+      // One task: sequential fused pread+hash over the range (the plain
+      // xxh64 stream cannot split); ranges still overlap each other.
+      ts.tasks.emplace_back([=, &first_err] {
+        if (first_err.load() != 0) return;
+        XXState s;
+        xx_init(&s, seed);
+        int64_t got = 0, hashed = 0;
+        while (got < len) {
+          int64_t want = len - got < CHUNK ? len - got : CHUNK;
+          int rc = pread_full(fd, dst + got, want, off + got);
+          if (rc != 0) {
+            int expected = 0;
+            first_err.compare_exchange_strong(expected, rc);
+            return;
+          }
+          got += want;
+          int64_t avail = (got - hashed) / 32;
+          xx_stripes(&s, dst + hashed, avail);
+          hashed += avail * 32;
+        }
+        out_hashes[i] =
+            xx_finalize(&s, seed, dst + hashed, len - hashed, len);
+      });
+      continue;
+    }
+    int64_t n_stripes = (len + stripe_bytes - 1) / stripe_bytes;
+    stripes[static_cast<size_t>(i)].resize(static_cast<size_t>(n_stripes));
+    std::vector<uint64_t>* out = &stripes[static_cast<size_t>(i)];
+    for (int64_t j = 0; j < n_stripes; ++j) {
+      int64_t s_off = j * stripe_bytes;
+      int64_t s_sz = len - s_off < stripe_bytes ? len - s_off : stripe_bytes;
+      ts.tasks.emplace_back([=, &first_err] {
+        if (first_err.load() != 0) return;
+        int rc = pread_full(fd, dst + s_off, s_sz, off + s_off);
+        if (rc != 0) {
+          int expected = 0;
+          first_err.compare_exchange_strong(expected, rc);
+          return;
+        }
+        (*out)[static_cast<size_t>(j)] =
+            tpusnap_xxhash64(dst + s_off, s_sz, seed);
+      });
+    }
+  }
+  ts.run_all();
+  ::close(fd);
+  if (first_err.load() != 0) return first_err.load();
+  if (want_hash && out_hashes != nullptr) {
+    for (int i = 0; i < n; ++i) {
+      if (!stripes[static_cast<size_t>(i)].empty()) {
+        out_hashes[i] =
+            combine_stripe_digests(stripes[static_cast<size_t>(i)], seed);
+      }
+    }
+  }
+  return 0;
+}
+
+// ------------------------------------------------------------ zlib encode
+// Native deflate directly into a caller-provided buffer (the compression
+// frame's payload region) — skips the Python-side copy of the compressed
+// bytes into the frame.  Compiled only when zlib headers are present
+// (build.py probes); byte-identical to Python's zlib.compress(data, level)
+// (both are compress2 with default windowBits/memLevel/strategy).
+
+int tpusnap_has_zlib() {
+#ifdef TPUSNAP_WITH_ZLIB
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// Returns the encoded size, -1 when the output does not fit dst_cap (the
+// incompressible case callers turn into a raw frame), -2 on any other
+// zlib error.
+int64_t tpusnap_zlib_encode(const void* src, int64_t src_len, void* dst,
+                            int64_t dst_cap, int level) {
+#ifdef TPUSNAP_WITH_ZLIB
+  uLongf dlen = static_cast<uLongf>(dst_cap);
+  int rc = compress2(static_cast<Bytef*>(dst), &dlen,
+                     static_cast<const Bytef*>(src),
+                     static_cast<uLong>(src_len), level);
+  if (rc == Z_BUF_ERROR) return -1;
+  if (rc != Z_OK) return -2;
+  return static_cast<int64_t>(dlen);
+#else
+  (void)src;
+  (void)src_len;
+  (void)dst;
+  (void)dst_cap;
+  (void)level;
+  return -2;
+#endif
 }
 
 }  // extern "C"

@@ -188,6 +188,12 @@ class BatchedBufferStager(BufferStager):
         self._members = members
         self._total = total
         self._scatter_ok = scatter_ok
+        # Member digest sinks, aligned with the ScatterBuffer parts (member
+        # order IS parts order): the scheduler resolves them at write time,
+        # fused into ONE native write+hash call for the whole slab on the
+        # scatter path.  None when members resolved during staging (the
+        # join path) or recording is off.
+        self.hash_sinks: Optional[list] = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         async def _stage_one(stager: BufferStager, nbytes: int) -> memoryview:
@@ -202,9 +208,23 @@ class BatchedBufferStager(BufferStager):
         views = await asyncio.gather(
             *(_stage_one(s, n) for s, _, n in self._members)
         )
+        member_sinks = [
+            getattr(s, "hash_sinks", None) for s, _, _ in self._members
+        ]
         scatter = ScatterBuffer(views)
         if self._scatter_ok:
+            if all(sinks and len(sinks) == 1 for sinks in member_sinks):
+                # One sink per member, parts-aligned: the whole slab's
+                # digests come back from the fused write.
+                self.hash_sinks = [sinks[0] for sinks in member_sinks]
+            else:
+                # Checksum recording off (no member deferred) — or an
+                # unexpected mix; resolve whatever exists now.
+                await self._resolve_member_sinks(member_sinks, views, executor)
             return scatter
+        # Join path (backend can't scatter, so it can't fuse either):
+        # resolve member digests from the views before the pack memcpy.
+        await self._resolve_member_sinks(member_sinks, views, executor)
         # The destination would join() scatter parts at write time; do it
         # HERE, during staging, where the slab-sized allocation is covered
         # by the declared staging cost (parts + total) and the scheduler
@@ -217,6 +237,26 @@ class BatchedBufferStager(BufferStager):
                 executor, scatter.join
             )
         return scatter.join()
+
+    @staticmethod
+    async def _resolve_member_sinks(member_sinks, views, executor) -> None:
+        from . import integrity
+
+        async def _one(sinks, view) -> None:
+            digest = await integrity.compute_on(view, executor)
+            for sink in sinks:
+                sink(digest)
+
+        # Concurrent, like the member staging itself: the hashers release
+        # the GIL, so an 8-member slab hashes across the executor instead
+        # of one member at a time.
+        await asyncio.gather(
+            *(
+                _one(sinks, view)
+                for sinks, view in zip(member_sinks, views)
+                if sinks
+            )
+        )
 
     def get_staging_cost_bytes(self) -> int:
         cost = sum(s.get_staging_cost_bytes() for s, _, _ in self._members)

@@ -345,6 +345,7 @@ async def _read_via_root(root: StoragePlugin, read_io: ReadIO) -> None:
         byte_range=read_io.byte_range,
         into=read_io.into,
         want_hash=read_io.want_hash,
+        hash_algo=getattr(read_io, "hash_algo", None),
     )
     await root.read(sub)
     read_io.buf = sub.buf
@@ -535,19 +536,23 @@ class CASWriterPlugin(StoragePlugin):
             )
             await self._inner.write(write_io)
             return
-        _, _, hexdigest = digest.partition(":")
-        key = _digest_key(self._algo, hexdigest)
-        relpath = chunk_relpath(self._algo, hexdigest)
+        # The digest tag names the algorithm ("xxh64" small chunks,
+        # "xxh64s" striped large ones) — the chunk's CAS namespace must
+        # match its content's actual algo, not the configured default, or
+        # the name↔content invariant (_verify_chunk) breaks.
+        algo, _, hexdigest = digest.partition(":")
+        key = _digest_key(algo, hexdigest)
+        relpath = chunk_relpath(algo, hexdigest)
         nbytes = memoryview(buf).nbytes
 
         if key in self._index:
             # Referenced by a committed manifest (or written earlier this
             # take): the chunk is durable and immutable — pure dedup.
-            self._record_hit(write_io.path, hexdigest, nbytes)
+            self._record_hit(write_io.path, algo, hexdigest, nbytes)
             return
         if await self._probe_existing(relpath, digest, executor):
             self._index.add(key)
-            self._record_hit(write_io.path, hexdigest, nbytes)
+            self._record_hit(write_io.path, algo, hexdigest, nbytes)
             return
         try:
             # durable=True: tmp+fsync+rename on fs — a chunk is only ever
@@ -575,7 +580,7 @@ class CASWriterPlugin(StoragePlugin):
             self.chunks_written += 1
             self.bytes_written += nbytes
             self.relocations[write_io.path] = location_for(
-                self._algo, hexdigest
+                algo, hexdigest
             )
 
     async def _delete_if_mismatched(
@@ -618,11 +623,11 @@ class CASWriterPlugin(StoragePlugin):
             return False
         return True
 
-    def _record_hit(self, path: str, hexdigest: str, nbytes: int) -> None:
+    def _record_hit(self, path: str, algo: str, hexdigest: str, nbytes: int) -> None:
         with self._lock:
             self.dedup_hits += 1
             self.bytes_saved += nbytes
-            self.relocations[path] = location_for(self._algo, hexdigest)
+            self.relocations[path] = location_for(algo, hexdigest)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -974,7 +979,9 @@ def _repack_step_to_cas(
                 "repack requires the native xxh64 library (content "
                 "addressing is impossible without digests)"
             )
-        hexdigest = digest.partition(":")[2]
+        # Chunk algo from the digest tag ("xxh64s" for striped large
+        # payloads), matching the write path's naming.
+        algo, _, hexdigest = digest.partition(":")
         key = _digest_key(algo, hexdigest)
         relpath = chunk_relpath(algo, hexdigest)
         nbytes = memoryview(read_io.buf).nbytes

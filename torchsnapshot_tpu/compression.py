@@ -191,6 +191,40 @@ def available_codecs() -> Tuple[str, ...]:
     )
 
 
+# Below this the native encode-into-frame saves less than its setup costs.
+_NATIVE_ENCODE_MIN_BYTES = 1 << 20
+
+
+def _native_zlib_frame(mv, usize: int, codec: _Codec, level: Optional[int]):
+    """Native deflate straight into the frame's payload region (the codec
+    encode offload): one allocation, zero copies of the compressed bytes.
+    Returns the finished frame, ``None`` when the payload is incompressible
+    (caller stores raw — same decision Python's ``len(candidate) < usize``
+    makes, via compress2's Z_BUF_ERROR at cap usize-1), or ``False`` when
+    native zlib is unavailable/failed (caller runs the Python codec; both
+    produce byte-identical deflate streams, so the fallback is invisible)."""
+    from . import phase_stats
+    from .native_io import NativeFileIO, NativeZlibError
+
+    native = NativeFileIO.maybe_create()
+    if native is None or not native.has_zlib:
+        return False
+    frame = bytearray(HEADER_BYTES + usize - 1)
+    eff_level = level if level is not None else codec.default_level
+    try:
+        with phase_stats.timed("compress", usize):
+            elen = native.zlib_encode_into(
+                mv, memoryview(frame)[HEADER_BYTES:], eff_level
+            )
+    except NativeZlibError:
+        return False  # real failure: the Python codec runs instead
+    if elen is None:
+        return None  # would not shrink: store raw-in-frame
+    _HEADER.pack_into(frame, 0, MAGIC, codec.codec_id, 0, 0, usize)
+    del frame[HEADER_BYTES + elen :]
+    return frame
+
+
 def encode(buf, codec_name: str, level: Optional[int] = None) -> Tuple[bytearray, str]:
     """Frame ``buf``'s bytes with ``codec_name``; returns ``(frame,
     inner_codec_name)``.
@@ -200,6 +234,9 @@ def encode(buf, codec_name: str, level: Optional[int] = None) -> Tuple[bytearray
     header records what actually happened, so readers never consult the
     plan.  Runs one pass over the payload; callers put it on the
     scheduler's worker pool (the underlying C codecs release the GIL).
+    Large zlib payloads deflate natively straight into the frame
+    (libtpusnap) — byte-identical output, one fewer full copy of the
+    compressed bytes.
     """
     from . import phase_stats
 
@@ -209,17 +246,26 @@ def encode(buf, codec_name: str, level: Optional[int] = None) -> Tuple[bytearray
     payload = mv  # raw fallback: the input itself, copied once into the frame
     inner = RAW
     if codec is not None and codec.codec_id != 0:
-        try:
-            with phase_stats.timed("compress", usize):
-                candidate = codec.compress(mv, level)
-            if len(candidate) < usize:
-                payload = candidate
-                inner = codec
-        except Exception:
-            logger.warning(
-                "Compression with %r failed; storing chunk raw", codec_name,
-                exc_info=True,
-            )
+        tried_native = False
+        if codec.name == "zlib" and usize >= _NATIVE_ENCODE_MIN_BYTES:
+            native_frame = _native_zlib_frame(mv, usize, codec, level)
+            if native_frame is not False:
+                tried_native = True
+                if native_frame is not None:
+                    return native_frame, codec.name
+                # incompressible: fall through to the raw frame below
+        if not tried_native:
+            try:
+                with phase_stats.timed("compress", usize):
+                    candidate = codec.compress(mv, level)
+                if len(candidate) < usize:
+                    payload = candidate
+                    inner = codec
+            except Exception:
+                logger.warning(
+                    "Compression with %r failed; storing chunk raw", codec_name,
+                    exc_info=True,
+                )
     # One pre-sized allocation, one copy of the payload — no intermediate
     # bytes(mv) and no header+payload concat copy.
     frame = bytearray(HEADER_BYTES + len(payload))

@@ -172,6 +172,10 @@ class FaultyStoragePlugin(StoragePlugin):
         # Mirror the inner plugin's scatter capability: the batcher keys
         # slab staging costs on it, and injection must not change planning.
         self.supports_scatter = getattr(inner, "supports_scatter", False)
+        # And the fused write+hash capability: the torn-write kind builds
+        # its own prefix WriteIO (no hash request), so digests recorded on
+        # the eventual successful retry still describe the full payload.
+        self.supports_write_hash = getattr(inner, "supports_write_hash", False)
 
     def _get_executor(self):
         # Forward the inner plugin's executor (if any): the incremental

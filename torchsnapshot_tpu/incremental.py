@@ -79,7 +79,7 @@ def _slab_matches(buf, expected: Dict[tuple, str]) -> bool:
         for ((start, end), checksum), part in zip(ranges, buf.parts):
             if start != offset or end - start != part.nbytes:
                 return False
-            if integrity.digest(part) != checksum:
+            if integrity.digest_as(part, checksum) != checksum:
                 return False
             offset = end
         return True
@@ -87,7 +87,7 @@ def _slab_matches(buf, expected: Dict[tuple, str]) -> bool:
     for (start, end), checksum in ranges:
         if start != offset or end > view.nbytes:
             return False
-        if integrity.digest(view[start:end]) != checksum:
+        if integrity.digest_as(view[start:end], checksum) != checksum:
             return False
         offset = end
     return offset == view.nbytes
@@ -121,7 +121,13 @@ class IncrementalStoragePlugin(StoragePlugin):
                 # unchanged payload silently re-uploads in full.
                 if isinstance(expected, dict):
                     return _slab_matches(write_io.buf, expected)
-                return integrity.digest(contiguous(write_io.buf)) == expected
+                # digest_as: hash under the BASE's recorded algorithm, so
+                # payloads recorded before the striped-digest era still
+                # dedup instead of re-uploading on every save.
+                return (
+                    integrity.digest_as(contiguous(write_io.buf), expected)
+                    == expected
+                )
 
             # hash (GB/s-scale work) off the event loop; None = the loop's
             # default executor for plugins without their own pool

@@ -1,17 +1,33 @@
 """Payload integrity: checksums recorded in the manifest, verified on restore.
 
 A capability beyond the reference (which trusts storage end-to-end): every
-array/object payload gets an xxHash64 digest (native C++, ~5 GB/s — off the
-critical path at checkpoint bandwidths) computed from the exact staged bytes,
-stored on its manifest entry as ``"xxh64:<hex>"``, and verified whenever a
-consumer receives a payload in full (whole-file reads, slab byte-ranges,
-sharded pieces).  Tiled partial reads skip verification.  Disable with
-``TPUSNAP_CHECKSUM=0``.  Checksums are silently skipped when the native
-library is unavailable; restore only verifies entries that carry a digest.
+array/object payload gets a digest computed from the exact staged bytes,
+stored on its manifest entry, and verified whenever a consumer receives a
+payload in full (whole-file reads, slab byte-ranges, sharded pieces).  Tiled
+partial reads skip verification.  Disable with ``TPUSNAP_CHECKSUM=0``.
+
+Two digest algorithms, chosen by payload size (the policy is size-only and
+deterministic, so every compute path — native fused write, native one-shot,
+pure-Python fallback — produces the same manifest bytes):
+
+- ``xxh64:<hex>`` — plain xxHash64 (seed 0) for payloads under
+  ``STRIPED_MIN_BYTES``;
+- ``xxh64s:<hex>`` — the striped variant for large payloads: independent
+  xxh64 per ``STRIPE_BYTES`` window, combined via xxh64 over the
+  little-endian digest stream.  Striping is what lets a single 1 GB chunk
+  hash at memory bandwidth (parallel stripes on the native worker pool)
+  and lets checksummed restores read in parallel with per-stripe fused
+  verification; a sequential xxh64 stream can do neither.
+
+Hashing backends, in preference order: the native library (libtpusnap,
+GIL-released, pool-parallel), then the ``xxhash`` wheel (C extension,
+bit-identical), then nothing — digests are skipped (recorded as None,
+tolerated on read) only when no backend exists.  ``TPUSNAP_NATIVE=0``
+forces the non-native backend; manifests stay byte-identical.
 
 Digests cover the bytes **as stored**: for compressed entries
 (compression.py) that is the framed compressed payload — exactly what is
-on disk — so ``verify``/``audit``, the read-fused xxh64 path, and
+on disk — so ``verify``/``audit``, the read-fused hashing paths, and
 incremental dedup's comparisons all work without decompressing anything,
 and corruption inside a frame surfaces as :class:`ChecksumError` before
 the decoder ever runs.
@@ -22,9 +38,14 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .native_io import STRIPE_BYTES, STRIPED_MIN_BYTES
+
 
 class ChecksumError(RuntimeError):
     pass
+
+
+_KNOWN_ALGOS = ("xxh64", "xxh64s")
 
 
 def checksums_enabled() -> bool:
@@ -42,19 +63,129 @@ def save_checksums_enabled() -> bool:
     ) not in ("0", "false", "")
 
 
-def digest(buf) -> Optional[str]:
-    """Unconditional xxh64 digest (None only when the native lib is absent).
-    Callers that hash for COMPARISON (incremental dedup deciding whether a
-    payload changed) use this directly — the save-side recording knob must
-    not silently disable dedup."""
+# ----------------------------------------------------------- hash backends
+
+
+_XXHASH_MOD = None
+_XXHASH_PROBED = False
+
+
+def _xxhash_mod():
+    """The ``xxhash`` wheel, or None.  The non-native backend: bit-identical
+    xxh64, releases the GIL, present on most images.
+
+    The probed flag is set AFTER the module lands: concurrent first calls
+    (parallel slab hashers on executor threads) may both import — benign —
+    but none can ever observe probed=True with the module still unset,
+    which would silently drop that payload's digest."""
+    global _XXHASH_MOD, _XXHASH_PROBED
+    if _XXHASH_PROBED:
+        return _XXHASH_MOD
+    try:
+        import xxhash  # type: ignore[import-not-found]
+
+        mod = xxhash
+    except ImportError:
+        mod = None
+    _XXHASH_MOD = mod
+    _XXHASH_PROBED = True
+    return mod
+
+
+def hashing_available() -> bool:
+    """Whether ANY digest backend exists (native or the xxhash wheel)."""
     from .native_io import NativeFileIO
-    from . import phase_stats
+
+    return NativeFileIO.maybe_create() is not None or _xxhash_mod() is not None
+
+
+def digest_algo_for(nbytes: int) -> str:
+    """The algorithm policy: size-only, so every compute path agrees."""
+    return "xxh64s" if nbytes >= STRIPED_MIN_BYTES else "xxh64"
+
+
+def format_digest(hash64: int, nbytes: int) -> str:
+    return f"{digest_algo_for(nbytes)}:{hash64:016x}"
+
+
+def hash_algo_of(checksum: Optional[str]) -> Optional[str]:
+    """The algo tag of a recorded digest, or None when absent/unknown."""
+    if not checksum:
+        return None
+    algo = checksum.partition(":")[0]
+    return algo if algo in _KNOWN_ALGOS else None
+
+
+def _py_hash64(view: memoryview) -> Optional[int]:
+    mod = _xxhash_mod()
+    if mod is None:
+        return None
+    return mod.xxh64(view).intdigest()
+
+
+def _py_hash64_striped(view: memoryview) -> Optional[int]:
+    mod = _xxhash_mod()
+    if mod is None:
+        return None
+    from .native_io import striped_hash64
+
+    # The ONE shared striped-combination implementation (native_io): the
+    # wheel fallback and a stale native library's fallback cannot drift.
+    return striped_hash64(view, lambda v: mod.xxh64(v).intdigest())
+
+
+def _hash64(buf, algo: str) -> Optional[int]:
+    """The raw 64-bit digest of ``buf`` under ``algo``, via the best
+    available backend; None when no backend exists."""
+    from .native_io import NativeFileIO
 
     native = NativeFileIO.maybe_create()
-    if native is None:
+    if native is not None:
+        if algo == "xxh64s":
+            return native.xxhash64_striped(buf)
+        return native.xxhash64(buf)
+    view = memoryview(buf)
+    if not view.c_contiguous:
+        view = memoryview(bytes(view))
+    view = view.cast("B")
+    if algo == "xxh64s":
+        return _py_hash64_striped(view)
+    return _py_hash64(view)
+
+
+def digest(buf) -> Optional[str]:
+    """Unconditional digest (None only when no hash backend is available).
+    Callers that hash for COMPARISON (incremental dedup deciding whether a
+    payload changed, CAS content addressing) use this directly — the
+    save-side recording knob must not silently disable dedup."""
+    from . import phase_stats
+
+    nbytes = memoryview(buf).nbytes
+    algo = digest_algo_for(nbytes)
+    with phase_stats.timed("checksum", nbytes):
+        h = _hash64(buf, algo)
+    if h is None:
         return None
+    return f"{algo}:{h:016x}"
+
+
+def digest_as(buf, expected: Optional[str]) -> Optional[str]:
+    """Digest ``buf`` under the algorithm an EXISTING recorded digest used,
+    for comparison against it — dedup paths (incremental, CAS probes) must
+    hash a pre-upgrade base's way, not the current size policy, or every
+    large unchanged payload recorded as plain ``xxh64`` before the striped
+    era would silently re-upload forever.  Falls back to the size policy
+    when the recorded tag is absent/unknown."""
+    from . import phase_stats
+
+    algo = hash_algo_of(expected)
+    if algo is None:
+        return digest(buf)
     with phase_stats.timed("checksum", memoryview(buf).nbytes):
-        return f"xxh64:{native.xxhash64(buf):016x}"
+        h = _hash64(buf, algo)
+    if h is None:
+        return None
+    return f"{algo}:{h:016x}"
 
 
 def compute(buf) -> Optional[str]:
@@ -71,11 +202,15 @@ _INLINE_DIGEST_MAX_BYTES = 1 << 20
 
 
 async def compute_on(buf, executor) -> Optional[str]:
-    """``compute`` on the executor: the native xxh64 releases the GIL, so
-    concurrent stagers' hashes overlap with each other and with storage I/O
-    instead of serializing on the event-loop thread (~100 ms per 512 MB
-    chunk at hash rate — the checksum must stay off the critical path).
-    Small buffers hash inline; see ``_INLINE_DIGEST_MAX_BYTES``."""
+    """``compute`` on the executor: the native/xxhash hashers release the
+    GIL, so concurrent stagers' hashes overlap with each other and with
+    storage I/O instead of serializing on the event-loop thread (~100 ms per
+    512 MB chunk at hash rate — the checksum must stay off the critical
+    path).  Small buffers hash inline; see ``_INLINE_DIGEST_MAX_BYTES``.
+
+    Used by paths that must resolve digests AT STAGE TIME (the batcher's
+    join path); the scheduler's write path defers instead, fusing the hash
+    into the native write where the storage supports it."""
     if not save_checksums_enabled():
         return None
     if executor is None or memoryview(buf).nbytes < _INLINE_DIGEST_MAX_BYTES:
@@ -130,7 +265,11 @@ def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
     Reads fan across ``io_concurrency`` threads (round-3 advisor finding:
     a strictly sequential audit re-downloaded cloud snapshots one payload
     at a time, making ``cp --verify`` much slower than the copy it
-    checked); results are aggregated in deterministic payload order.
+    checked); results are aggregated in deterministic payload order.  Each
+    read carries the recorded digest's algo so plugins that fuse hashing
+    into the read loop (native fs) verify per range with no second memory
+    pass — striped ("xxh64s") payloads additionally read and hash their
+    stripes in parallel on the native pool.
 
     An unreadable SHARED payload — a slab or a CAS chunk several entries
     reference — is reported once per location (not once per byte range),
@@ -151,6 +290,7 @@ def audit(storage, metadata, io_concurrency: int = 4) -> tuple:
             path=location,
             byte_range=list(byte_range) if byte_range else None,
             want_hash=True,
+            hash_algo=hash_algo_of(checksum),
         )
         try:
             storage.sync_read(read_io)
@@ -201,28 +341,27 @@ def verify(
 ) -> None:
     """Verify ``buf`` against its manifest digest.
 
-    ``precomputed`` is an xxh64 already computed over exactly these bytes
-    (the native fs plugin fuses hashing into the read loop — one memory pass
-    instead of two); when present the buffer is not traversed again."""
+    ``precomputed`` is a 64-bit digest already computed — under the
+    EXPECTED digest's algorithm — over exactly these bytes (the native fs
+    plugin fuses hashing into the read loop; one memory pass instead of
+    two); when present the buffer is not traversed again."""
     if expected is None or not checksums_enabled():
         return
-    algo, _, digest = expected.partition(":")
-    if algo != "xxh64":
+    algo, _, digest_hex = expected.partition(":")
+    if algo not in _KNOWN_ALGOS:
         return  # unknown algorithm: tolerate (forward compat)
     if precomputed is not None:
         actual = f"{precomputed:016x}"
     else:
-        from .native_io import NativeFileIO
-
-        native = NativeFileIO.maybe_create()
-        if native is None:
-            return
         from . import phase_stats
 
         with phase_stats.timed("checksum", memoryview(buf).nbytes):
-            actual = f"{native.xxhash64(buf):016x}"
-    if actual != digest:
+            h = _hash64(buf, algo)
+        if h is None:
+            return  # no hash backend on this host: nothing provable
+        actual = f"{h:016x}"
+    if actual != digest_hex:
         raise ChecksumError(
-            f"Checksum mismatch for {location}: stored xxh64:{digest}, "
-            f"computed xxh64:{actual} — the payload is corrupt"
+            f"Checksum mismatch for {location}: stored {algo}:{digest_hex}, "
+            f"computed {algo}:{actual} — the payload is corrupt"
         )

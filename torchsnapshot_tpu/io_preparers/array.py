@@ -243,9 +243,28 @@ class ArrayBufferStager(BufferStager):
         self._obj = obj
         self._entry = entry
         self._is_async_snapshot = is_async_snapshot
+        # Deferred-digest contract with the scheduler: instead of hashing
+        # the staged bytes here (a separate memory pass), stage_buffer
+        # registers one sink per buffer part; the scheduler resolves them
+        # at write time — fused into the native write+hash call where the
+        # storage supports it, or via one pre-write hash pass otherwise.
+        # The digest policy is size-only, so both routes produce identical
+        # manifests.
+        self.hash_sinks: Optional[list] = None
+
+    def _defer_checksum(self) -> None:
+        from .. import integrity
+
+        if integrity.save_checksums_enabled():
+            entry = self._entry
+
+            def _set(digest_str) -> None:
+                entry.checksum = digest_str
+
+            self.hash_sinks = [_set]
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
-        from .. import integrity, phase_stats
+        from .. import phase_stats
 
         obj = self._obj
         if self._entry.serializer == Serializer.PICKLE.value:
@@ -253,7 +272,7 @@ class ArrayBufferStager(BufferStager):
             with phase_stats.timed("serialize", getattr(host, "nbytes", 0)):
                 data = serialization.pickle_save_as_bytes(host)
             self._obj = None
-            self._entry.checksum = await integrity.compute_on(data, executor)
+            self._defer_checksum()
             return data
         if staging.is_jax_array(obj):
             # Enqueue the async DMA now (we are being admitted by the
@@ -292,9 +311,11 @@ class ArrayBufferStager(BufferStager):
             from ..telemetry import metrics as tmetrics
 
             tmetrics.record_codec(inner, uncompressed_nbytes, len(frame))
-            self._entry.checksum = await integrity.compute_on(frame, executor)
+            # The deferred digest covers the FRAME — exactly the bytes the
+            # scheduler hands storage.
+            self._defer_checksum()
             return frame
-        self._entry.checksum = await integrity.compute_on(mv, executor)
+        self._defer_checksum()
         return mv
 
     @staticmethod
@@ -772,6 +793,11 @@ class ArrayBufferConsumer(BufferConsumer):
         # Tiled reads carry checksum=None (partial payloads are never
         # verified) — don't ask the plugin to hash them.
         self.wants_read_hash = checksum is not None
+        # Which digest the fused read must compute ("xxh64s" large payloads
+        # verify with parallel per-stripe reads on the native pool).
+        from .. import integrity
+
+        self.hash_algo = integrity.hash_algo_of(checksum)
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None

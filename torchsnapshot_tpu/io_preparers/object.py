@@ -59,6 +59,10 @@ class ObjectBufferStager(BufferStager):
     def __init__(self, obj: Any, entry: ObjectEntry) -> None:
         self._obj = obj
         self._entry = entry
+        # Deferred digest (see ArrayBufferStager): the scheduler resolves
+        # the sink at write time, fused into the native write when the
+        # storage supports it.
+        self.hash_sinks: Optional[list] = None
 
     async def stage_buffer(self, executor: Optional[Executor] = None) -> BufferType:
         from .. import integrity, phase_stats
@@ -74,7 +78,13 @@ class ObjectBufferStager(BufferStager):
             # along; the phase_stats clamp keeps its retroactive interval
             # honest.
             phase_stats.add("serialize", time.monotonic() - begin, len(data))
-        self._entry.checksum = await integrity.compute_on(data, executor)
+        if integrity.save_checksums_enabled():
+            entry = self._entry
+
+            def _set(digest_str) -> None:
+                entry.checksum = digest_str
+
+            self.hash_sinks = [_set]
         return data
 
     def get_staging_cost_bytes(self) -> int:
@@ -95,6 +105,9 @@ class ObjectBufferConsumer(BufferConsumer):
         self._nbytes_hint = 4096
         self.precomputed_hash64 = None
         self.wants_read_hash = entry.checksum is not None
+        from .. import integrity
+
+        self.hash_algo = integrity.hash_algo_of(entry.checksum)
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None

@@ -394,6 +394,9 @@ class _ShardedArrayBufferConsumer(BufferConsumer):
         self._into = into
         self.precomputed_hash64: Optional[int] = None
         self.wants_read_hash = piece_entry.checksum is not None
+        from .. import integrity
+
+        self.hash_algo = integrity.hash_algo_of(piece_entry.checksum)
 
     async def consume_buffer(
         self, buf: BufferType, executor: Optional[Executor] = None

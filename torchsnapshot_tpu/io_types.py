@@ -77,6 +77,18 @@ class WriteIO:
     # Backends whose writes are already durable-on-ack (object stores)
     # ignore it.
     durable: bool = False
+    # Fused write+hash request (scheduler → plugins advertising
+    # ``supports_write_hash``): compute each part's digest fused with the
+    # write — one memory pass on native threads instead of a separate
+    # Python-level checksum pass — and fill ``part_hash64``.  Parts are the
+    # ScatterBuffer members in order, or the single whole buffer.  A plugin
+    # that leaves ``part_hash64`` None is fine: the scheduler hashes the
+    # still-held buffer itself.
+    want_part_hashes: bool = False
+    # Per-part 64-bit digests under the size policy integrity.format_digest
+    # applies (plain xxh64 below STRIPED_MIN_BYTES, striped xxh64s at or
+    # above), set by the plugin when it fused hashing into the write.
+    part_hash64: Optional[List[int]] = None
 
 
 @dataclass
@@ -94,10 +106,17 @@ class ReadIO:
     # default so merged spanning reads, tiled reads, and checksum-less
     # entries never pay for a digest nobody will use.
     want_hash: bool = False
-    # xxh64 of exactly the bytes placed in ``buf``, when the plugin computed
-    # it fused with the read (native fs data plane).  Consumers whose
-    # integrity check covers the whole read use it to skip their own hash
-    # pass; None means "not computed" and is always safe.
+    # The recorded digest's algorithm ("xxh64" | "xxh64s"), so a fusing
+    # plugin computes the digest the consumer will actually compare
+    # against.  "xxh64s" (striped) additionally unlocks the parallel
+    # read path for checksummed payloads: stripes read+hash concurrently
+    # on the native pool, which a sequential xxh64 stream forbids.
+    hash_algo: Optional[str] = None
+    # The 64-bit digest (under ``hash_algo``) of exactly the bytes placed
+    # in ``buf``, when the plugin computed it fused with the read (native
+    # fs data plane).  Consumers whose integrity check covers the whole
+    # read use it to skip their own hash pass; None means "not computed"
+    # and is always safe.
     hash64: Optional[int] = None
 
 
@@ -155,6 +174,13 @@ class StoragePlugin(abc.ABC):
     # write time leave this False so the batcher keeps the slab-sized side
     # allocation in the staging cost the scheduler budgets for.
     supports_scatter: bool = False
+
+    # True when write() honors WriteIO.want_part_hashes — digests computed
+    # fused with the write on native threads (the fs native data plane).
+    # The scheduler defers manifest checksums to write time for such
+    # backends; for everything else it hashes the staged buffer itself
+    # right before the write, so manifests are identical either way.
+    supports_write_hash: bool = False
 
     @abc.abstractmethod
     async def write(self, write_io: WriteIO) -> None:

@@ -49,6 +49,8 @@ CAS_ALGO_ENV_VAR = _ENV_PREFIX + "CAS_ALGO"
 JOURNAL_ENV_VAR = _ENV_PREFIX + "JOURNAL"
 JOURNAL_MAX_SEGMENTS_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_SEGMENTS"
 JOURNAL_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_BYTES"
+NATIVE_ENV_VAR = _ENV_PREFIX + "NATIVE"
+NATIVE_THREADS_ENV_VAR = _ENV_PREFIX + "NATIVE_THREADS"
 
 # Digest algorithms the CAS layout supports.  One today; the layout
 # namespaces chunks by algorithm (cas/<algo>/...) so adding another is a
@@ -515,6 +517,30 @@ def override_journal_max_segments(value: int) -> Generator[None, None, None]:
 @contextmanager
 def override_journal_max_bytes(value: int) -> Generator[None, None, None]:
     with _override_env(JOURNAL_MAX_BYTES_ENV_VAR, str(value)):
+        yield
+
+
+def native_enabled() -> bool:
+    """Whether the native data plane (libtpusnap.so) may be used at all.
+    ``TPUSNAP_NATIVE=0`` forces the pure-Python fallback path everywhere —
+    writes, reads, hashing, codec encode — which must stay byte-identical
+    to the native path (the parity contract tests/test_native_parity.py
+    enforces).  On by default."""
+    return os.environ.get(NATIVE_ENV_VAR, "1") not in ("0", "", "false", "False")
+
+
+def get_native_threads() -> int:
+    """Size of the native extension's internal C++ worker pool
+    (``TPUSNAP_NATIVE_THREADS``), which executes the fused write+hash,
+    striped-hash, and multi-range-read tasks off the GIL.  0 (default)
+    sizes automatically: min(16, hardware threads).  Applied before the
+    pool's lazy creation; later changes are ignored for the process."""
+    return max(0, _get_int_env(NATIVE_THREADS_ENV_VAR, 0))
+
+
+@contextmanager
+def override_native(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(NATIVE_ENV_VAR, "1" if enabled else "0"):
         yield
 
 

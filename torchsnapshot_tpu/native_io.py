@@ -4,18 +4,80 @@ Replaces aiofiles' thread-pooled Python I/O in the hot path (reference
 /root/reference/torchsnapshot/storage_plugins/fs.py): whole-buffer writes and
 (ranged) reads happen in one C call each, with the GIL released by ctypes for
 the entire syscall loop — no Python-level chunking overhead.
+
+Beyond per-call GIL release, the library runs an internal C++ worker pool
+(``TPUSNAP_NATIVE_THREADS``) executing the off-GIL data plane:
+
+- ``write_parts_hash`` — ONE call per payload/slab that writes all member
+  buffers AND returns each member's digest, hash and write fused over the
+  same cache-resident bytes;
+- ``xxhash64_striped`` — the parallel "xxh64s" digest for large buffers
+  (independent per-stripe xxh64s combined over the digest stream);
+- ``read_ranges_hash`` — multi-range pread fan-out with optional fused
+  per-range hashing for restore and audit.
+
+``TPUSNAP_NATIVE=0`` disables the whole native plane (``maybe_create``
+returns None); every consumer then takes a byte-identical pure-Python path.
+A stale library missing the newer symbols degrades per-feature: the
+``has_*`` capability flags gate each fast path and a one-time
+``native.degraded`` event records what was lost.
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Digest striping policy — these constants DEFINE the "xxh64s" digest value
+# (recorded in manifests, naming CAS chunks) and are mirrored by the native
+# library call arguments and integrity.py's pure-Python fallback.  Changing
+# them changes every striped digest: never bump without a new algo tag.
+STRIPE_BYTES = 8 << 20
+STRIPED_MIN_BYTES = 32 << 20
+
+# The native data plane's ABI generation.  native_io reads the library's
+# tpusnap_abi_version() at load and treats a mismatch exactly like missing
+# symbols (full per-feature degrade): a STALE .so that still EXPORTS every
+# entry point but with changed semantics (a hash fix, a different stripe
+# combination) must never silently fill manifests with divergent digests.
+# Bump in lockstep with TPUSNAP_ABI_VERSION in tpustore.cc whenever any
+# existing entry point's observable behavior changes.
+NATIVE_ABI_VERSION = 1
+
+
+class NativeZlibError(RuntimeError):
+    """Native deflate could not run (unavailable, bad level, Z_MEM_ERROR) —
+    distinct from the None 'did not fit' result; callers fall back to the
+    Python codec, whose output is byte-identical."""
+
+
+def striped_hash64(view: memoryview, hash64) -> int:
+    """The ONE Python-side implementation of the "xxh64s" combination:
+    per-STRIPE_BYTES digests via ``hash64`` (any xxh64-compatible callable
+    returning an int), combined by hashing their little-endian u64 stream.
+    Both fallbacks — the xxhash wheel (integrity.py) and a stale native
+    library without the striped symbol — go through here, so they cannot
+    drift from each other (the native C implementation mirrors it and is
+    pinned by the parity tests)."""
+    import struct
+
+    if view.nbytes <= STRIPE_BYTES:
+        return hash64(view)
+    packed = b"".join(
+        struct.pack("<Q", hash64(view[o : o + STRIPE_BYTES]))
+        for o in range(0, view.nbytes, STRIPE_BYTES)
+    )
+    return hash64(packed)
 
 
 class NativeFileIO:
     _instance: Optional["NativeFileIO"] = None
     _failed = False
+    _degraded_reported = False
 
     def __init__(self) -> None:
         from ._native.build import get_native_lib_path
@@ -62,6 +124,123 @@ class NativeFileIO:
             ctypes.POINTER(ctypes.c_uint64),
         ]
         self._lib = lib
+        self._probe_data_plane(lib)
+
+    def _probe_data_plane(self, lib: ctypes.CDLL) -> None:
+        """Bind the off-GIL data-plane symbols, degrading per-feature when
+        a stale library predates them (build.py returns a stale .so rather
+        than nothing when the rebuild can't run)."""
+        missing: List[str] = []
+
+        # ABI generation gate: a stale library that still exports every
+        # symbol but with changed semantics must degrade like one missing
+        # them all — per-symbol probing alone can't see a behavior change.
+        abi_ok = False
+        try:
+            fn = lib.tpusnap_abi_version
+            fn.restype = ctypes.c_int
+            fn.argtypes = []
+            abi_ok = int(fn()) == NATIVE_ABI_VERSION
+        except AttributeError:
+            pass
+        if not abi_ok:
+            missing.append(f"abi_version=={NATIVE_ABI_VERSION}")
+
+        def _bind(name: str, restype, argtypes) -> bool:
+            if not abi_ok:
+                return False
+            try:
+                fn = getattr(lib, name)
+            except AttributeError:
+                missing.append(name)
+                return False
+            fn.restype = restype
+            fn.argtypes = argtypes
+            return True
+
+        self.has_pool = _bind(
+            "tpusnap_pool_configure", None, [ctypes.c_int]
+        )
+        self.has_striped_hash = _bind(
+            "tpusnap_xxhash64_striped",
+            ctypes.c_uint64,
+            [ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64],
+        )
+        self.has_fused_write = _bind(
+            "tpusnap_write_parts_hash",
+            ctypes.c_int,
+            [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int,
+                ctypes.c_uint64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64),
+            ],
+        )
+        self.has_ranged_read = _bind(
+            "tpusnap_read_ranges_hash",
+            ctypes.c_int,
+            [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_int,
+                ctypes.c_uint64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64),
+            ],
+        )
+        self.has_zlib = False
+        if _bind("tpusnap_has_zlib", ctypes.c_int, []):
+            _bind(
+                "tpusnap_zlib_encode",
+                ctypes.c_int64,
+                [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_int,
+                ],
+            )
+            self.has_zlib = bool(lib.tpusnap_has_zlib())
+        if self.has_pool:
+            from . import knobs
+
+            lib.tpusnap_pool_configure(knobs.get_native_threads())
+        if missing:
+            self._report_degraded(missing)
+
+    @classmethod
+    def _report_degraded(cls, missing: List[str]) -> None:
+        if cls._degraded_reported:
+            return
+        cls._degraded_reported = True
+        logger.warning(
+            "libtpusnap.so is missing data-plane symbols %s (stale build?); "
+            "the corresponding fast paths fall back to Python",
+            missing,
+        )
+        try:
+            from .event import Event
+            from .event_handlers import log_event
+            from .telemetry import metrics as tmetrics
+
+            tmetrics.record_native_degraded("stale_library")
+            log_event(
+                Event(
+                    name="native.degraded",
+                    metadata={"missing": sorted(missing)},
+                )
+            )
+        except Exception:
+            pass  # telemetry must never break the data plane
 
     def xxhash64(self, buf) -> int:
         view = memoryview(buf)
@@ -83,8 +262,164 @@ class NativeFileIO:
             c_buf = ctypes.c_void_p(arr.ctypes.data)
         return int(self._lib.tpusnap_xxhash64(c_buf, nbytes, 0))
 
+    def xxhash64_striped(self, buf) -> int:
+        """The striped ("xxh64s") digest of ``buf``: per-STRIPE_BYTES xxh64
+        digests combined via xxh64 over their little-endian stream, computed
+        in parallel on the native worker pool.  Falls back to a sequential
+        per-stripe loop over the plain hasher when the library predates the
+        symbol — same value either way."""
+        view = memoryview(buf)
+        if not view.c_contiguous:
+            view = memoryview(bytes(view))
+        view = view.cast("B")
+        if self.has_striped_hash:
+            import numpy as np
+
+            if view.nbytes == 0:
+                return int(self._lib.tpusnap_xxhash64_striped(b"", 0, 0, STRIPE_BYTES))
+            arr = np.frombuffer(view, np.uint8)
+            return int(
+                self._lib.tpusnap_xxhash64_striped(
+                    ctypes.c_void_p(arr.ctypes.data),
+                    view.nbytes,
+                    0,
+                    STRIPE_BYTES,
+                )
+            )
+        return striped_hash64(view, self.xxhash64)
+
+    def write_parts_hash(self, path: str, parts: Sequence[Any]) -> List[int]:
+        """Fused write+hash: ``parts`` land sequentially in one file while
+        each part's digest is computed from the same cache-resident bytes on
+        the native worker pool.  Returns one hash per part, in order (parts
+        of >= STRIPED_MIN_BYTES are "xxh64s" digests, smaller ones plain
+        "xxh64" — ``integrity.format_digest`` applies the same policy).
+        Zero-length parts are kept (their digest is the empty hash)."""
+        import numpy as np
+
+        views = []
+        for part in parts:
+            view = memoryview(part)
+            if not view.c_contiguous:
+                view = memoryview(bytes(view))
+            views.append(view.cast("B"))
+        n = len(views)
+        if n == 0:
+            with open(path, "wb"):
+                return []
+        arrs = [np.frombuffer(v, np.uint8) if v.nbytes else None for v in views]
+        bufs = (ctypes.c_void_p * n)(
+            *(a.ctypes.data if a is not None else None for a in arrs)
+        )
+        sizes = (ctypes.c_int64 * n)(*(v.nbytes for v in views))
+        out = (ctypes.c_uint64 * n)()
+        rc = self._lib.tpusnap_write_parts_hash(
+            path.encode(),
+            bufs,
+            sizes,
+            n,
+            0,
+            STRIPE_BYTES,
+            STRIPED_MIN_BYTES,
+            out,
+        )
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)
+        return list(out)
+
+    def read_ranges_into(
+        self,
+        path: str,
+        ranges: Sequence[Tuple[int, int]],
+        views: Sequence[Any],
+        want_hash: bool = False,
+    ) -> Optional[List[int]]:
+        """Parallel multi-range pread into caller-owned buffers, optionally
+        fused with per-range hashing (striped for ranges >=
+        STRIPED_MIN_BYTES, plain below).  ``ranges`` are absolute
+        ``(offset, end)`` file extents; ``views[i]`` must be writable and
+        exactly ``end - offset`` bytes.  Returns per-range hashes when
+        ``want_hash`` else None."""
+        import numpy as np
+
+        n = len(ranges)
+        if n == 0:
+            return [] if want_hash else None
+        arrs = []
+        for (off, end), view in zip(ranges, views):
+            mv = memoryview(view)
+            if mv.nbytes != end - off:
+                raise ValueError(
+                    f"range [{off}, {end}) needs {end - off} bytes, "
+                    f"destination has {mv.nbytes}"
+                )
+            arrs.append(np.frombuffer(mv, np.uint8) if mv.nbytes else None)
+        bufs = (ctypes.c_void_p * n)(
+            *(a.ctypes.data if a is not None else None for a in arrs)
+        )
+        offs = (ctypes.c_int64 * n)(*(off for off, _ in ranges))
+        lens = (ctypes.c_int64 * n)(*(end - off for off, end in ranges))
+        out = (ctypes.c_uint64 * n)()
+        rc = self._lib.tpusnap_read_ranges_hash(
+            path.encode(),
+            n,
+            offs,
+            lens,
+            bufs,
+            1 if want_hash else 0,
+            0,
+            STRIPE_BYTES,
+            STRIPED_MIN_BYTES,
+            out,
+        )
+        if rc != 0:
+            raise OSError(-rc, os.strerror(-rc), path)
+        return list(out) if want_hash else None
+
+    def zlib_encode_into(self, src, dst, level: int) -> Optional[int]:
+        """Deflate ``src`` directly into ``dst`` (a writable view sized to
+        the incompressible cap), byte-identical to ``zlib.compress(src,
+        level)``.  Returns the encoded length, or None when the output
+        would not fit ``dst`` — the genuinely-incompressible signal the
+        caller turns into a raw frame.  A real zlib failure (bad level,
+        Z_MEM_ERROR) raises :class:`NativeZlibError` instead: conflating it
+        with "didn't fit" would silently store a compressible payload raw;
+        the caller catches it and retries through Python zlib."""
+        if not self.has_zlib:
+            raise NativeZlibError("native zlib unavailable")
+        import numpy as np
+
+        src_view = memoryview(src)
+        if not src_view.c_contiguous:
+            src_view = memoryview(bytes(src_view))
+        src_view = src_view.cast("B")
+        if src_view.nbytes == 0:
+            raise NativeZlibError("empty input")
+        dst_view = memoryview(dst)
+        src_arr = np.frombuffer(src_view, np.uint8)
+        dst_arr = np.frombuffer(dst_view, np.uint8)
+        n = self._lib.tpusnap_zlib_encode(
+            ctypes.c_void_p(src_arr.ctypes.data),
+            src_view.nbytes,
+            ctypes.c_void_p(dst_arr.ctypes.data),
+            dst_view.nbytes,
+            int(level),
+        )
+        if n > 0:
+            return int(n)
+        if n == -1:
+            return None  # would not shrink below the cap
+        raise NativeZlibError(f"compress2 failed (rc {int(n)})")
+
     @classmethod
     def maybe_create(cls) -> Optional["NativeFileIO"]:
+        from . import knobs
+
+        if not knobs.native_enabled():
+            # TPUSNAP_NATIVE=0: force the byte-identical pure-Python path.
+            # Checked per call so tests can toggle the knob; the built
+            # instance stays cached for when it flips back on.
+            return None
         if cls._failed:
             return None
         if cls._instance is None:

@@ -149,17 +149,16 @@ def copy_snapshot(
     """
     if verify:
         from . import integrity
-        from .native_io import NativeFileIO
 
         # The same guard the CLI's verify has (__main__.py): a no-op
         # audit must not masquerade as a clean one.
         if (
             not integrity.checksums_enabled()
-            or NativeFileIO.maybe_create() is None
+            or not integrity.hashing_available()
         ):
             raise RuntimeError(
                 "cannot verify copy: checksums disabled "
-                "(TPUSNAP_CHECKSUM=0) or native library unavailable"
+                "(TPUSNAP_CHECKSUM=0) or no hash backend available"
             )
     src = url_to_storage_plugin(src_path)
     dst = url_to_storage_plugin(dst_path)

@@ -104,6 +104,7 @@ class _WritePipeline:
         self.buf: Optional[object] = None
         self.buf_sz_bytes = 0
         self._io_credited = False
+        self._digests_done = False
 
     def release_after_io(self, budget: "_BudgetTracker") -> None:
         """Release the staged buffer and credit its bytes, exactly once.
@@ -122,9 +123,81 @@ class _WritePipeline:
         self.buf_sz_bytes = _buf_nbytes(self.buf)
         return self
 
+    def _hash_sinks(self) -> Optional[list]:
+        """Per-part digest callbacks the stager deferred to write time
+        (io_preparers set these instead of hashing during staging), or
+        None when digests were already resolved / recording is off."""
+        return getattr(self.write_req.buffer_stager, "hash_sinks", None)
+
+    def _parts(self) -> list:
+        buf = self.buf
+        return buf.parts if isinstance(buf, ScatterBuffer) else [buf]
+
+    def _aligned_parts(self, sinks: list) -> list:
+        parts = self._parts()
+        if len(parts) != len(sinks):
+            raise RuntimeError(
+                f"{self.write_req.path}: {len(sinks)} digest sinks for "
+                f"{len(parts)} buffer parts — stager/batcher mismatch"
+            )
+        return parts
+
+    async def ensure_digests(self, executor: Optional[Executor]) -> None:
+        """Resolve deferred manifest digests for storages WITHOUT fused
+        write+hash: one hash pass over the staged parts, off the event loop
+        (the hashers release the GIL), before the write is issued.  Parts
+        hash concurrently across the executor — the per-member overlap the
+        stage-time compute_on path had.  The fused path skips this — the
+        plugin returns the digests from the write itself (write_buffer).
+        Manifests are identical either way: the digest policy is
+        size-only."""
+        sinks = self._hash_sinks()
+        if not sinks or self._digests_done:
+            return
+        if getattr(self.storage, "supports_write_hash", False):
+            return  # fused at write time
+        from . import integrity
+
+        parts = self._aligned_parts(sinks)
+        if executor is not None and self.buf_sz_bytes >= 1 << 20:
+            loop = asyncio.get_running_loop()
+            digests = await asyncio.gather(
+                *(loop.run_in_executor(executor, integrity.digest, p) for p in parts)
+            )
+        else:
+            digests = [integrity.digest(p) for p in parts]
+        for sink, d in zip(sinks, digests):
+            sink(d)
+        self._digests_done = True
+
     async def write_buffer(self) -> "_WritePipeline":
         assert self.buf is not None
-        await self.storage.write(WriteIO(path=self.write_req.path, buf=self.buf))
+        sinks = self._hash_sinks()
+        write_io = WriteIO(path=self.write_req.path, buf=self.buf)
+        fused = (
+            bool(sinks)
+            and not self._digests_done
+            and getattr(self.storage, "supports_write_hash", False)
+        )
+        if fused:
+            parts = self._aligned_parts(sinks)
+            sizes = [memoryview(p).nbytes for p in parts]
+            write_io.want_part_hashes = True
+        await self.storage.write(write_io)
+        if fused:
+            from . import integrity
+
+            hashes = write_io.part_hash64
+            if hashes is not None and len(hashes) == len(sinks):
+                for sink, h, n in zip(sinks, hashes, sizes):
+                    sink(integrity.format_digest(h, n))
+            else:
+                # The plugin declined (e.g. degraded mid-run): hash the
+                # still-held parts — digests must exist before the commit
+                # gathers the manifest.
+                for sink, part in zip(sinks, parts):
+                    sink(integrity.digest(part))
+            self._digests_done = True
         self.buf = None  # release host memory promptly
         return self
 
@@ -325,6 +398,11 @@ async def execute_write_reqs(
 
     async def _io(pipeline: _WritePipeline) -> None:
         try:
+            # Deferred manifest digests for non-fusing storages resolve
+            # HERE — outside the io semaphore, so a hash pass never
+            # occupies an I/O slot (fusing storages return digests from
+            # the write call itself).
+            await pipeline.ensure_digests(executor)
             # Bounded retry of TRANSIENT write failures (shared taxonomy,
             # retry.py): the staged buffer is still held (write_buffer only
             # releases it on success), so a requeue is a pure re-send — a
@@ -617,6 +695,10 @@ class _ReadPipeline:
             # must not pay for hashing nobody uses.
             want_hash=getattr(consumer, "accepts_hash64", False)
             and getattr(consumer, "wants_read_hash", True),
+            # The recorded digest's algo: a fusing plugin must compute the
+            # digest the consumer will compare against, and "xxh64s" lets
+            # it read+hash stripes in parallel.
+            hash_algo=getattr(consumer, "hash_algo", None),
         )
         await self.storage.read(read_io)
         self.buf = read_io.buf

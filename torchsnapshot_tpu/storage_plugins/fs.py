@@ -89,7 +89,16 @@ class FSStoragePlugin(StoragePlugin):
             os.makedirs(parent, exist_ok=True)
             self._dir_cache.add(parent)
 
-    def _blocking_write(self, path: str, buf, durable: bool = False) -> None:
+    @property
+    def supports_write_hash(self) -> bool:
+        """Fused write+hash available: the scheduler defers manifest digests
+        to write time and gets them back from one native call per payload."""
+        native = self._native
+        return native is not None and native.has_fused_write
+
+    def _blocking_write(
+        self, path: str, buf, durable: bool = False, write_io=None
+    ) -> None:
         # Write to a temp file and rename: atomic (readers never see partial
         # payloads) and breaks hard links instead of truncating a shared
         # inode (incremental snapshots hard-link unchanged payloads into new
@@ -107,9 +116,26 @@ class FSStoragePlugin(StoragePlugin):
         tmp = f"{path}.tmp.{os.getpid()}"
         scatter = isinstance(buf, ScatterBuffer)
         nbytes = buf.nbytes if scatter else memoryview(buf).nbytes
+        fused = (
+            write_io is not None
+            and getattr(write_io, "want_part_hashes", False)
+            and self._native is not None
+            and self._native.has_fused_write
+        )
+        phase = "native_write_hash" if fused else "fs_write"
         try:
-            with phase_stats.timed("fs_write", nbytes):
-                if scatter:
+            with phase_stats.timed(phase, nbytes):
+                if fused:
+                    # ONE native call: every part lands while its digest is
+                    # computed from the same cache-resident bytes on the
+                    # native worker pool — the off-GIL data plane that
+                    # replaces the separate Python-level checksum + write
+                    # passes.
+                    parts = buf.parts if scatter else [buf]
+                    write_io.part_hash64 = self._native.write_parts_hash(
+                        tmp, parts
+                    )
+                elif scatter:
                     # Slab members land sequentially with no pack memcpy.
                     if self._native is not None:
                         self._native.write_file_parts(tmp, buf.parts)
@@ -142,56 +168,93 @@ class FSStoragePlugin(StoragePlugin):
                 pass
             raise
 
-    def _blocking_read(self, path: str, byte_range, into=None, want_hash=False):
+    def _blocking_read(
+        self, path: str, byte_range, into=None, want_hash=False, hash_algo=None
+    ):
         import time
 
         from .. import phase_stats
 
         begin = time.monotonic()
-        result, hash64 = self._read_impl(path, byte_range, into, want_hash)
+        result, hash64, phase = self._read_impl(
+            path, byte_range, into, want_hash, hash_algo
+        )
         phase_stats.add(
-            "fs_read", time.monotonic() - begin, memoryview(result).nbytes
+            phase, time.monotonic() - begin, memoryview(result).nbytes
         )
         return result, hash64
 
-    def _read_impl(self, path: str, byte_range, into, want_hash):
-        """Returns (buffer, xxh64-of-the-read-bytes-or-None).
+    def _native_ranges(self, path: str, byte_range, view, want_hash: bool):
+        """The native multi-range read path (``native_read`` phase): the
+        range lands via parallel pread tasks on the C++ worker pool — one
+        call replaces the per-chunk Python loop.  With ``want_hash`` the
+        per-stripe digests are fused with the reads (the "xxh64s"
+        verify-while-reading path)."""
+        offset = byte_range[0] if byte_range is not None else 0
+        hashes = self._native.read_ranges_into(
+            path,
+            [(offset, offset + view.nbytes)],
+            [view],
+            want_hash=want_hash,
+        )
+        return hashes[0] if hashes else None
 
-        The hash comes from the fused C read (each block hashed cache-hot
+    def _read_impl(self, path: str, byte_range, into, want_hash, hash_algo):
+        """Returns (buffer, digest-or-None, phase_stats phase name).
+
+        The digest comes from the fused C read (each block hashed cache-hot
         right after its pread) — one memory pass for read+verify instead of
         two.  Only reads whose issuer asked (ReadIO.want_hash: the consumer
-        will verify the whole payload) pay for it; parallel chunked reads
-        skip it (xxh64 is order-dependent)."""
+        will verify the whole payload) pay for it, and the issuer's
+        ``hash_algo`` decides the shape: "xxh64s" (striped) payloads read
+        AND verify in parallel on the native pool; plain "xxh64" streams
+        are order-dependent and stay sequential."""
         from .. import integrity
 
         want_hash = want_hash and integrity.checksums_enabled()
+        striped = want_hash and hash_algo == "xxh64s"
         if into is not None:
             # Read-into-place: bytes land in the restore target's own
             # memory — no allocation, and the consumer skips its copy.
             if self._native is not None:
                 view = memoryview(into).cast("B")
+                if striped and self._native.has_ranged_read:
+                    # Parallel fused read+verify: stripes pread and hash
+                    # concurrently, digest combined natively — the large
+                    # checksummed restore no longer chooses between
+                    # parallelism and verification.
+                    hash64 = self._native_ranges(
+                        path, byte_range, view, want_hash=True
+                    )
+                    return into, hash64, "native_read"
                 if view.nbytes >= _PARALLEL_READ_MIN_BYTES and self._use_parallel(
                     want_hash
                 ):
                     parallel_ways = self._parallel_ways(view.nbytes)
                     if parallel_ways > 1:
-                        self._timed_parallel(path, byte_range, view, parallel_ways)
-                        return into, None
-                if want_hash:
-                    # One memory pass for read+verify — always preferred for
-                    # checksummed payloads (a parallel read would need a
-                    # second full hash pass; xxh64 is order-dependent).
+                        phase = self._timed_parallel(
+                            path, byte_range, view, parallel_ways
+                        )
+                        return into, None, phase
+                if want_hash and not striped:
+                    # One memory pass for read+verify — preferred for plain-
+                    # digest payloads (a parallel read would need a second
+                    # full hash pass; the xxh64 stream is order-dependent).
+                    # A striped request that reaches here (ranged-read
+                    # symbol missing) must NOT return a plain digest the
+                    # consumer would compare against an xxh64s value —
+                    # read unhashed and let verify() do its own pass.
                     hash64 = self._native.read_file_into(
                         path, byte_range, into, want_hash=True
                     )
-                    return into, hash64
+                    return into, hash64, "fs_read"
                 self._timed_sequential(
                     path,
                     byte_range,
                     into,
                     record=view.nbytes >= _PARALLEL_READ_MIN_BYTES,
                 )
-                return into, None
+                return into, None, "fs_read"
             with open(path, "rb") as f:
                 if byte_range is not None:
                     f.seek(byte_range[0])
@@ -201,28 +264,46 @@ class FSStoragePlugin(StoragePlugin):
                     n = f.readinto(view[filled:])
                     if not n:
                         # A silent short read would leave stale bytes in
-                        # the restore target (and the native-less build
-                        # has no checksum verify to catch it).
+                        # the restore target (and the checksum verify may
+                        # be degraded on a native-less build).
                         raise OSError(
                             f"short read from {path}: got {filled} of "
                             f"{view.nbytes} bytes"
                         )
                     filled += n
-            return into, None
+            return into, None, "fs_read"
         if self._native is not None:
-            return self._native.read_file(path, byte_range, want_hash=want_hash)
+            if striped and self._native.has_ranged_read:
+                if byte_range is None:
+                    size = os.path.getsize(path)
+                    byte_range = [0, size]
+                out = bytearray(byte_range[1] - byte_range[0])
+                hash64 = None
+                if len(out):
+                    hash64 = self._native_ranges(
+                        path, byte_range, memoryview(out), want_hash=True
+                    )
+                return out, hash64, "native_read"
+            buf, hash64 = self._native.read_file(
+                # Same algo guard as the into-path: never hand back a plain
+                # digest for an xxh64s consumer.
+                path, byte_range, want_hash=want_hash and not striped
+            )
+            return buf, hash64, "fs_read"
         with open(path, "rb") as f:
             if byte_range is None:
-                return bytearray(f.read()), None
+                return bytearray(f.read()), None, "fs_read"
             offset, end = byte_range
             f.seek(offset)
-            return bytearray(f.read(end - offset)), None
+            return bytearray(f.read(end - offset)), None, "fs_read"
 
     def _use_parallel(self, want_hash: bool) -> bool:
         """Strategy for a large into-read: pinned env var wins outright;
-        checksummed reads stay sequential (the fused read+hash is one memory
-        pass — parallel would need a second full hash pass); otherwise the
-        first two qualifying reads A/B-measure and the winner sticks."""
+        plain-checksummed reads stay sequential (the fused read+hash is one
+        memory pass — parallel would need a second full hash pass; striped
+        "xxh64s" reads never reach here, they have their own parallel fused
+        path); otherwise the first two qualifying reads A/B-measure and the
+        winner sticks."""
         from .. import knobs
 
         pinned = knobs.get_parallel_read_ways()
@@ -269,17 +350,22 @@ class FSStoragePlugin(StoragePlugin):
                 if self._seq_gbps is None:
                     self._seq_gbps = memoryview(into).nbytes / 1e9 / elapsed
 
-    def _timed_parallel(self, path: str, byte_range, view, ways: int) -> None:
+    def _timed_parallel(self, path: str, byte_range, view, ways: int) -> str:
         import time
 
         begin = time.monotonic()
-        self._parallel_read_into(path, byte_range, view, ways)
+        phase = self._parallel_read_into(path, byte_range, view, ways)
         elapsed = max(time.monotonic() - begin, 1e-6)
         with self._adaptive_lock:
             if self._par_gbps is None:
                 self._par_gbps = view.nbytes / 1e9 / elapsed
+        return phase
 
-    def _parallel_read_into(self, path: str, byte_range, view, n_chunks: int) -> None:
+    def _parallel_read_into(self, path: str, byte_range, view, n_chunks: int) -> str:
+        """Parallel unhashed into-read; returns the phase it ran under.
+        Prefers ONE native multi-range call (pread tasks on the C++ pool —
+        no per-chunk Python dispatch); the thread-pool chunk loop remains
+        as the degraded-library fallback."""
         if byte_range is not None:
             expected = byte_range[1] - byte_range[0]
             if view.nbytes != expected:
@@ -288,6 +374,9 @@ class FSStoragePlugin(StoragePlugin):
                 raise ValueError(
                     f"into-view is {view.nbytes} bytes, range is {expected}"
                 )
+        if self._native.has_ranged_read:
+            self._native_ranges(path, byte_range, view, want_hash=False)
+            return "native_read"
         base = byte_range[0] if byte_range is not None else 0
         total = view.nbytes
         chunk = -(-total // n_chunks)
@@ -306,6 +395,7 @@ class FSStoragePlugin(StoragePlugin):
             offset += length
         for fut in futures:
             fut.result()
+        return "fs_read"
 
     async def write(self, write_io: WriteIO) -> None:
         path = os.path.join(self.root, write_io.path)
@@ -316,6 +406,7 @@ class FSStoragePlugin(StoragePlugin):
             path,
             write_io.buf,
             getattr(write_io, "durable", False),
+            write_io,
         )
 
     async def read(self, read_io: ReadIO) -> None:
@@ -328,6 +419,7 @@ class FSStoragePlugin(StoragePlugin):
             read_io.byte_range,
             read_io.into,
             read_io.want_hash,
+            getattr(read_io, "hash_algo", None),
         )
 
     async def copy_from_sibling(self, src_root: str, path: str) -> bool:

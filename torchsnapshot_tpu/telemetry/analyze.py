@@ -56,6 +56,13 @@ PHASE_GROUPS: Dict[str, frozenset] = {
     "h2d": frozenset({"h2d_dispatch", "h2d_land"}),
     "memory_budget": frozenset({"budget_wait"}),
     "io_concurrency": frozenset({"io_slot_wait"}),
+    # The native data plane's fused phases: native_write_hash is hash+write
+    # in one call and native_read is the parallel pread fan-out — both are
+    # wall spent driving storage, so they classify as storage_io (the
+    # folded-in hash work is exactly what no longer exists as a separate
+    # serialize-group pass).  native_read also matches the _read suffix;
+    # native_write_hash needs the explicit entry.
+    "storage_io": frozenset({"native_write_hash", "native_read"}),
 }
 _STORAGE_SUFFIXES = ("_write", "_read")
 # A wait group only names the limiting resource when it covers at least

@@ -432,6 +432,18 @@ def record_journal_fallback(reason: str) -> None:
     ).inc(reason=reason)
 
 
+def record_native_degraded(reason: str) -> None:
+    """The native data plane lost features (stale libtpusnap.so missing
+    newer symbols, rebuild impossible): the affected fast paths fall back
+    to Python.  One increment per process per reason."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_native_degraded_total",
+        "Native data-plane degradations (stale library, missing symbols)",
+    ).inc(reason=reason)
+
+
 def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
     """One framed payload's in/out byte counts; ratio derives at query
     time as uncompressed_total / compressed_total."""
@@ -482,6 +494,7 @@ DIRECT_METRIC_EVENTS = frozenset(
         "journal.commit",  # record_journal_segment
         "journal.compaction",  # record_journal_compaction
         "journal.fallback",  # record_journal_fallback
+        "native.degraded",  # record_native_degraded
     }
 )
 
