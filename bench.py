@@ -261,6 +261,19 @@ def _install_watchdog() -> None:
 def main() -> None:
     import jax
 
+    # Refuse to bank numbers from an instrumented native library: TSAN/ASAN
+    # slow the data plane 2-20x, so any wall/phase measurement under
+    # TPUSNAP_NATIVE_SANITIZE would poison the BENCH_r* trajectory.
+    from torchsnapshot_tpu import knobs as _sanitize_knobs
+
+    if _sanitize_knobs.get_native_sanitize():
+        raise SystemExit(
+            "bench.py refuses to run with TPUSNAP_NATIVE_SANITIZE set: "
+            "sanitizer-built native libraries produce meaningless perf "
+            "numbers. Unset it (or TPUSNAP_NATIVE=0 for the pure-Python "
+            "baseline) and re-run."
+        )
+
     # --telemetry: assert the save produced a telemetry sidecar
     # (telemetry/sidecar.py) and embed its summary in the result aux — the
     # CI hook that keeps the observability path exercised end to end.
@@ -282,7 +295,10 @@ def main() -> None:
         from torchsnapshot_tpu.faults import parse_fault_spec
 
         parse_fault_spec(faults_spec)  # fail fast on a typo'd spec
-        os.environ["TPUSNAP_FAULTS"] = faults_spec
+        # Whole-process install, read back by the plugin resolver (and
+        # forwarded to TPU re-runs via argv): an env export, not a config
+        # read — knobs.override_faults would unwind before the bench body.
+        os.environ["TPUSNAP_FAULTS"] = faults_spec  # tpusnap-lint: disable=knob-discipline
         log(f"fault injection enabled: {faults_spec!r}")
 
     _install_watchdog()
@@ -587,8 +603,9 @@ def main() -> None:
     # zstd on a host without the wheel stored RAW bytes, and must take the
     # fallback probe below, not claim the main save measured compression.
     if _compression.resolve(_knobs.get_compression()[0]) != "raw":
+        _codec, _level = _knobs.get_compression()
         compression_probe = {
-            "codec": os.environ["TPUSNAP_COMPRESSION"],
+            "codec": _codec if _level is None else f"{_codec}:{_level}",
             "note": "main save ran compressed (TPUSNAP_COMPRESSION set)",
             "bytes_written": bytes_written,
             "logical_bytes": actual_bytes,

@@ -1,0 +1,35 @@
+"""async-blocking fixtures: blocking calls on the event loop."""
+
+import asyncio
+import subprocess
+import time
+
+import requests
+
+
+async def bad_blocking_loop(path, url):
+    time.sleep(1.0)  # LINT-EXPECT: async-blocking
+    requests.get(url)  # LINT-EXPECT: async-blocking
+    subprocess.run(["true"])  # LINT-EXPECT: async-blocking
+    with open(path) as f:  # LINT-EXPECT: async-blocking
+        return f.read()
+
+
+class Plugin:
+    async def bad_method(self, url):
+        return self._requests.get(url)  # LINT-EXPECT: async-blocking
+
+
+async def ok_patterns(loop, path):
+    await asyncio.sleep(1.0)  # the async way to wait
+
+    def _executor_target():
+        time.sleep(0.1)  # nested sync def: run_in_executor target
+        with open(path) as f:
+            return f.read()
+
+    return await loop.run_in_executor(None, _executor_target)
+
+
+def ok_sync_helper():
+    time.sleep(0.1)  # not on the loop: sync callers may block

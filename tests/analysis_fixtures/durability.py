@@ -1,0 +1,29 @@
+"""durability-discipline fixtures: rename/replace without fsync."""
+
+import os
+
+
+def bad_commit(tmp, path):
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    os.replace(tmp, path)  # LINT-EXPECT: durability-discipline
+
+
+def bad_rename(tmp, path):
+    os.rename(tmp, path)  # LINT-EXPECT: durability-discipline
+
+
+def ok_durable_commit(tmp, path):
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
+def ok_suppressed(tmp, path):
+    # Scratch shuffle, nothing durable here.
+    os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline

@@ -1,0 +1,25 @@
+"""event-taxonomy fixtures: literal Event kinds vs the bridge allowlists."""
+
+import threading
+
+from torchsnapshot_tpu.event import Event
+from torchsnapshot_tpu.event_handlers import log_event
+
+
+def bad_event_kinds():
+    log_event(Event(name="totally.unknown"))  # LINT-EXPECT: event-taxonomy
+    log_event(
+        Event(  # LINT-EXPECT: event-taxonomy
+            name="cas.not_a_real_kind",
+            metadata={},
+        )
+    )
+
+
+def ok_event_kinds(kind):
+    log_event(Event(name="take.start"))  # lifecycle family
+    log_event(Event(name="restore.end", metadata={"ok": True}))
+    log_event(Event(name="cas.dedup"))  # DIRECT_METRIC_EVENTS
+    log_event(Event(name="watchdog.stall"))  # BRIDGED_EVENTS
+    log_event(Event(name=kind))  # dynamic: runtime consistency test's job
+    return threading.Event()  # not a telemetry event at all

@@ -1,0 +1,15 @@
+"""exception-taxonomy fixtures: bare Exception in the storage layer."""
+
+from torchsnapshot_tpu.retry import StorageTransientError
+
+
+def bad_raises(flaky):
+    if flaky:
+        raise Exception("storage hiccup")  # LINT-EXPECT: exception-taxonomy
+    raise BaseException  # LINT-EXPECT: exception-taxonomy
+
+
+def ok_raises(flaky, path):
+    if flaky:
+        raise StorageTransientError("endpoint 503'd; retryable")
+    raise FileNotFoundError(path)  # terminal, specifically typed

@@ -1,0 +1,32 @@
+"""knob-discipline fixtures: TPUSNAP_* env access outside knobs.py."""
+
+import os
+from os import environ
+
+from torchsnapshot_tpu import knobs
+
+_LOCAL_KNOB = "TPUSNAP_" + "CAS"
+
+
+def bad_reads():
+    a = os.environ.get("TPUSNAP_CAS")  # LINT-EXPECT: knob-discipline
+    b = os.getenv("TPUSNAP_NATIVE")  # LINT-EXPECT: knob-discipline
+    c = os.environ["TPUSNAP_METRICS"]  # LINT-EXPECT: knob-discipline
+    d = environ.get("TPUSNAP_JOURNAL")  # LINT-EXPECT: knob-discipline
+    e = os.environ.get(_LOCAL_KNOB)  # LINT-EXPECT: knob-discipline
+    f = os.environ.get(knobs.CAS_ENV_VAR)  # LINT-EXPECT: knob-discipline
+    return a, b, c, d, e, f
+
+
+def bad_writes_and_membership():
+    os.environ["TPUSNAP_CAS"] = "1"  # LINT-EXPECT: knob-discipline
+    os.environ.pop("TPUSNAP_CAS", None)  # LINT-EXPECT: knob-discipline
+    return "TPUSNAP_CAS" in os.environ  # LINT-EXPECT: knob-discipline
+
+
+def ok_patterns():
+    harness = os.environ.get("TPUSNAP_TEST_KEEP_STORE_ADDR")  # test namespace
+    other = os.environ.get("JAX_PLATFORMS")  # not a tpusnap knob
+    accessor = knobs.cas_enabled()  # the blessed route
+    suppressed = os.environ.get("TPUSNAP_CAS")  # tpusnap-lint: disable=knob-discipline
+    return harness, other, accessor, suppressed

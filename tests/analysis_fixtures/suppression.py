@@ -1,0 +1,18 @@
+"""suppression fixtures: valid disables work, unknown rule names flagged."""
+
+import os
+
+
+def suppressed_inline():
+    return os.environ.get("TPUSNAP_CAS")  # tpusnap-lint: disable=knob-discipline
+
+
+def suppressed_line_above():
+    # tpusnap-lint: disable=knob-discipline
+    return os.environ.get("TPUSNAP_NATIVE")
+
+
+def typo_suppression():
+    # The disable names a rule that doesn't exist, so it suppresses
+    # nothing AND is itself a finding.
+    return os.environ.get("TPUSNAP_CAS")  # tpusnap-lint: disable=knob-dissipline  # LINT-EXPECT: knob-discipline,suppression

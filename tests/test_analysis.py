@@ -1,0 +1,268 @@
+"""Tier-1 enforcement + golden tests for the `tpusnap lint` analyzer.
+
+Two halves:
+
+- **Repo gate** — every rule over the whole repository must report zero
+  findings (the tier-1 complement of the CLI exit code): a new violation
+  anywhere fails CI here, with the finding text in the assertion.
+- **Golden fixtures** — each rule must fire on its seeded violations in
+  ``tests/analysis_fixtures/`` (lines marked ``# LINT-EXPECT: <rules>``)
+  and stay silent everywhere else in the same file, proving both the
+  trigger and the no-trigger half of each rule.  Suppression comments and
+  the unknown-rule-in-suppression finding are covered by the fixtures
+  too.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from torchsnapshot_tpu._analysis import core
+from torchsnapshot_tpu._analysis.rules_knobs import KnobDocsRule
+from torchsnapshot_tpu._analysis.rules_native import NativeAbiRule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analysis_fixtures")
+
+_EXPECT_RE = re.compile(r"#\s*LINT-EXPECT:\s*([A-Za-z0-9_,\- ]+)")
+
+
+# ------------------------------------------------------------- repo gate
+
+
+def test_repo_is_lint_clean():
+    """The whole repository passes every rule — the tier-1 gate the
+    `tpusnap lint` CLI exit code mirrors."""
+    findings = core.lint_project(REPO_ROOT)
+    assert findings == [], "tpusnap lint found violations:\n" + "\n".join(
+        str(f) for f in findings
+    )
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    from torchsnapshot_tpu.__main__ import main
+
+    assert main(["lint", REPO_ROOT]) == 0
+    capsys.readouterr()
+
+    # A seeded violation must flip the exit code.
+    (tmp_path / "pyproject.toml").write_text("")
+    (tmp_path / "bad.py").write_text(
+        'import os\nv = os.environ.get("TPUSNAP_CAS")\n'
+    )
+    assert main(["lint", str(tmp_path), "--rules", "knob-discipline"]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:2" in out and "knob-discipline" in out
+
+
+def test_fixture_dir_is_excluded_from_repo_walk():
+    """The deliberate violations must never leak into the repo lint."""
+    rels = [rel for _, rel in core.iter_python_files(REPO_ROOT)]
+    assert not any("analysis_fixtures" in rel for rel in rels)
+    assert "torchsnapshot_tpu/knobs.py" in rels
+    assert "bench.py" in rels
+
+
+# -------------------------------------------------------- golden fixtures
+
+
+def _expected_findings(source: str):
+    expected = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _EXPECT_RE.search(line)
+        if m:
+            for rule in m.group(1).split(","):
+                expected.add((rule.strip(), lineno))
+    return expected
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    [
+        "knob_discipline.py",
+        "event_taxonomy.py",
+        "phase_registry.py",
+        "durability.py",
+        "async_blocking.py",
+        "exception_taxonomy.py",
+        "suppression.py",
+    ],
+)
+def test_fixture_golden(fixture):
+    """Each rule fires exactly on its marked lines and nowhere else in
+    the fixture — trigger and no-trigger halves in one assertion."""
+    path = os.path.join(FIXTURES, fixture)
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    expected = _expected_findings(source)
+    assert expected, f"{fixture} has no LINT-EXPECT markers"
+    findings = core.lint_sources({fixture: source}, core.all_rules())
+    actual = {(f.rule, f.line) for f in findings}
+    assert actual == expected, (
+        f"{fixture}: findings mismatch\n"
+        f"  unexpected: {sorted(actual - expected)}\n"
+        f"  missing:    {sorted(expected - actual)}\n"
+        "  all: " + "\n  ".join(str(f) for f in findings)
+    )
+
+
+def test_suppression_silences_and_typo_is_flagged():
+    """Direct (non-golden) statement of the suppression contract: a valid
+    disable produces no finding, an unknown rule name is itself one."""
+    src_ok = (
+        "import os\n"
+        'v = os.environ.get("TPUSNAP_CAS")  # tpusnap-lint: disable=knob-discipline\n'
+    )
+    assert core.lint_sources({"s.py": src_ok}, core.all_rules()) == []
+
+    # Concatenated so the repo-wide suppression scanner (which reads raw
+    # lines, string literals included) doesn't see a disable in THIS file.
+    src_typo = (
+        "import os\n"
+        'v = os.environ.get("TPUSNAP_CAS")  # tpusnap-lint: '
+        "disable=knob-dicsipline\n"
+    )
+    findings = core.lint_sources({"s.py": src_typo}, core.all_rules())
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["knob-discipline", "suppression"], findings
+
+
+def test_parse_error_is_a_finding():
+    findings = core.lint_sources({"broken.py": "def f(:\n"}, core.all_rules())
+    assert [f.rule for f in findings] == ["parse-error"]
+    assert findings[0].path == "broken.py"
+
+
+# ------------------------------------------------- project-level cross-checks
+
+
+def _write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+
+
+def test_knob_docs_bidirectional(tmp_path):
+    _write(
+        tmp_path,
+        "torchsnapshot_tpu/knobs.py",
+        '_P = "TPUSNAP_"\n'
+        'FOO_ENV_VAR = _P + "FOO"\n'
+        'BAR_ENV_VAR = "TPUSNAP_BAR"\n',
+    )
+    _write(
+        tmp_path,
+        "docs/knobs.md",
+        "| `TPUSNAP_FOO` | on | documented |\n"
+        "| `TPUSNAP_GHOST` | ? | documented but unregistered |\n",
+    )
+    project = core.Project(root=str(tmp_path), modules=[])
+    findings = list(KnobDocsRule().project_check(project))
+    by_rule = {(f.path, "TPUSNAP_BAR" in f.message, "TPUSNAP_GHOST" in f.message) for f in findings}
+    assert len(findings) == 2, findings
+    assert ("torchsnapshot_tpu/knobs.py", True, False) in by_rule  # undocumented
+    assert ("docs/knobs.md", False, True) in by_rule  # ghost knob
+
+
+def test_knob_docs_clean_when_in_sync(tmp_path):
+    _write(tmp_path, "torchsnapshot_tpu/knobs.py", 'FOO_ENV_VAR = "TPUSNAP_FOO"\n')
+    _write(tmp_path, "docs/knobs.md", "`TPUSNAP_FOO` documented here\n")
+    project = core.Project(root=str(tmp_path), modules=[])
+    assert list(KnobDocsRule().project_check(project)) == []
+
+
+_CC_TEMPLATE = """\
+#include <stdint.h>
+extern "C" {
+int tpusnap_abi_version() { return %(abi)s; }
+int %(sym)s(const char* path) { return 0; }
+}  // extern "C"
+"""
+
+_PY_TEMPLATE = """\
+NATIVE_ABI_VERSION = %(abi)s
+class N:
+    def bind(self, lib):
+        lib.tpusnap_abi_version
+        fn = lib.%(sym)s
+"""
+
+
+def test_native_abi_detects_drift(tmp_path):
+    """A symbol exported but unprobed (and vice-versa) and an ABI-number
+    mismatch are each findings — the acceptance-criterion drift case."""
+    _write(
+        tmp_path,
+        "torchsnapshot_tpu/_native/tpustore.cc",
+        _CC_TEMPLATE % {"abi": "2", "sym": "tpusnap_only_in_cc"},
+    )
+    _write(
+        tmp_path,
+        "torchsnapshot_tpu/native_io.py",
+        _PY_TEMPLATE % {"abi": "1", "sym": "tpusnap_only_in_python"},
+    )
+    project = core.Project(root=str(tmp_path), modules=[])
+    findings = list(NativeAbiRule().project_check(project))
+    messages = "\n".join(f.message for f in findings)
+    assert len(findings) == 3, findings
+    assert "tpusnap_only_in_cc" in messages
+    assert "tpusnap_only_in_python" in messages
+    assert "NATIVE_ABI_VERSION=1" in messages
+
+
+def test_native_abi_clean_when_in_sync(tmp_path):
+    _write(
+        tmp_path,
+        "torchsnapshot_tpu/_native/tpustore.cc",
+        _CC_TEMPLATE % {"abi": "1", "sym": "tpusnap_shared"},
+    )
+    _write(
+        tmp_path,
+        "torchsnapshot_tpu/native_io.py",
+        _PY_TEMPLATE % {"abi": "1", "sym": "tpusnap_shared"},
+    )
+    project = core.Project(root=str(tmp_path), modules=[])
+    assert list(NativeAbiRule().project_check(project)) == []
+
+
+def test_native_abi_repo_contract():
+    """On the real tree: every exported symbol is probed, every probed
+    symbol exists, ABI constants agree (parsed, not imported)."""
+    from torchsnapshot_tpu._analysis.rules_native import (
+        exported_symbols,
+        probed_symbols,
+    )
+    from torchsnapshot_tpu.native_io import NATIVE_ABI_VERSION
+
+    with open(
+        os.path.join(REPO_ROOT, "torchsnapshot_tpu/_native/tpustore.cc")
+    ) as f:
+        cc = f.read()
+    with open(os.path.join(REPO_ROOT, "torchsnapshot_tpu/native_io.py")) as f:
+        py = f.read()
+    exported = set(exported_symbols(cc))
+    probed = set(probed_symbols(py))
+    assert exported, "no exported symbols parsed from tpustore.cc"
+    assert exported == probed, (exported - probed, probed - exported)
+    m = re.search(r"int\s+tpusnap_abi_version\s*\(\s*\)\s*\{\s*return\s+(\d+)", cc)
+    assert m and int(m.group(1)) == NATIVE_ABI_VERSION
+
+
+# ----------------------------------------------------------------- external
+
+
+def test_external_tools_skip_gracefully(tmp_path):
+    """--external must never fail because ruff/mypy aren't installed; on a
+    root without pyproject.toml it skips wholesale."""
+    from torchsnapshot_tpu._analysis.external import run_external
+
+    results = run_external(str(tmp_path))
+    assert all(r.ok for r in results)
+
+    results = run_external(REPO_ROOT)
+    for r in results:
+        # Installed -> must pass on our tree; missing -> skipped cleanly.
+        assert r.ok, f"{r.tool} failed:\n{r.output}"

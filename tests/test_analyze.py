@@ -7,7 +7,6 @@ CLI must exit nonzero on schema-invalid trace input.
 """
 
 import json
-import os
 
 import pytest
 

@@ -1,13 +1,37 @@
 """Async-snapshot safety: after async_take returns, the caller may mutate
 host arrays and donate/overwrite device buffers without corrupting the
 snapshot (the reference's defensive-copy contract, tensor.py:283-307; our
-contract is staging-complete-before-return, SURVEY.md §3.2)."""
+contract is staging-complete-before-return, SURVEY.md §3.2).
+
+The "no blocking I/O on the scheduler loop" invariant is split in two
+since the analyzer landed: the STATIC half (every blocking call lexically
+inside an `async def`) is the `async-blocking` lint rule
+(torchsnapshot_tpu/_analysis/rules_async.py) — exercised here as a rule
+client over the whole package instead of ad-hoc per-call assertions — and
+ONE runtime smoke test (test_async_take_not_blocked_by_slow_storage)
+keeps proving the early-return behavior end to end."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from torchsnapshot_tpu import Snapshot, StateDict
+
+
+def test_scheduler_loop_statically_free_of_blocking_calls():
+    """Lint-rule client: the async-blocking analyzer rule over every
+    package module must be clean — the static complement of the runtime
+    smoke below (which only proves one plugin's path on one save)."""
+    import os
+
+    from torchsnapshot_tpu._analysis import core
+    from torchsnapshot_tpu._analysis.rules_async import AsyncBlockingRule
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = core.lint_project(repo_root, rules=[AsyncBlockingRule()])
+    assert findings == [], "blocking calls on the asyncio loop:\n" + "\n".join(
+        str(f) for f in findings
+    )
 
 
 def test_host_mutation_after_async_take(tmp_path):

@@ -266,8 +266,6 @@ def test_verify_reports_missing_shared_chunk_once_naming_referrers(
 
 @needs_native
 def test_incremental_from_delegates_to_cas_index(tmp_path):
-    from torchsnapshot_tpu.incremental import IncrementalStoragePlugin
-
     root = str(tmp_path / "ckpts")
     with knobs.override_cas(True), knobs.override_batching_disabled(True):
         Snapshot.take(f"{root}/step_1", _state(1))

@@ -802,7 +802,9 @@ def _scale16_lock_storm_body():
     not just the 1-process unit test above."""
     from torchsnapshot_tpu.dist_store import FileStore
 
-    rank = int(os.environ["TPUSNAP_RANK"])
+    from torchsnapshot_tpu import knobs
+
+    rank = knobs.get_env_rank()
     store_path = os.environ["TPUSNAP_TEST_STORM_PATH"]
     store = FileStore(store_path, lock_stale_s=1.0)
     if rank == 0:
@@ -846,7 +848,9 @@ def _get_state_dict_for_key_rank_body():
 
     pg = make_test_pg()
     rank = pg.get_rank()
-    snap_dir = os.path.join(os.environ["TPUSNAP_STORE_PATH"], "snap")
+    from torchsnapshot_tpu import knobs
+
+    snap_dir = os.path.join(knobs.get_store_path(), "snap")
     # Rank-private (non-replicated, non-sharded) values differ per rank.
     app = {"m": StateDict({"rank_value": np.full(8, float(rank))})}
     snapshot = Snapshot.take(snap_dir, app, pg=pg)

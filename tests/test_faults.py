@@ -6,7 +6,6 @@ tear down (or leave GC-able) partial snapshot dirs, the `gc` CLI, and the
 barrier-timeout knob with peer-error propagation.
 """
 
-import os
 import threading
 import time
 

@@ -4,10 +4,9 @@ tests/test_flatten.py:15-29)."""
 from collections import OrderedDict
 
 import numpy as np
-import pytest
 
 from torchsnapshot_tpu.flatten import flatten, inflate
-from torchsnapshot_tpu.manifest import DictEntry, ListEntry, OrderedDictEntry
+from torchsnapshot_tpu.manifest import DictEntry
 
 
 def test_roundtrip_nested():

@@ -4,7 +4,6 @@ import jax
 import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
-import pytest
 
 from torchsnapshot_tpu import io_preparer, knobs
 from torchsnapshot_tpu.manifest import (

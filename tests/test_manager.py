@@ -1,6 +1,5 @@
 """SnapshotManager: step discovery, retention, resume."""
 
-import os
 
 import numpy as np
 import pytest

@@ -4,7 +4,6 @@ tests/test_manifest.py:638-702)."""
 import json
 
 from torchsnapshot_tpu.manifest import (
-    Chunk,
     ChunkedTensorEntry,
     DictEntry,
     ListEntry,

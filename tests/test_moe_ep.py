@@ -70,8 +70,6 @@ def test_expert_stacked_arrays_roundtrip_and_reshard(tmp_path):
 def test_per_rank_expert_subtree_ownership():
     """EP style (b): each rank owns a disjoint expert subtree under its rank
     namespace; restore hands every rank its own experts back."""
-    import os
-
     from torchsnapshot_tpu.test_utils import make_test_pg, run_with_procs
 
     @run_with_procs(nproc=4)

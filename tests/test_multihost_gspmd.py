@@ -15,7 +15,6 @@ import sys
 import tempfile
 import traceback
 
-import pytest
 
 SNAP_PATH = "/tmp/tpusnap_multihost_test/snap"
 
@@ -30,7 +29,8 @@ def _worker(rank: int, world: int, coord_port: int, store_path: str, conn) -> No
     try:
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["TPUSNAP_STORE_PATH"] = store_path
+        # Launcher-side export for this worker process (read back via knobs).
+        os.environ["TPUSNAP_STORE_PATH"] = store_path  # tpusnap-lint: disable=knob-discipline
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -39,7 +39,6 @@ def _worker(rank: int, world: int, coord_port: int, store_path: str, conn) -> No
             num_processes=world,
             process_id=rank,
         )
-        import jax.numpy as jnp
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -169,7 +168,8 @@ def _hsdp_worker(rank: int, world: int, coord_port: int, store_path: str, conn) 
     try:
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
         os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["TPUSNAP_STORE_PATH"] = store_path
+        # Launcher-side export for this worker process (read back via knobs).
+        os.environ["TPUSNAP_STORE_PATH"] = store_path  # tpusnap-lint: disable=knob-discipline
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -178,7 +178,6 @@ def _hsdp_worker(rank: int, world: int, coord_port: int, store_path: str, conn) 
             num_processes=world,
             process_id=rank,
         )
-        import jax.numpy as jnp
         import numpy as np
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

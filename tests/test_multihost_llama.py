@@ -52,7 +52,6 @@ def _worker(rank: int, world: int, coord_port: int, store_path: str, conn) -> No
             shard_train_state,
         )
         from torchsnapshot_tpu.pg_wrapper import PGWrapper
-        from torchsnapshot_tpu.test_utils import check_state_dict_eq
 
         devices = jax.devices()
         assert len(devices) == 4

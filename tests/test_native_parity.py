@@ -9,7 +9,6 @@ pure-Python computation routes can never disagree.
 """
 
 import hashlib
-import json
 import os
 
 import numpy as np

@@ -125,7 +125,6 @@ def test_pickle_fallback():
 
 
 def test_jax_array_to_host_codec():
-    import jax
     import jax.numpy as jnp
 
     x = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4)

@@ -204,7 +204,6 @@ def test_sharded_entry_dropped_when_unrequested_e2e(tmp_path):
     """Restoring into a target without the sharded array drops it silently
     (reference handle_sharded_tensor_elasticity semantics: a sharded entry
     needs a target to define local shards); other leaves restore fine."""
-    import jax as _jax
     from torchsnapshot_tpu import Snapshot, StateDict
 
     sharding = NamedSharding(_mesh((8,), ("x",)), P("x", None))

@@ -4,7 +4,6 @@ tracer (schema-validated trace-event JSON), per-snapshot sidecars, the
 stats/trace CLI, and the phase_stats raw-add wall clamp."""
 
 import json
-import os
 
 import jax.numpy as jnp
 import numpy as np

@@ -87,9 +87,11 @@ def _tcpstore_pg_body():
     from torchsnapshot_tpu.pg_wrapper import PGWrapper
     from torchsnapshot_tpu.tpustore import TCPStore, TCPStoreServer
 
-    rank = int(os.environ["TPUSNAP_RANK"])
-    world_size = int(os.environ["TPUSNAP_WORLD_SIZE"])
-    bootstrap = FileStore(os.environ["TPUSNAP_STORE_PATH"])
+    from torchsnapshot_tpu import knobs
+
+    rank = knobs.get_env_rank()
+    world_size = knobs.get_env_world_size()
+    bootstrap = FileStore(knobs.get_store_path())
     if rank == 0:
         server = TCPStoreServer()
         bootstrap.set("addr", f"127.0.0.1:{server.port}".encode())
@@ -290,8 +292,7 @@ def test_native_worker_pool_configured():
         import pytest
 
         pytest.skip("pool symbols unavailable (stale library)")
-    io._lib.tpusnap_pool_size.restype = __import__("ctypes").c_int
-    size = io._lib.tpusnap_pool_size()
+    size = io.pool_size()
     assert 2 <= size <= 16
 
 

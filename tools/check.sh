@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# The one gate script: everything CI (or a pre-push hook) needs to trust a
+# change.  Ordered cheap-to-expensive so the common failure is fast:
+#
+#   1. tpusnap lint            — project-invariant static analysis (always)
+#   2. tpusnap lint --external — ruff + mypy when installed (skip = ok)
+#   3. tier-1 pytest           — the ROADMAP verify suite (not slow-marked)
+#   4. sanitizer smoke         — TSAN race-regression legs, only when the
+#                                toolchain can build+host the instrumented
+#                                library (the suite itself skips otherwise)
+#
+# Usage: tools/check.sh [--fast]   (--fast = lint tiers only, no pytest)
+
+set -u -o pipefail
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+fail=0
+step() { printf '\n=== %s ===\n' "$*"; }
+
+step "tpusnap lint"
+python -m torchsnapshot_tpu lint "$REPO_ROOT" || fail=1
+
+step "tpusnap lint --external (ruff + mypy; missing tools skip)"
+python -m torchsnapshot_tpu lint "$REPO_ROOT" --external || fail=1
+
+if [ "${1:-}" = "--fast" ]; then
+  [ "$fail" -eq 0 ] && echo "check.sh --fast: OK" || echo "check.sh --fast: FAILED"
+  exit "$fail"
+fi
+
+step "tier-1 pytest (-m 'not slow')"
+timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider || fail=1
+
+# Sanitizer smoke: only worth the build when the compiler supports
+# -fsanitize=thread; the suite itself still skips per-test when the
+# runtime can't host the instrumented library.
+step "sanitizer smoke (tsan race-regression legs)"
+if printf 'int main(){return 0;}' | g++ -x c++ -fsanitize=thread - -o /tmp/tsan_probe.$$ 2>/dev/null; then
+  rm -f "/tmp/tsan_probe.$$"
+  timeout -k 10 900 python -m pytest tests/test_native_sanitize.py -q \
+    -p no:cacheprovider -k "tsan" || fail=1
+else
+  echo "toolchain lacks -fsanitize=thread; skipped"
+fi
+
+if [ "$fail" -eq 0 ]; then echo "check.sh: OK"; else echo "check.sh: FAILED"; fi
+exit "$fail"

@@ -13,6 +13,7 @@
     python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
     python -m torchsnapshot_tpu analyze <trace-dir> [--snapshot URL] [--json]
     python -m torchsnapshot_tpu history <manager-root-url> [--json]
+    python -m torchsnapshot_tpu lint [root] [--external] [--json]
 
 Read-only except ``cp`` and ``gc --apply``; works against any storage
 backend URL.  (Beyond reference parity: the reference ships no CLI.)
@@ -666,6 +667,10 @@ def cmd_history(args: argparse.Namespace) -> int:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    from ._analysis.cli import add_lint_parser
+
+    add_lint_parser(sub)
 
     p = sub.add_parser("info", help="snapshot summary")
     p.add_argument("path")

@@ -1,0 +1,21 @@
+"""Project-invariant static analysis (``tpusnap lint``).
+
+The repo's cross-cutting invariants — knob discipline, the event taxonomy,
+the phase registry, the tmp+fsync+rename commit pattern, no blocking calls
+on the asyncio scheduler loop, the shared exception taxonomy, and the
+native ABI's symbol contract — are machine-checked here instead of living
+in reviewer memory.  One AST visitor per rule over a shared file walker,
+structured ``file:line`` findings, per-line suppression via
+``# tpusnap-lint: disable=<rule>``; surfaced as the ``tpusnap lint`` CLI
+subcommand and enforced repo-wide by a tier-1 test
+(tests/test_analysis.py).  Rule catalog: docs/static_analysis.md.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    all_rules,
+    lint_project,
+    lint_sources,
+    rule_names,
+)

@@ -1,0 +1,126 @@
+"""``tpusnap lint`` subcommand implementation.
+
+Exit codes: 0 clean, 1 findings (in-tree rules or an external tool), 2
+usage/internal error.  ``--json`` emits a machine-readable document for CI
+annotation; ``--external`` additionally runs ruff + mypy (skipping
+gracefully when not installed — see external.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from . import core
+from .external import run_external
+
+
+def add_lint_parser(sub) -> None:
+    p = sub.add_parser(
+        "lint",
+        help="run the project-invariant static analysis suite",
+        description=(
+            "AST-checks the repo's cross-cutting invariants (knob "
+            "discipline, event/phase taxonomies, tmp+fsync+rename, "
+            "async-blocking, exception taxonomy, native ABI drift). "
+            "Rule catalog: docs/static_analysis.md."
+        ),
+    )
+    p.add_argument(
+        "root",
+        nargs="?",
+        default=None,
+        help="project root to lint (default: the repo this package lives in)",
+    )
+    p.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated subset of rules to run (see --list-rules)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "--external",
+        action="store_true",
+        help="also run ruff + mypy (skipped when not installed)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.set_defaults(fn=cmd_lint)
+
+
+def cmd_lint(args: argparse.Namespace) -> int:
+    rules = core.all_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.name}: {rule.description}")
+        return 0
+    if args.rules:
+        wanted = {name.strip() for name in args.rules.split(",") if name.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(known: {', '.join(core.rule_names())})"
+            )
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+    root = os.path.abspath(args.root) if args.root else core.find_project_root()
+    if not os.path.isdir(root):
+        print(f"not a directory: {root}")
+        return 2
+
+    findings = core.lint_project(root, rules=rules)
+    externals = run_external(root) if args.external else []
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "root": root,
+                    "findings": [
+                        {
+                            "rule": f.rule,
+                            "path": f.path,
+                            "line": f.line,
+                            "message": f.message,
+                        }
+                        for f in findings
+                    ],
+                    "external": [
+                        {
+                            "tool": e.tool,
+                            "skipped": e.skipped,
+                            "returncode": e.returncode,
+                            "output": e.output,
+                        }
+                        for e in externals
+                    ],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(str(f))
+        for e in externals:
+            status = (
+                "skipped"
+                if e.skipped
+                else ("ok" if e.returncode == 0 else f"exit {e.returncode}")
+            )
+            print(f"external {e.tool}: {status}")
+            if not e.skipped and e.returncode != 0 and e.output:
+                print(e.output)
+        n_files = _count_files(root)
+        print(
+            f"tpusnap lint: {len(findings)} finding(s) over {n_files} "
+            f"file(s), {len(rules)} rule(s)"
+            + (" + external tools" if externals else "")
+        )
+    bad_external = any(not e.ok for e in externals)
+    return 1 if findings or bad_external else 0
+
+
+def _count_files(root: str) -> int:
+    return sum(1 for _ in core.iter_python_files(root))

@@ -1,0 +1,92 @@
+"""External linters behind ``tpusnap lint --external``: ruff + mypy.
+
+Both are optional — the container image may not ship them.  A missing
+tool is reported as SKIPPED (exit stays clean): the project invariants are
+the in-tree rules' job; ruff/mypy add the generic syntax/undefined-name/
+unused-import and typing tiers when available, configured from
+pyproject.toml ([tool.ruff]/[tool.mypy]) so CI, editors, and the lint
+subcommand agree on one baseline.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+
+@dataclass
+class ExternalResult:
+    tool: str
+    skipped: bool
+    returncode: int
+    output: str
+
+    @property
+    def ok(self) -> bool:
+        return self.skipped or self.returncode == 0
+
+
+def _run(cmd: Sequence[str], cwd: str, timeout: int = 600) -> Optional[
+    "subprocess.CompletedProcess[str]"
+]:
+    try:
+        return subprocess.run(
+            list(cmd),
+            cwd=cwd,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def _tool_cmd(tool: str) -> Optional[List[str]]:
+    """Prefer the console script, fall back to ``python -m``; None when
+    neither exists."""
+    import importlib.util
+    import shutil
+
+    script = shutil.which(tool)
+    if script:
+        return [script]
+    if importlib.util.find_spec(tool) is not None:
+        return [sys.executable, "-m", tool]
+    return None
+
+
+def run_ruff(root: str) -> ExternalResult:
+    cmd = _tool_cmd("ruff")
+    if cmd is None:
+        return ExternalResult("ruff", True, 0, "ruff not installed; skipped")
+    proc = _run(cmd + ["check", "."], cwd=root)
+    if proc is None:
+        return ExternalResult("ruff", True, 0, "ruff failed to launch; skipped")
+    return ExternalResult(
+        "ruff", False, proc.returncode, (proc.stdout + proc.stderr).strip()
+    )
+
+
+def run_mypy(root: str) -> ExternalResult:
+    cmd = _tool_cmd("mypy")
+    if cmd is None:
+        return ExternalResult("mypy", True, 0, "mypy not installed; skipped")
+    proc = _run(cmd + ["torchsnapshot_tpu"], cwd=root)
+    if proc is None:
+        return ExternalResult("mypy", True, 0, "mypy failed to launch; skipped")
+    return ExternalResult(
+        "mypy", False, proc.returncode, (proc.stdout + proc.stderr).strip()
+    )
+
+
+def run_external(root: str) -> List[ExternalResult]:
+    if not os.path.exists(os.path.join(root, "pyproject.toml")):
+        return [
+            ExternalResult(
+                "external", True, 0, "no pyproject.toml at root; skipped"
+            )
+        ]
+    return [run_ruff(root), run_mypy(root)]

@@ -1,0 +1,106 @@
+"""Async-blocking rule: no blocking calls on the asyncio scheduler loop.
+
+The write/read pipelines run on one event loop per operation; a blocking
+call inside an ``async def`` parks every in-flight pipeline behind it
+(stalls the scheduler's semaphores, starves the progress reporters, and —
+under the watchdog — eventually fingerprints as a stall).  Blocking work
+belongs in ``run_in_executor`` / the native data plane.
+
+The check is lexical: calls whose NEAREST enclosing function is an
+``async def`` are matched against a blocklist.  A nested synchronous
+``def`` inside an async function is exempt — that's precisely the
+run_in_executor-target idiom the scheduler and plugins use.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .core import Finding, ModuleFile, Rule, dotted_name, in_package
+
+# Fully-matched dotted chains (after normalizing away self./cls. and a
+# leading underscore on the first segment, so `self._requests.get` is seen
+# as requests.get).
+_BLOCKED_EXACT = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "os.system": "use run_in_executor (or asyncio.create_subprocess_*)",
+    "socket.create_connection": "use loop.sock_connect / run_in_executor",
+}
+# Any call rooted at these modules blocks (HTTP and child processes).
+_BLOCKED_ROOTS = {
+    "requests": "route HTTP through run_in_executor (see gcs/s3 plugins)",
+    "subprocess": "use asyncio.create_subprocess_* or run_in_executor",
+}
+_OPEN_HINT = (
+    "synchronous file I/O on the event loop: open/read/write via "
+    "run_in_executor or the native data plane"
+)
+
+
+def _normalize(chain: str) -> str:
+    parts = chain.split(".")
+    if parts and parts[0] in ("self", "cls") and len(parts) > 1:
+        parts = parts[1:]
+    if parts:
+        parts[0] = parts[0].lstrip("_") or parts[0]
+    return ".".join(parts)
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = (
+        "Blocking calls (time.sleep, requests.*, subprocess.*, builtin "
+        "open) lexically inside `async def` bodies stall the scheduler "
+        "loop; route them through run_in_executor."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    def _blocked(self, node: ast.Call) -> Optional[str]:
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "open":
+            return _OPEN_HINT
+        chain = dotted_name(func)
+        if chain is None:
+            return None
+        chain = _normalize(chain)
+        if chain in _BLOCKED_EXACT:
+            return f"blocking call {chain}: {_BLOCKED_EXACT[chain]}"
+        root = chain.split(".", 1)[0]
+        if root in _BLOCKED_ROOTS:
+            return f"blocking call {chain}: {_BLOCKED_ROOTS[root]}"
+        return None
+
+    def _scan_async_body(
+        self, owner: ast.AsyncFunctionDef
+    ) -> Iterable[Tuple[ast.Call, str]]:
+        """Calls whose nearest enclosing function is ``owner`` itself —
+        nested sync defs (executor targets) and nested async defs (visited
+        on their own) are skipped."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(owner))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                hint = self._blocked(node)
+                if hint is not None:
+                    yield node, hint
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for owner in ast.walk(module.tree):
+            if not isinstance(owner, ast.AsyncFunctionDef):
+                continue
+            for node, hint in self._scan_async_body(owner):
+                yield Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=node.lineno,
+                    message=f"in `async def {owner.name}`: {hint}",
+                )

@@ -1,0 +1,77 @@
+"""Durability discipline: the tmp+fsync+rename commit pattern (PR 3).
+
+A rename that publishes un-fsynced bytes can surface a zero-length or torn
+file after a host crash — the exact bug class the durable-commit work
+removed from the storage layer.  The check is lexical: an
+``os.rename``/``os.replace`` call is flagged unless an fsync happens
+earlier in the same function body.  Renames that genuinely don't need
+durability (telemetry sidecars, lock-file shuffling) carry a suppression
+naming why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import Finding, ModuleFile, Rule, dotted_name, in_package
+
+_RENAME_FUNCS = {"os.rename", "os.replace"}
+# What counts as "an fsync happened": a direct os.fsync/os.fdatasync, or a
+# call into a helper whose name declares the durable contract (the fs
+# plugin's `durable` flag plumbing).
+_FSYNC_MARKERS = ("fsync", "fdatasync", "durable")
+
+
+class DurabilityRule(Rule):
+    name = "durability-discipline"
+    description = (
+        "os.rename/os.replace publishing a file must be preceded by an "
+        "fsync in the same function body (tmp+fsync+rename): renaming "
+        "un-synced bytes can publish a torn file after a crash."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    def _fsync_lines(self, fn: ast.AST) -> List[int]:
+        lines = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func) or ""
+            leaf = chain.rsplit(".", 1)[-1]
+            if any(marker in leaf for marker in _FSYNC_MARKERS):
+                lines.append(node.lineno)
+        return lines
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            renames = [
+                node
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Call)
+                and dotted_name(node.func) in _RENAME_FUNCS
+            ]
+            if not renames:
+                continue
+            fsyncs = self._fsync_lines(fn)
+            for node in renames:
+                if any(line < node.lineno for line in fsyncs):
+                    continue
+                func_name = dotted_name(node.func)
+                yield Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=node.lineno,
+                    message=(
+                        f"{func_name} in {fn.name}() without a preceding "
+                        "fsync in the same function: a crash can publish a "
+                        "torn file — follow tmp+fsync+rename, or suppress "
+                        "with a comment naming why durability is not "
+                        "required here"
+                    ),
+                )

@@ -1,0 +1,130 @@
+"""Event-taxonomy and phase-registry rules: every literal event kind and
+phase name the package emits must be known to the telemetry layer.
+
+Static complement of the runtime consistency test (tests/test_telemetry.py
+cross-checks events actually EMITTED during a test run against the bridge's
+allowlists); these rules catch the literal at its source even on paths no
+tier-1 test drives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Finding, ModuleFile, Rule, dotted_name, in_package
+
+
+def _bridge_sets():
+    from ..telemetry.metrics import (
+        BRIDGED_EVENT_SUFFIXES,
+        BRIDGED_EVENTS,
+        DIRECT_METRIC_EVENTS,
+    )
+
+    return BRIDGED_EVENTS | DIRECT_METRIC_EVENTS, tuple(BRIDGED_EVENT_SUFFIXES)
+
+
+class EventTaxonomyRule(Rule):
+    name = "event-taxonomy"
+    description = (
+        "Every string literal passed as an Event kind (Event(name=...)) "
+        "is covered by the metrics bridge: a lifecycle '<action>.start/"
+        "<action>.end' pair, BRIDGED_EVENTS, or DIRECT_METRIC_EVENTS — an "
+        "unknown kind would bypass metrics silently."
+    )
+
+    def __init__(self) -> None:
+        self._known, self._suffixes = _bridge_sets()
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    def _event_name(self, node: ast.Call) -> Optional[ast.Constant]:
+        func = node.func
+        is_event = (isinstance(func, ast.Name) and func.id == "Event") or (
+            isinstance(func, ast.Attribute) and func.attr == "Event"
+        )
+        if not is_event:
+            return None
+        # threading.Event() takes no arguments; the telemetry Event always
+        # carries name= (or a leading positional) — only literal kinds are
+        # checkable statically.
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    return kw.value
+        if node.args and isinstance(node.args[0], ast.Constant):
+            if isinstance(node.args[0].value, str):
+                return node.args[0]
+        return None
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            const = self._event_name(node)
+            if const is None:
+                continue
+            kind = const.value
+            if kind in self._known or kind.endswith(self._suffixes):
+                continue
+            yield Finding(
+                rule=self.name,
+                path=module.rel,
+                line=node.lineno,
+                message=(
+                    f"event kind {kind!r} is not in the metrics bridge's "
+                    "taxonomy: add it to BRIDGED_EVENTS or "
+                    "DIRECT_METRIC_EVENTS (telemetry/metrics.py) or use a "
+                    "'<action>.start'/'<action>.end' lifecycle pair"
+                ),
+            )
+
+
+class PhaseRegistryRule(Rule):
+    name = "phase-registry"
+    description = (
+        "Every literal phase name passed to phase_stats.timed/add "
+        "classifies into a resource group in analyze.py's PHASE_GROUPS "
+        "(or matches the _write/_read storage suffix) — an unclassified "
+        "phase lands in 'other' and breaks bottleneck attribution."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    def _classify(self, phase: str) -> str:
+        from ..telemetry.analyze import classify_phase
+
+        return classify_phase(phase)
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            if chain is None or not chain.endswith(
+                ("phase_stats.timed", "phase_stats.add")
+            ):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue  # dynamic phase names are covered at runtime
+            if self._classify(arg.value) != "other":
+                continue
+            yield Finding(
+                rule=self.name,
+                path=module.rel,
+                line=node.lineno,
+                message=(
+                    f"phase {arg.value!r} is unclassified: add it to "
+                    "PHASE_GROUPS in telemetry/analyze.py (or name it with "
+                    "a _write/_read suffix for storage phases) so analyze "
+                    "attributes it to a resource group"
+                ),
+            )

@@ -1,0 +1,66 @@
+"""Exception-taxonomy rule: the storage layer and the scheduler classify
+failures through retry.py's transient/terminal taxonomy.
+
+A bare ``raise Exception(...)`` there is unclassifiable: retry.is_transient
+treats unknown errors as terminal, so a transient condition raised as plain
+Exception silently loses its retries, and a terminal one raised as
+StorageTransientError would spin the budget.  Raisers must pick a typed
+error — ``StorageTransientError`` (or a subclass) for retryable
+conditions, a specific builtin/domain exception for terminal ones.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Finding, ModuleFile, Rule
+
+# Where the taxonomy is load-bearing: every plugin the retry layers wrap,
+# the plugin resolver, the pipeline scheduler, and the fault injector
+# (whose raised kinds the whole chaos suite classifies).
+_SCOPED = (
+    "torchsnapshot_tpu/storage_plugins/",
+    "torchsnapshot_tpu/storage_plugin.py",
+    "torchsnapshot_tpu/scheduler.py",
+    "torchsnapshot_tpu/faults.py",
+)
+_BARE = {"Exception", "BaseException"}
+
+
+class ExceptionTaxonomyRule(Rule):
+    name = "exception-taxonomy"
+    description = (
+        "Storage plugins, the scheduler, and the fault injector never "
+        "raise bare Exception/BaseException: failures classify through "
+        "retry.py's taxonomy (StorageTransientError for retryable, a "
+        "specific type for terminal)."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return rel.startswith(_SCOPED[0]) or rel in _SCOPED[1:]
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name not in _BARE:
+                continue
+            yield Finding(
+                rule=self.name,
+                path=module.rel,
+                line=node.lineno,
+                message=(
+                    f"raise {name} is unclassifiable by retry.is_transient "
+                    "(unknown -> terminal): raise StorageTransientError "
+                    "for retryable conditions or a specific exception "
+                    "type for terminal ones"
+                ),
+            )

@@ -1,0 +1,241 @@
+"""Knob discipline: every ``TPUSNAP_*`` env access goes through knobs.py,
+and the knob registry stays in lockstep with docs/knobs.md."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional, Tuple
+
+from .core import Finding, ModuleFile, Project, Rule, module_string_constants
+
+KNOB_PREFIX = "TPUSNAP_"
+# The test harness's own namespace (TPUSNAP_TEST_*): process-coordination
+# flags for tests, not configuration knobs — exempt from discipline and
+# from the docs cross-check.
+TEST_PREFIX = "TPUSNAP_TEST_"
+KNOBS_REL = "torchsnapshot_tpu/knobs.py"
+KNOBS_DOC_REL = "docs/knobs.md"
+
+_ENV_ATTRS = {"get", "pop", "setdefault"}
+_DOC_KNOB_RE = re.compile(r"TPUSNAP_[A-Z0-9_]+")
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` or a bare ``environ`` imported from os."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return isinstance(node.value, ast.Name) and node.value.id == "os"
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+class _KeyResolver:
+    """Resolves an env-key expression to a string: literals, module-level
+    constants of the same file, and ``knobs.<X>_ENV_VAR`` attributes
+    (resolved against the knobs registry so routing a raw ``os.environ``
+    access through a knobs *constant* doesn't evade the rule)."""
+
+    def __init__(self, module: ModuleFile, knob_consts: Dict[str, str]):
+        self._local = (
+            {
+                name: value
+                for name, (value, _) in module_string_constants(
+                    module.tree
+                ).items()
+            }
+            if module.tree is not None
+            else {}
+        )
+        self._knobs = knob_consts
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            return self._local.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return self._knobs.get(expr.attr)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            left = self.resolve(expr.left)
+            right = self.resolve(expr.right)
+            if left is not None and right is not None:
+                return left + right
+        return None
+
+
+def knob_registry(project: Project) -> Dict[str, Tuple[str, int]]:
+    """{const_name: (env_var, lineno)} for every ``*_ENV_VAR`` string
+    constant registered in knobs.py."""
+    module = project.module(KNOBS_REL)
+    if module is None or module.tree is None:
+        path = project.read_text(KNOBS_REL)
+        if path is None:
+            return {}
+        try:
+            tree = ast.parse(path)
+        except SyntaxError:
+            return {}
+        consts = module_string_constants(tree)
+    else:
+        consts = module_string_constants(module.tree)
+    return {
+        name: (value, lineno)
+        for name, (value, lineno) in consts.items()
+        if name.endswith("_ENV_VAR") and value.startswith(KNOB_PREFIX)
+    }
+
+
+class KnobDisciplineRule(Rule):
+    name = "knob-discipline"
+    description = (
+        "TPUSNAP_* environment variables are read (and written) only "
+        "through knobs.py accessors; direct os.environ/os.getenv access "
+        "anywhere else bypasses the one registry that documents, "
+        "validates, and test-overrides every knob."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return rel != KNOBS_REL
+
+    def _knob_consts(self) -> Dict[str, str]:
+        # The live registry: resolving knobs.<CONST> attribute keys against
+        # it means aliasing a constant can't evade the rule.  Falls back to
+        # empty when the package isn't importable (standalone checkouts).
+        try:
+            from .. import knobs
+
+            return {
+                name: value
+                for name, value in vars(knobs).items()
+                if name.endswith("_ENV_VAR") and isinstance(value, str)
+            }
+        except Exception:  # noqa: BLE001
+            return {}
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        resolver = _KeyResolver(module, self._knob_consts())
+
+        def finding(node: ast.AST, key: str, how: str) -> Finding:
+            return Finding(
+                rule=self.name,
+                path=module.rel,
+                line=node.lineno,
+                message=(
+                    f"direct {how} of {key}: route TPUSNAP_* env access "
+                    "through a knobs.py accessor (or knobs.override_env "
+                    "for scoped test overrides)"
+                ),
+            )
+
+        def is_knob(key: Optional[str]) -> bool:
+            return (
+                key is not None
+                and key.startswith(KNOB_PREFIX)
+                and not key.startswith(TEST_PREFIX)
+            )
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                key_expr: Optional[ast.AST] = None
+                how = "read"
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _ENV_ATTRS
+                    and _is_environ(func.value)
+                ):
+                    key_expr = node.args[0] if node.args else None
+                    how = "read" if func.attr == "get" else f"{func.attr}()"
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr == "getenv"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "os"
+                ) or (isinstance(func, ast.Name) and func.id == "getenv"):
+                    key_expr = node.args[0] if node.args else None
+                if key_expr is not None:
+                    key = resolver.resolve(key_expr)
+                    if is_knob(key):
+                        yield finding(node, key, how)
+            elif isinstance(node, ast.Subscript) and _is_environ(node.value):
+                key = resolver.resolve(node.slice)
+                if is_knob(key):
+                    how = (
+                        "write"
+                        if isinstance(node.ctx, (ast.Store, ast.Del))
+                        else "read"
+                    )
+                    yield finding(node, key, how)
+            elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+            ):
+                for comparator in node.comparators:
+                    if _is_environ(comparator):
+                        key = resolver.resolve(node.left)
+                        if is_knob(key):
+                            yield finding(node, key, "membership test")
+
+
+class KnobDocsRule(Rule):
+    name = "knob-docs"
+    description = (
+        "Bidirectional registry<->docs cross-check: every *_ENV_VAR knob "
+        "registered in knobs.py is documented in docs/knobs.md, and every "
+        "TPUSNAP_* name docs/knobs.md mentions is a registered knob — an "
+        "undocumented knob is invisible to operators, a documented ghost "
+        "knob silently does nothing."
+    )
+
+    def project_check(self, project: Project) -> Iterable[Finding]:
+        registry = knob_registry(project)
+        if not registry:
+            yield Finding(
+                rule=self.name,
+                path=KNOBS_REL,
+                line=1,
+                message="could not parse the knob registry from knobs.py",
+            )
+            return
+        doc_text = project.read_text(KNOBS_DOC_REL)
+        if doc_text is None:
+            yield Finding(
+                rule=self.name,
+                path=KNOBS_DOC_REL,
+                line=1,
+                message="docs/knobs.md missing: the knob registry has no "
+                "operator documentation",
+            )
+            return
+        documented: Dict[str, int] = {}
+        for i, line in enumerate(doc_text.splitlines(), start=1):
+            for match in _DOC_KNOB_RE.findall(line):
+                documented.setdefault(match, i)
+        registered: Dict[str, Tuple[str, int]] = {
+            value: (name, lineno) for name, (value, lineno) in registry.items()
+        }
+        for env_var, (const, lineno) in sorted(registered.items()):
+            if env_var.startswith(TEST_PREFIX):
+                continue
+            if env_var not in documented:
+                yield Finding(
+                    rule=self.name,
+                    path=KNOBS_REL,
+                    line=lineno,
+                    message=(
+                        f"{env_var} (registered as {const}) is not "
+                        f"documented in {KNOBS_DOC_REL}"
+                    ),
+                )
+        for env_var, lineno in sorted(documented.items()):
+            if env_var.startswith(TEST_PREFIX):
+                continue
+            if env_var not in registered:
+                yield Finding(
+                    rule=self.name,
+                    path=KNOBS_DOC_REL,
+                    line=lineno,
+                    message=(
+                        f"{env_var} is documented but not registered as a "
+                        "*_ENV_VAR constant in knobs.py (ghost knob?)"
+                    ),
+                )

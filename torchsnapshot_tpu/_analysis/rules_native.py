@@ -1,0 +1,147 @@
+"""Native ABI cross-check: the C library's exported surface and the
+ctypes bindings cannot drift.
+
+``native_io.py`` degrades per-feature by probing symbols — which means a
+symbol exported by ``tpustore.cc`` but never probed is dead weight whose
+Python half was forgotten (exactly the stale-lib degrade bug class PR 8
+hardened against), and a symbol probed but not exported would degrade the
+data plane on every load.  The ABI generation constants
+(``tpusnap_abi_version()`` / ``NATIVE_ABI_VERSION``) must also agree, or
+every freshly-built library would be treated as stale.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Optional, Tuple
+
+from .core import Finding, Project, Rule
+
+CC_REL = "torchsnapshot_tpu/_native/tpustore.cc"
+PY_REL = "torchsnapshot_tpu/native_io.py"
+
+# A C function DEFINITION at line start inside an extern "C" region:
+# type tokens (possibly pointered), then the symbol, then its parameter
+# list.  Calls inside bodies ('h = tpusnap_xxhash64(...)') don't match —
+# the '=' breaks the contiguous type-token run from line start.
+_CC_DEF_RE = re.compile(
+    r"^\s*(?:[A-Za-z_][A-Za-z0-9_]*[\s\*]+)+(tpusnap_[a-z0-9_]+)\s*\("
+)
+_PY_SYM_RE = re.compile(r"^tpusnap_[a-z0-9_]+$")
+_CC_ABI_RE = re.compile(
+    r"int\s+tpusnap_abi_version\s*\(\s*\)\s*\{\s*return\s+(\d+)\s*;"
+)
+_PY_ABI_RE = re.compile(r"^NATIVE_ABI_VERSION\s*=\s*(\d+)", re.M)
+
+
+def exported_symbols(cc_text: str) -> Dict[str, int]:
+    """{symbol: lineno} for every tpusnap_* function defined inside an
+    ``extern "C"`` block of the native source."""
+    out: Dict[str, int] = {}
+    depth = 0
+    for i, line in enumerate(cc_text.splitlines(), start=1):
+        if 'extern "C"' in line and "{" in line:
+            depth += 1
+            continue
+        if depth and line.strip().startswith("}") and 'extern "C"' in line:
+            depth -= 1
+            continue
+        if not depth:
+            continue
+        m = _CC_DEF_RE.match(line)
+        if m:
+            out.setdefault(m.group(1), i)
+    return out
+
+
+def probed_symbols(py_text: str) -> Dict[str, int]:
+    """{symbol: first lineno} for every tpusnap_* name native_io.py
+    actually references in CODE: an attribute access on the CDLL
+    (``lib.tpusnap_x``) or a whole-string literal (``_bind("tpusnap_x")``).
+    AST-based on purpose — a comment or docstring mentioning a symbol must
+    not mask its deleted binding (that would silently defeat the drift
+    check this rule exists for)."""
+    import ast
+
+    out: Dict[str, int] = {}
+    try:
+        tree = ast.parse(py_text)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and _PY_SYM_RE.match(node.attr):
+            out.setdefault(node.attr, node.lineno)
+        elif (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _PY_SYM_RE.match(node.value)
+        ):
+            out.setdefault(node.value, node.lineno)
+    return out
+
+
+class NativeAbiRule(Rule):
+    name = "native-abi"
+    description = (
+        "Every tpusnap_* symbol exported by tpustore.cc is probed/bound "
+        "in native_io.py and vice-versa, and the two ABI generation "
+        "constants agree — symbol drift is the stale-library degrade bug "
+        "class."
+    )
+
+    def _load(self, project: Project) -> Tuple[Optional[str], Optional[str]]:
+        return project.read_text(CC_REL), project.read_text(PY_REL)
+
+    def project_check(self, project: Project) -> Iterable[Finding]:
+        cc_text, py_text = self._load(project)
+        if cc_text is None or py_text is None:
+            # A checkout without the native source has no ABI to check.
+            return
+        exported = exported_symbols(cc_text)
+        probed = probed_symbols(py_text)
+        for sym in sorted(set(exported) - set(probed)):
+            yield Finding(
+                rule=self.name,
+                path=CC_REL,
+                line=exported[sym],
+                message=(
+                    f"exported symbol {sym} is never probed/bound in "
+                    f"{PY_REL}: dead native surface, or a forgotten "
+                    "Python-side binding"
+                ),
+            )
+        for sym in sorted(set(probed) - set(exported)):
+            yield Finding(
+                rule=self.name,
+                path=PY_REL,
+                line=probed[sym],
+                message=(
+                    f"{sym} is probed/bound but tpustore.cc exports no "
+                    "such symbol: the data plane would degrade on every "
+                    "load"
+                ),
+            )
+        cc_abi = _CC_ABI_RE.search(cc_text)
+        py_abi = _PY_ABI_RE.search(py_text)
+        if cc_abi and py_abi and cc_abi.group(1) != py_abi.group(1):
+            yield Finding(
+                rule=self.name,
+                path=PY_REL,
+                line=py_text[: py_abi.start()].count("\n") + 1,
+                message=(
+                    f"NATIVE_ABI_VERSION={py_abi.group(1)} disagrees with "
+                    f"tpusnap_abi_version() returning {cc_abi.group(1)} in "
+                    "tpustore.cc: every fresh build would degrade as stale"
+                ),
+            )
+        elif cc_abi is None or py_abi is None:
+            yield Finding(
+                rule=self.name,
+                path=PY_REL if py_abi is None else CC_REL,
+                line=1,
+                message=(
+                    "could not locate the ABI generation constant "
+                    "(tpusnap_abi_version / NATIVE_ABI_VERSION) for the "
+                    "cross-check"
+                ),
+            )

@@ -44,7 +44,6 @@ from .io_types import (
 )
 from .manifest import (
     ChunkedTensorEntry,
-    Entry,
     Manifest,
     ShardedArrayEntry,
     TensorEntry,

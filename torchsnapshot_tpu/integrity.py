@@ -35,10 +35,11 @@ the decoder ever runs.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
-from .native_io import STRIPE_BYTES, STRIPED_MIN_BYTES
+from . import knobs
+
+from .native_io import STRIPED_MIN_BYTES
 
 
 class ChecksumError(RuntimeError):
@@ -49,7 +50,7 @@ _KNOWN_ALGOS = ("xxh64", "xxh64s")
 
 
 def checksums_enabled() -> bool:
-    return os.environ.get("TPUSNAP_CHECKSUM", "1") not in ("0", "false", "")
+    return knobs.checksum_enabled()
 
 
 def save_checksums_enabled() -> bool:
@@ -58,9 +59,7 @@ def save_checksums_enabled() -> bool:
     already carry — the escape hatch for hosts whose link rate outruns the
     hash (restore-side verification is already free: the native fs plugin
     fuses it into the read loop)."""
-    return checksums_enabled() and os.environ.get(
-        "TPUSNAP_CHECKSUM_ON_SAVE", "1"
-    ) not in ("0", "false", "")
+    return checksums_enabled() and knobs.checksum_on_save_enabled()
 
 
 # ----------------------------------------------------------- hash backends

@@ -51,6 +51,24 @@ JOURNAL_MAX_SEGMENTS_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_SEGMENTS"
 JOURNAL_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_BYTES"
 NATIVE_ENV_VAR = _ENV_PREFIX + "NATIVE"
 NATIVE_THREADS_ENV_VAR = _ENV_PREFIX + "NATIVE_THREADS"
+NATIVE_SANITIZE_ENV_VAR = _ENV_PREFIX + "NATIVE_SANITIZE"
+CHECKSUM_ENV_VAR = _ENV_PREFIX + "CHECKSUM"
+CHECKSUM_ON_SAVE_ENV_VAR = _ENV_PREFIX + "CHECKSUM_ON_SAVE"
+D2H_BITCAST_ENV_VAR = _ENV_PREFIX + "D2H_BITCAST"
+H2D_BITCAST_ENV_VAR = _ENV_PREFIX + "H2D_BITCAST"
+GCS_ENDPOINT_ENV_VAR = _ENV_PREFIX + "GCS_ENDPOINT"
+S3_ENDPOINT_ENV_VAR = _ENV_PREFIX + "S3_ENDPOINT"
+S3_MULTIPART_THRESHOLD_ENV_VAR = _ENV_PREFIX + "S3_MULTIPART_THRESHOLD_BYTES"
+S3_MULTIPART_PART_ENV_VAR = _ENV_PREFIX + "S3_MULTIPART_PART_BYTES"
+STORE_ADDR_ENV_VAR = _ENV_PREFIX + "STORE_ADDR"
+STORE_PATH_ENV_VAR = _ENV_PREFIX + "STORE_PATH"
+RANK_ENV_VAR = _ENV_PREFIX + "RANK"
+WORLD_SIZE_ENV_VAR = _ENV_PREFIX + "WORLD_SIZE"
+
+# Sanitizer build modes _native/build.py understands; each produces its own
+# libtpusnap-<mode>.so so the normal library is never clobbered by an
+# instrumented one.
+_SUPPORTED_SANITIZERS = ("tsan", "asan", "ubsan")
 
 # Digest algorithms the CAS layout supports.  One today; the layout
 # namespaces chunks by algorithm (cas/<algo>/...) so adding another is a
@@ -613,3 +631,120 @@ def get_pinned_host_retry_s() -> float:
     (round-4 verdict: the old flag was sticky forever)."""
     val = os.environ.get(PINNED_HOST_RETRY_S_ENV_VAR)
     return float(val) if val is not None else 300.0
+
+
+def get_native_sanitize() -> str:
+    """Requested sanitizer instrumentation for the native library
+    (``TPUSNAP_NATIVE_SANITIZE``): ``tsan`` / ``asan`` / ``ubsan`` build
+    (and load) a separately-named ``libtpusnap-<mode>.so`` so the normal
+    production library is untouched; empty (the default) means no
+    instrumentation.  An unknown value fails loudly — silently running an
+    uninstrumented race test would report a meaningless "clean"."""
+    val = os.environ.get(NATIVE_SANITIZE_ENV_VAR, "").strip().lower()
+    if val in ("", "0", "none", "off"):
+        return ""
+    if val not in _SUPPORTED_SANITIZERS:
+        raise ValueError(
+            f"{NATIVE_SANITIZE_ENV_VAR}={val!r}: unsupported sanitizer "
+            f"(supported: {', '.join(_SUPPORTED_SANITIZERS)})"
+        )
+    return val
+
+
+@contextmanager
+def override_native_sanitize(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(NATIVE_SANITIZE_ENV_VAR, value):
+        yield
+
+
+def checksum_enabled() -> bool:
+    """Whether payload digests participate at all (``TPUSNAP_CHECKSUM``,
+    default on).  Off disables both recording on save and verification on
+    restore; :mod:`integrity` is the sole consumer and re-exports this as
+    ``checksums_enabled``."""
+    return os.environ.get(CHECKSUM_ENV_VAR, "1") not in ("0", "false", "")
+
+
+def checksum_on_save_enabled() -> bool:
+    """Whether saves RECORD digests (``TPUSNAP_CHECKSUM_ON_SAVE``, default
+    on; meaningless when ``TPUSNAP_CHECKSUM=0``).  Restores keep verifying
+    whatever digests snapshots already carry."""
+    return os.environ.get(CHECKSUM_ON_SAVE_ENV_VAR, "1") not in (
+        "0",
+        "false",
+        "",
+    )
+
+
+def _get_tristate_env(name: str) -> Optional[bool]:
+    """None when unset (caller decides), else the usual falsy spellings."""
+    val = os.environ.get(name)
+    if val is None:
+        return None
+    return val not in ("0", "false", "")
+
+
+def d2h_bitcast_flag() -> Optional[bool]:
+    """Forced on/off for sub-word d2h bitcast staging, or None — the
+    staging layer then decides per array (staging.py)."""
+    return _get_tristate_env(D2H_BITCAST_ENV_VAR)
+
+
+def h2d_bitcast_flag() -> Optional[bool]:
+    """Forced on/off for sub-word h2d bitcast upload, or None — falls back
+    to the d2h flag, then the per-device heuristic (staging.py)."""
+    return _get_tristate_env(H2D_BITCAST_ENV_VAR)
+
+
+def get_gcs_endpoint() -> Optional[str]:
+    """Override for the GCS JSON/upload API base URL (fake-server tests,
+    private service connect); None uses the public endpoint."""
+    val = os.environ.get(GCS_ENDPOINT_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_s3_endpoint() -> Optional[str]:
+    """Override for the S3 endpoint URL (minio, fake server); None derives
+    the AWS endpoint from the bucket region."""
+    val = os.environ.get(S3_ENDPOINT_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_s3_multipart_threshold_bytes(default: int) -> int:
+    """Object size above which the s3 plugin switches to multipart upload;
+    the plugin passes its AWS-bound default."""
+    return _get_int_env(S3_MULTIPART_THRESHOLD_ENV_VAR, default)
+
+
+def get_s3_multipart_part_bytes(default: int) -> int:
+    """Part size for s3 multipart uploads (AWS bounds: >=5 MB, <=10k
+    parts)."""
+    return _get_int_env(S3_MULTIPART_PART_ENV_VAR, default)
+
+
+def get_store_addr() -> Optional[str]:
+    """``host:port`` of an external TCP KV store for multi-process
+    coordination (dist_store.py bootstrap), or None."""
+    val = os.environ.get(STORE_ADDR_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_store_path() -> Optional[str]:
+    """Filesystem directory backing the FileStore coordination KV
+    (dist_store.py bootstrap), or None."""
+    val = os.environ.get(STORE_PATH_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_env_rank() -> Optional[int]:
+    """This process's rank as exported by the launcher/test harness
+    (``TPUSNAP_RANK``), or None when not running under one."""
+    val = os.environ.get(RANK_ENV_VAR)
+    return int(val) if val is not None else None
+
+
+def get_env_world_size() -> Optional[int]:
+    """World size as exported by the launcher/test harness
+    (``TPUSNAP_WORLD_SIZE``), or None."""
+    val = os.environ.get(WORLD_SIZE_ENV_VAR)
+    return int(val) if val is not None else None

@@ -160,7 +160,7 @@ class NativeFileIO:
 
         self.has_pool = _bind(
             "tpusnap_pool_configure", None, [ctypes.c_int]
-        )
+        ) and _bind("tpusnap_pool_size", ctypes.c_int, [])
         self.has_striped_hash = _bind(
             "tpusnap_xxhash64_striped",
             ctypes.c_uint64,
@@ -241,6 +241,11 @@ class NativeFileIO:
             )
         except Exception:
             pass  # telemetry must never break the data plane
+
+    def pool_size(self) -> int:
+        """Current size of the native worker pool (0 before lazy creation);
+        requires ``has_pool``."""
+        return int(self._lib.tpusnap_pool_size())
 
     def xxhash64(self, buf) -> int:
         view = memoryview(buf)
@@ -420,6 +425,11 @@ class NativeFileIO:
             # Checked per call so tests can toggle the knob; the built
             # instance stays cached for when it flips back on.
             return None
+        # Validate the sanitize knob OUTSIDE the swallowed constructor
+        # path: a typo'd TPUSNAP_NATIVE_SANITIZE must fail loudly (the
+        # knob's contract), not silently run every save pure-Python via
+        # the sticky _failed flag.
+        knobs.get_native_sanitize()
         if cls._failed:
             return None
         if cls._instance is None:

@@ -26,6 +26,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from . import knobs
+
 
 PRNG_KEY_ENVELOPE = "__tpusnap_jax_prng_key__"
 
@@ -128,7 +130,7 @@ def _use_bitcast_staging(arr: Any) -> bool:
     extra HBM pass and buys back the difference.  Off on the CPU backend
     (asarray there is already zero-copy) and overridable via
     TPUSNAP_D2H_BITCAST=0/1."""
-    flag = _bitcast_env_flag("TPUSNAP_D2H_BITCAST")
+    flag = knobs.d2h_bitcast_flag()
     if flag is not None:
         return flag
     try:
@@ -182,23 +184,14 @@ def to_host(arr: Any) -> np.ndarray:
 _H2D_BITCAST_CACHE: dict = {}
 
 
-def _bitcast_env_flag(name: str) -> Optional[bool]:
-    import os
-
-    flag = os.environ.get(name)
-    if flag is None:
-        return None
-    return flag not in ("0", "false", "")
-
-
 def _use_bitcast_h2d(device: Any, dtype: Any) -> bool:
     """Same rationale as _use_bitcast_staging, opposite direction: sub-word
     dtypes upload host→device markedly slower on some transports.  Own knob
     (TPUSNAP_H2D_BITCAST) so the two directions tune independently; falls
     back to the shared TPUSNAP_D2H_BITCAST override for convenience."""
-    flag = _bitcast_env_flag("TPUSNAP_H2D_BITCAST")
+    flag = knobs.h2d_bitcast_flag()
     if flag is None:
-        flag = _bitcast_env_flag("TPUSNAP_D2H_BITCAST")
+        flag = knobs.d2h_bitcast_flag()
     if flag is not None:
         return flag
     try:

@@ -25,7 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 from urllib.parse import quote as _quote
 
-from .. import retry
+from .. import knobs, retry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 from ..memoryview_stream import MemoryviewStream
 
@@ -141,8 +141,6 @@ class GCSStoragePlugin(StoragePlugin):
     _KNOWN_OPTIONS = frozenset({"endpoint"})
 
     def __init__(self, root: str, storage_options=None) -> None:
-        import os
-
         options = dict(storage_options or {})
         unknown = set(options) - self._KNOWN_OPTIONS
         if unknown:
@@ -171,7 +169,7 @@ class GCSStoragePlugin(StoragePlugin):
         )
         # Endpoint override (local fake GCS / emulator): anonymous sessions,
         # both the resumable-upload and download bases point at it.
-        endpoint = options.get("endpoint", os.environ.get("TPUSNAP_GCS_ENDPOINT"))
+        endpoint = options.get("endpoint", knobs.get_gcs_endpoint())
         if endpoint:
             endpoint = endpoint.rstrip("/")
             self._upload_base = endpoint

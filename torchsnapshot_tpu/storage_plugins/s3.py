@@ -32,7 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 from xml.etree import ElementTree
 
-from .. import retry
+from .. import knobs, retry
 from ..io_types import ReadIO, StoragePlugin, WriteIO, contiguous
 
 _IO_THREADS = 16
@@ -51,9 +51,7 @@ _UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 # (512 MB chunk/shard knobs), but an oversized pickled object or a merged
 # slab must not fail outright.  Env-overridable so tests can exercise the
 # multipart path with small objects.
-_MULTIPART_THRESHOLD_ENV = "TPUSNAP_S3_MULTIPART_THRESHOLD_BYTES"
 _DEFAULT_MULTIPART_THRESHOLD = 5 * 1024 * 1024 * 1024
-_MULTIPART_PART_ENV = "TPUSNAP_S3_MULTIPART_PART_BYTES"
 _DEFAULT_MULTIPART_PART = 256 * 1024 * 1024  # AWS bounds: >=5 MB, <=10k parts
 
 
@@ -187,7 +185,7 @@ class S3StoragePlugin(StoragePlugin):
                 "AWS_REGION", os.environ.get("AWS_DEFAULT_REGION", "us-east-1")
             ),
         )
-        endpoint = options.get("endpoint", os.environ.get("TPUSNAP_S3_ENDPOINT"))
+        endpoint = options.get("endpoint", knobs.get_s3_endpoint())
         if endpoint:
             # Path-style addressing for custom endpoints (fakes, minio).
             self._base = f"{endpoint.rstrip('/')}/{bucket}"
@@ -283,10 +281,8 @@ class S3StoragePlugin(StoragePlugin):
             # memoryview body: requests uploads it without copying (the old
             # MemoryviewStream behavior), and retries re-send the same view.
             body = memoryview(contiguous(write_io.buf))
-            threshold = int(
-                os.environ.get(
-                    _MULTIPART_THRESHOLD_ENV, _DEFAULT_MULTIPART_THRESHOLD
-                )
+            threshold = knobs.get_s3_multipart_threshold_bytes(
+                _DEFAULT_MULTIPART_THRESHOLD
             )
             if body.nbytes > threshold:
                 self._multipart_put(self._key(write_io.path), body)
@@ -359,9 +355,7 @@ class S3StoragePlugin(StoragePlugin):
         ``_request``'s retry loop independently (a transient mid-upload only
         re-sends that part, not the whole object).  On any failure the
         upload is aborted so S3 doesn't bill for orphaned parts."""
-        part_size = int(
-            os.environ.get(_MULTIPART_PART_ENV, _DEFAULT_MULTIPART_PART)
-        )
+        part_size = knobs.get_s3_multipart_part_bytes(_DEFAULT_MULTIPART_PART)
         # AWS caps multipart uploads at 10k parts.
         part_size = max(part_size, -(-body.nbytes // 10000))
         upload_id = self._initiate_multipart(key)

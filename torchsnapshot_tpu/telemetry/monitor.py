@@ -418,7 +418,10 @@ class OpMonitor:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(doc, f)
-            os.replace(tmp, path)
+            # Best-effort liveness beacon rewritten every tick; an fsync
+            # per tick would cost real I/O to protect a file whose loss
+            # means one missed probe interval.
+            os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline
         except OSError:
             logger.debug("failed to write heartbeat %s", path, exc_info=True)
 

@@ -183,7 +183,10 @@ class _TraceOp:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(payload, f)
-            os.replace(tmp, path)
+            # Best-effort diagnostics: a trace lost to a crash is the
+            # least of that crash's problems; rename-atomicity alone keeps
+            # concurrent readers off half-written JSON.
+            os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline
             return path
         except OSError:
             logger.warning("failed to write trace file %s", path, exc_info=True)

@@ -20,6 +20,8 @@ from typing import Any, Callable, Dict
 
 import numpy as np
 
+from . import knobs
+
 
 def tensor_eq(a: Any, b: Any) -> bool:
     from . import staging
@@ -89,11 +91,15 @@ def _proc_entry(
     # host for a real job) must not silently reroute every test's
     # coordination to an external — possibly dead — server; tests that WANT
     # the TCP store opt in with TPUSNAP_TEST_KEEP_STORE_ADDR.
+    # The writes below are launcher-side EXPORTS for this forked child (the
+    # bootstrap contract dist_store/make_test_pg read back through knobs),
+    # not configuration reads — the one pattern knob discipline permits
+    # outside knobs.py, under an explicit suppression.
     if not os.environ.get("TPUSNAP_TEST_KEEP_STORE_ADDR"):
-        os.environ.pop("TPUSNAP_STORE_ADDR", None)
-    os.environ["TPUSNAP_STORE_PATH"] = store_path
-    os.environ["TPUSNAP_RANK"] = str(rank)
-    os.environ["TPUSNAP_WORLD_SIZE"] = str(world_size)
+        os.environ.pop(knobs.STORE_ADDR_ENV_VAR, None)  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.STORE_PATH_ENV_VAR] = store_path  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.RANK_ENV_VAR] = str(rank)  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.WORLD_SIZE_ENV_VAR] = str(world_size)  # tpusnap-lint: disable=knob-discipline
     # Subprocesses run on the CPU backend (tests): single device per proc.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
@@ -112,8 +118,11 @@ def make_test_pg():
     from .dist_store import get_or_create_store
     from .pg_wrapper import PGWrapper
 
-    rank = int(os.environ["TPUSNAP_RANK"])
-    world_size = int(os.environ["TPUSNAP_WORLD_SIZE"])
+    rank = knobs.get_env_rank()
+    world_size = knobs.get_env_world_size()
+    assert rank is not None and world_size is not None, (
+        "make_test_pg() requires the run_with_procs bootstrap env"
+    )
     store = get_or_create_store(rank, world_size)
     return PGWrapper(store=store, rank=rank, world_size=world_size)
 
