@@ -363,15 +363,103 @@ def test_sidecar_records_logical_vs_physical(tmp_path):
 
 
 @needs_native
-def test_cp_refuses_cas_snapshot(tmp_path):
+def test_cp_replicates_cas_snapshot_chunk_by_chunk(tmp_path):
+    """CAS-aware cp: a content-addressed step replicates through the two
+    roots — chunks into the destination's cas/ store, marker last — and
+    a second step's copy skips every chunk the destination already holds
+    (the incremental serving-replica seed)."""
     from torchsnapshot_tpu.replication import copy_snapshot
 
     root = str(tmp_path / "ckpts")
+    dst_root = str(tmp_path / "replica")
     mgr = SnapshotManager(root)
     with knobs.override_cas(True), knobs.override_batching_disabled(True):
         mgr.save(1, _state(1))
-    with pytest.raises(RuntimeError, match="repack"):
-        copy_snapshot(f"{root}/step_1", str(tmp_path / "copy"))
+        mgr.save(2, _state(2))
+    copied = copy_snapshot(f"{root}/step_1", f"{dst_root}/step_1", verify=True)
+    dst = _state(0)
+    copied.restore(dst)
+    np.testing.assert_array_equal(dst["m"]["frozen"], FROZEN)
+    chunks_after_first = set(_chunk_files(dst_root))
+    assert chunks_after_first
+    copy_snapshot(f"{root}/step_2", f"{dst_root}/step_2", verify=True)
+    chunks_after_second = set(_chunk_files(dst_root))
+    # The shared frozen chunk was skipped; only step 2's delta shipped.
+    assert chunks_after_first < chunks_after_second
+    assert len(chunks_after_second - chunks_after_first) == 1
+    _assert_roundtrip(SnapshotManager(dst_root), 2)
+    # Refuses to clobber a committed destination without overwrite.
+    with pytest.raises(RuntimeError, match="overwrite"):
+        copy_snapshot(f"{root}/step_1", f"{dst_root}/step_1")
+    copy_snapshot(f"{root}/step_1", f"{dst_root}/step_1", overwrite=True)
+
+
+@needs_native
+def test_cp_replicates_journal_segment_with_chain(tmp_path):
+    """cp of a journal delta segment ships its whole replay chain (base +
+    prior segments + chunks) so the replica's restore_latest replays it."""
+    from torchsnapshot_tpu.replication import copy_snapshot
+
+    root = str(tmp_path / "ckpts")
+    dst_root = str(tmp_path / "replica")
+    with knobs.override_journal(True), knobs.override_batching_disabled(True):
+        mgr = SnapshotManager(root)
+        for step in (1, 2, 3):
+            mgr.save(step, _state(step))
+    copy_snapshot(f"{root}/seg_3", f"{dst_root}/seg_3", verify=True)
+    dst_mgr = SnapshotManager(dst_root)
+    dst = _state(0)
+    assert dst_mgr.restore_latest(dst) == 3
+    np.testing.assert_array_equal(
+        dst["m"]["opt"], np.full(4096, 3.0, np.float32)
+    )
+    # Renaming a segment in transit would break chain references: refused.
+    with pytest.raises(RuntimeError, match="rename"):
+        copy_snapshot(f"{root}/seg_3", f"{dst_root}/seg_9")
+
+
+@needs_native
+def test_cp_journal_lineage_guard(tmp_path):
+    """A committed same-numbered chain member at the destination is only
+    trusted when its manifest matches the source's; a torn member marker
+    is recopied, a DIFFERENT run's base refuses."""
+    from torchsnapshot_tpu.io_types import ReadIO
+    from torchsnapshot_tpu.replication import copy_snapshot
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+    root = str(tmp_path / "ckpts")
+    with knobs.override_journal(True), knobs.override_batching_disabled(True):
+        mgr = SnapshotManager(root)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    # Foreign destination: its own committed step_1 with different content.
+    foreign = str(tmp_path / "foreign")
+    with knobs.override_journal(True), knobs.override_batching_disabled(True):
+        SnapshotManager(foreign).save(1, _state(7))
+    with pytest.raises(RuntimeError, match="lineage"):
+        copy_snapshot(f"{root}/seg_2", f"{foreign}/seg_2")
+    # Torn chain-member marker at an otherwise-fresh destination: recopied,
+    # not refused.
+    torn = str(tmp_path / "torn")
+    os.makedirs(os.path.join(torn, "step_1"), exist_ok=True)
+    with open(os.path.join(torn, "step_1", ".snapshot_metadata"), "wb") as f:
+        f.write(b"{ this is not json")
+    copy_snapshot(f"{root}/seg_2", f"{torn}/seg_2", verify=True)
+    dst = _state(0)
+    assert SnapshotManager(torn).restore_latest(dst) == 2
+    np.testing.assert_array_equal(
+        dst["m"]["opt"], np.full(4096, 2.0, np.float32)
+    )
+    # The torn marker was healed with the source's good copy.
+    storage = url_to_storage_plugin(torn)
+    try:
+        read_io = ReadIO(path="step_1/.snapshot_metadata")
+        storage.sync_read(read_io)
+        from torchsnapshot_tpu.manifest import SnapshotMetadata
+
+        SnapshotMetadata.from_json(bytes(read_io.buf).decode("utf-8"))
+    finally:
+        storage.sync_close()
 
 
 def test_cas_degrades_without_digest(tmp_path, monkeypatch):

@@ -35,6 +35,15 @@ step "tier-1 pytest (-m 'not slow')"
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider || fail=1
 
+# Serve smoke: 2 concurrent restore processes through one shared host
+# chunk cache (the fleet-serving read tier) — origin traffic must be
+# ~one snapshot.  Also part of tier-1 above; called out here so a serving
+# regression is visible as its own gate line.
+step "serve smoke (2-worker concurrent restore through the chunk cache)"
+timeout -k 10 300 python -m pytest \
+  tests/test_serve.py::test_two_worker_concurrent_restore_fast -q \
+  -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

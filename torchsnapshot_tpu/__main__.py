@@ -14,9 +14,12 @@
     python -m torchsnapshot_tpu analyze <trace-dir> [--snapshot URL] [--json]
     python -m torchsnapshot_tpu history <manager-root-url> [--json]
     python -m torchsnapshot_tpu lint [root] [--external] [--json]
+    python -m torchsnapshot_tpu warm <root-or-snapshot> [--step N | --time T]
+    python -m torchsnapshot_tpu serve <root-or-snapshot> [--step N | --time T]
 
-Read-only except ``cp`` and ``gc --apply``; works against any storage
-backend URL.  (Beyond reference parity: the reference ships no CLI.)
+Read-only except ``cp``, ``gc --apply`` and ``warm`` (which populates the
+host chunk cache); works against any storage backend URL.  (Beyond
+reference parity: the reference ships no CLI.)
 """
 
 from __future__ import annotations
@@ -251,15 +254,24 @@ def cmd_steps(args: argparse.Namespace) -> int:
     from .pg_wrapper import PGWrapper
 
     mgr = SnapshotManager(args.path, pg=PGWrapper())
-    points = mgr.restore_points()
+    points = mgr.restore_point_times()
     if not points:
         print("no committed steps")
         return 0
-    for step, kind in points:
+    from datetime import datetime
+
+    for step, kind, ts in points:
+        # The committed-at instant (from the point's telemetry sidecar) is
+        # what `warm --time` / `restore_as_of` select on.
+        when = (
+            f"  committed {datetime.fromtimestamp(ts).isoformat(timespec='seconds')}"
+            if ts is not None
+            else ""
+        )
         if kind == "full":
-            print(f"step_{step}")
+            print(f"step_{step}{when}")
         else:
-            print(f"seg_{step} (journal delta)")
+            print(f"seg_{step} (journal delta){when}")
     print(f"latest: {points[-1][0]}")
     return 0
 
@@ -664,6 +676,231 @@ def cmd_history(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_time(val: str) -> float:
+    """Unix epoch seconds, or an ISO-8601 instant (local time when no
+    offset is given) — the one ``--time`` grammar warm/serve share."""
+    try:
+        return float(val)
+    except ValueError:
+        pass
+    from datetime import datetime
+
+    try:
+        return datetime.fromisoformat(val).timestamp()
+    except ValueError:
+        raise SystemExit(
+            f"--time {val!r}: expected unix epoch seconds or an ISO-8601 "
+            "instant (e.g. 2026-08-04T12:30:00)"
+        ) from None
+
+
+def _serving_target(path: str, step, time_str):
+    """``(snapshot_path, metadata)`` for warm/serve: ``path`` is either a
+    committed snapshot (used as-is) or a SnapshotManager root resolved to
+    ``--step`` / ``--time`` / the latest restore point.  Journal segments
+    resolve to their replayed merged view, so warming a segment pre-faults
+    its whole chain."""
+    from . import journal as journal_mod
+    from .manager import SnapshotManager
+    from .pg_wrapper import PGWrapper
+    from .snapshot import SNAPSHOT_METADATA_FNAME, Snapshot
+    from .storage_plugin import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(path)
+    try:
+        direct = storage.sync_exists(SNAPSHOT_METADATA_FNAME)
+    finally:
+        storage.sync_close()
+    if direct:
+        if step is not None or time_str is not None:
+            raise SystemExit(
+                f"{path} is a snapshot, not a manager root; --step/--time "
+                "select within a root"
+            )
+        md = Snapshot(path).metadata
+        if md.journal is not None:
+            # A delta segment alone is partial state: warm/serve must
+            # cover its replayed chain (base + priors), else residency
+            # would read 100% while a restore still fetches ~everything.
+            stripped = path.rstrip("/")
+            root, _, name = stripped.rpartition("/")
+            m = journal_mod.SEG_RE.match(name)
+            if not root or not m:
+                raise SystemExit(
+                    f"{path} is a journal delta segment but not at a "
+                    "<root>/seg_<N> path; cannot resolve its replay chain"
+                )
+            storage = url_to_storage_plugin(root)
+            try:
+                merged, _ = journal_mod.merged_metadata(
+                    storage, int(m.group(1))
+                )
+            finally:
+                storage.sync_close()
+            return path, merged
+        return path, md
+    mgr = SnapshotManager(path, pg=PGWrapper())
+    if time_str is not None:
+        if step is not None:
+            raise SystemExit("--step and --time are mutually exclusive")
+        step = mgr.step_as_of(_parse_time(time_str))
+    points = mgr.restore_points()
+    if not points:
+        raise SystemExit(f"{path} has no committed restore points")
+    if step is None:
+        step = points[-1][0]
+    kinds = [k for s, k in points if s == step]
+    if not kinds:
+        raise SystemExit(f"step {step} has no committed restore point under {path}")
+    if "full" in kinds:
+        snap_path = f"{path.rstrip('/')}/step_{step}"
+        return snap_path, Snapshot(snap_path).metadata
+    storage = url_to_storage_plugin(path)
+    try:
+        merged, _ = journal_mod.merged_metadata(storage, step)
+    finally:
+        storage.sync_close()
+    return journal_mod.segment_path(path.rstrip("/"), step), merged
+
+
+def _serving_storage(snap_path: str, metadata):
+    """The read stack warm uses: backend → (faults) → CAS resolve → cache."""
+    from . import cache as cache_mod
+    from . import cas as cas_mod
+    from .storage_plugin import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(snap_path)
+    storage = cas_mod.maybe_wrap_cas_reads(storage, snap_path, metadata)
+    return cache_mod.maybe_wrap_cache_reads(storage, metadata)
+
+
+def cmd_warm(args: argparse.Namespace) -> int:
+    """Pre-fault a snapshot's chunks into the shared host cache
+    (``TPUSNAP_CACHE_DIR``), so the N restore workers that follow hit
+    local disk instead of origin storage.  Parallel full-object reads
+    through the normal plugin data plane (native fs reads, ranged cloud
+    fan-out); idempotent — already-resident chunks are cache hits."""
+    import contextlib
+    import time as _time
+
+    from . import cache as cache_mod
+    from . import knobs
+
+    ctx = (
+        knobs.override_cache_dir(args.cache_dir)
+        if args.cache_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        cache_dir = knobs.get_cache_dir()
+        if not cache_dir:
+            print(
+                "no cache configured: set TPUSNAP_CACHE_DIR or pass "
+                "--cache-dir"
+            )
+            return 2
+        snap_path, metadata = _serving_target(args.path, args.step, args.time)
+        storage = _serving_storage(snap_path, metadata)
+        if cache_mod.find_reader(storage) is None:
+            storage.sync_close()
+            print(f"cache directory {cache_dir} could not be initialized")
+            return 2
+        begin = _time.monotonic()
+        try:
+            stats = cache_mod.warm_snapshot(
+                storage, metadata, concurrency=args.concurrency
+            )
+        finally:
+            storage.sync_close()
+        wall = _time.monotonic() - begin
+        store = cache_mod.CacheStore(cache_dir)
+        res = cache_mod.residency(
+            store, metadata, cache_mod.snapshot_fingerprint(metadata)
+        )
+        gbps = stats["bytes"] / 1e9 / wall if wall > 0 else 0.0
+        print(f"warmed {snap_path} into {cache_dir}")
+        print(
+            f"  {stats['locations']} chunk(s), {_human(stats['bytes'])} in "
+            f"{wall:.2f}s ({gbps:.2f} GB/s); "
+            f"{stats.get('misses', 0)} fetched from origin, "
+            f"{stats.get('hits', 0)} already resident"
+        )
+        print(
+            f"  residency: {res['resident']}/{res['locations']} chunk(s), "
+            f"{_human(res['bytes_resident'])} of {_human(res['bytes_total'])}"
+        )
+    return 0
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Report a snapshot's cache residency — how ready this host is to
+    serve N concurrent restores from local disk — plus the cache
+    directory's totals.  Read-only (run ``warm`` to change the answer)."""
+    import contextlib
+    import json
+
+    from . import cache as cache_mod
+    from . import knobs
+
+    ctx = (
+        knobs.override_cache_dir(args.cache_dir)
+        if args.cache_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        cache_dir = knobs.get_cache_dir()
+        if not cache_dir:
+            print(
+                "no cache configured: set TPUSNAP_CACHE_DIR or pass "
+                "--cache-dir"
+            )
+            return 2
+        snap_path, metadata = _serving_target(args.path, args.step, args.time)
+        store = cache_mod.CacheStore(cache_dir)
+        res = cache_mod.residency(
+            store, metadata, cache_mod.snapshot_fingerprint(metadata)
+        )
+        totals = store.stats()
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "snapshot": snap_path,
+                        "cache_dir": cache_dir,
+                        "residency": res,
+                        "cache": totals,
+                    },
+                    indent=1,
+                )
+            )
+            return 0
+        pct = (
+            100.0 * res["bytes_resident"] / res["bytes_total"]
+            if res["bytes_total"]
+            else 100.0
+        )
+        print(f"snapshot:  {snap_path}")
+        print(f"cache dir: {cache_dir}")
+        print(
+            f"residency: {res['resident']}/{res['locations']} chunk(s), "
+            f"{_human(res['bytes_resident'])} of {_human(res['bytes_total'])}"
+            f" ({pct:.0f}%)"
+        )
+        print(
+            f"cache:     {totals['entries']} entr"
+            f"{'y' if totals['entries'] == 1 else 'ies'}, "
+            f"{_human(totals['bytes'])}"
+            + (
+                f" of {_human(totals['max_bytes'])} bound"
+                if totals["max_bytes"]
+                else " (unbounded)"
+            )
+        )
+        if pct < 100.0:
+            print("run 'warm' to pre-fault the remaining chunks")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m torchsnapshot_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -787,6 +1024,51 @@ def main(argv=None) -> int:
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_analyze)
+
+    for name, fn, extra_help in (
+        (
+            "warm",
+            cmd_warm,
+            "pre-fault a snapshot's chunks into the host cache",
+        ),
+        (
+            "serve",
+            cmd_serve,
+            "report a snapshot's host-cache residency",
+        ),
+    ):
+        p = sub.add_parser(name, help=extra_help)
+        p.add_argument("path", help="snapshot URL or SnapshotManager root")
+        p.add_argument(
+            "--step",
+            type=int,
+            default=None,
+            help="restore point under a manager root (default: latest)",
+        )
+        p.add_argument(
+            "--time",
+            default=None,
+            help="point-in-time selector: the newest restore point "
+            "committed at or before this instant (epoch seconds or "
+            "ISO-8601)",
+        )
+        p.add_argument(
+            "--cache-dir",
+            default=None,
+            help="cache directory (default: TPUSNAP_CACHE_DIR)",
+        )
+        if name == "warm":
+            p.add_argument(
+                "--concurrency",
+                type=int,
+                default=8,
+                help="concurrent chunk fetches",
+            )
+        else:
+            p.add_argument(
+                "--json", action="store_true", help="machine-readable output"
+            )
+        p.set_defaults(fn=fn)
 
     p = sub.add_parser(
         "history", help="render a manager root's step-save history/trend"

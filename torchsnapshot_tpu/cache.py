@@ -1,0 +1,1007 @@
+"""Shared host-side chunk cache: the checkpoint-serving read tier.
+
+ROADMAP item 2.  The save path scales per chip, but the north-star serving
+scenario — thousands of inference workers concurrently pulling the same
+snapshot — hammers origin storage with N identical reads per host.  This
+module adds a file-backed, digest-keyed cache shared by every co-located
+worker (``TPUSNAP_CACHE_DIR``; one directory per host), so a snapshot's
+bytes cross the network ONCE per host and land from local disk N−1 times:
+
+- **Keys.**  Content-addressed chunks (``cas://<algo>/<digest>``) key on
+  their digest — immutable by construction and shared across snapshots and
+  steps.  Non-CAS payloads key on ``(manifest fingerprint, location,
+  byte range)``: the fingerprint (a digest of the commit marker's JSON)
+  changes whenever content does, so a pruned-and-rewritten ``step_N`` can
+  never serve stale bytes.
+- **Layout.**  One data file per entry under
+  ``<dir>/objects/<sha1(key)[:2]>/<sha1(key)>`` plus a ``.meta`` JSON
+  record (the per-entry index: key, size, self-digest) written after the
+  data — a reader requires the meta, so a torn populate is a miss, never a
+  short read.  Maintenance (eviction, residency scans) serializes on an
+  advisory ``flock`` so two processes never sweep concurrently.
+- **Populate.**  tmp + rename (atomic visibility); entries are verified on
+  populate — a full CAS chunk must hash to its digest before it is
+  trusted, everything else records a self-digest checked on later full
+  reads, so a corrupted cache file is detected and re-fetched from origin.
+  Concurrent populates of one key single-flight through a per-key advisory
+  lock: the first process fetches from origin, the rest block briefly and
+  then HIT — N co-located cold starts cost one origin fetch, not N.
+- **Ranged serves.**  A ranged read whose FULL object is resident (e.g.
+  pre-faulted by ``tpusnap warm``) is served by slicing the cached file;
+  only a ranged miss populates a range-keyed entry.
+- **Eviction.**  LRU by file access time under ``TPUSNAP_CACHE_MAX_BYTES``
+  (0 = unbounded), run opportunistically after populates.  Readers open an
+  fd and then read, so POSIX unlink semantics guarantee eviction never
+  truncates a read mid-flight — an evicted-while-open file stays fully
+  readable through the held descriptor.
+
+Installed as :class:`CacheReaderPlugin` by the snapshot read paths
+(restore / read_object / get_state_dict_for_key), OUTSIDE the CAS reader
+so digest keys are visible, and composing with ``faults.py`` (which the
+resolver installs around the origin backend — cache hits bypass injected
+origin faults exactly like they bypass origin latency).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .io_types import ReadIO, StoragePlugin, WriteIO
+
+logger = logging.getLogger(__name__)
+
+_META_SUFFIX = ".meta"
+_LOCK_SUFFIX = ".lock"
+_MAINT_LOCK = ".tpusnap_cache.lock"
+# Eviction walks the cache directory; amortize it over this many populates.
+_EVICT_CHECK_EVERY = 16
+# How long a cold miss waits for a sibling's in-flight populate before
+# fetching origin itself (timing out only duplicates traffic).
+_POPULATE_LOCK_TIMEOUT_S = 120.0
+# tmp files older than this are a crashed populate's debris (a live
+# populate holds its key's lock and finishes in seconds-to-minutes).
+_STALE_TMP_AGE_S = 3600.0
+
+
+# ------------------------------------------------------- process-wide totals
+
+_TOTALS_LOCK = threading.Lock()
+_TOTALS: Dict[str, int] = {
+    "hits": 0,
+    "misses": 0,
+    "hit_bytes": 0,
+    "miss_bytes": 0,
+    "evictions": 0,
+    "evicted_bytes": 0,
+}
+
+
+def process_stats() -> Dict[str, int]:
+    """Accumulated cache outcomes of this process (every wrapper instance
+    folds its counters in on close) — what a serve benchmark worker
+    reports: bytes served from cache vs fetched from origin."""
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
+def reset_process_stats() -> None:
+    with _TOTALS_LOCK:
+        for k in _TOTALS:
+            _TOTALS[k] = 0
+
+
+def _add_totals(**deltas: int) -> None:
+    with _TOTALS_LOCK:
+        for k, v in deltas.items():
+            _TOTALS[k] += v
+
+
+# ----------------------------------------------------------------- key model
+
+
+def snapshot_fingerprint(metadata: Any) -> str:
+    """Namespace for a snapshot's non-CAS cache keys: a digest of its
+    metadata JSON.  Content-derived, so two snapshots with identical
+    manifests share entries and a step dir rewritten with different content
+    (prune + re-save at the same number) can never alias."""
+    return hashlib.sha1(metadata.to_json().encode("utf-8")).hexdigest()[:16]
+
+
+def full_key_for(namespace: str, path: str) -> Tuple[str, Optional[str]]:
+    """``(full-object cache key, expected digest or None)`` for a storage
+    path.  CAS locations key on their digest (namespace-independent —
+    chunks are immutable and shared across snapshots); everything else
+    keys under the snapshot fingerprint."""
+    from . import cas
+
+    if cas.is_cas_location(path):
+        algo, hexdigest = cas.parse_cas_location(path)
+        return f"cas/{algo}/{hexdigest}", f"{algo}:{hexdigest}"
+    return f"obj/{namespace}/{path}", None
+
+
+def keys_for(
+    namespace: str, path: str, byte_range: Optional[List[int]]
+) -> Tuple[str, Optional[str], Optional[str]]:
+    """``(exact key, full-object key or None, expected digest for a full
+    CAS entry)``.  A ranged read's exact key embeds the range; its
+    full-object key lets a ``warm``-populated whole chunk serve any
+    range."""
+    full, expect = full_key_for(namespace, path)
+    if byte_range is None:
+        return full, None, expect
+    return f"{full}@{byte_range[0]}-{byte_range[1]}", full, expect
+
+
+# ---------------------------------------------------------------- the store
+
+
+class CacheStore:
+    """The on-disk cache: sync API only (callers run it on an executor —
+    every method here may touch disk and block)."""
+
+    def __init__(self, root: str, max_bytes: Optional[int] = None) -> None:
+        from . import knobs
+
+        self.root = root
+        self.max_bytes = (
+            knobs.get_cache_max_bytes() if max_bytes is None else max_bytes
+        )
+        self._objects = os.path.join(root, "objects")
+        os.makedirs(self._objects, exist_ok=True)
+        self._populates_since_check = 0
+        self._lock = threading.Lock()
+        # Keys whose content this process has verified against the
+        # recorded digest — ranged slices of an entry re-verify the WHOLE
+        # entry once per process (a crash-torn populate is only
+        # detectable that way; per-slice verification is impossible, the
+        # digest covers the full content), then fast-path.
+        self._verified_keys: set = set()
+        # The native data plane serves hits when built: its parallel pread
+        # pool runs at memory bandwidth where a single Python read loop
+        # measurably does not (concurrent same-process copies serialize on
+        # this class of kernel).  Pure-Python fallback below stays
+        # byte-identical.
+        try:
+            from .native_io import NativeFileIO
+
+            self._native = NativeFileIO.maybe_create()
+        except Exception:
+            self._native = None
+
+    # -------------------------------------------------------------- layout
+
+    def _paths(self, key: str) -> Tuple[str, str]:
+        h = hashlib.sha1(key.encode("utf-8")).hexdigest()
+        d = os.path.join(self._objects, h[:2])
+        return os.path.join(d, h), os.path.join(d, h + _META_SUFFIX)
+
+    def _read_meta(self, meta_path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(meta_path, "r", encoding="utf-8") as f:
+                doc = json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+        if not isinstance(doc, dict) or "nbytes" not in doc:
+            return None
+        return doc
+
+    # --------------------------------------------------------------- reads
+
+    def get(
+        self,
+        key: str,
+        into: Optional[memoryview] = None,
+        byte_range: Optional[List[int]] = None,
+    ):
+        """The cached entry's bytes (or ``True`` after filling ``into``),
+        or None on miss.  ``byte_range`` slices a sub-range out of the
+        entry — used when a FULL-object entry serves a ranged request.
+        Full-entry reads verify the recorded digest; a mismatch removes
+        the entry and reports a miss, so the caller re-fetches origin.
+
+        Eviction safety: an fd on the data file is opened (and its size
+        validated) before any bytes move, so a concurrent eviction's
+        unlink cannot truncate this read — POSIX keeps the inode alive for
+        the holder, and the native fast path falls back to the held fd if
+        the name is already gone."""
+        data_path, meta_path = self._paths(key)
+        meta = self._read_meta(meta_path)
+        if meta is None:
+            return None
+        nbytes = int(meta["nbytes"])
+        start, end = (
+            (byte_range[0], byte_range[1])
+            if byte_range is not None
+            else (0, nbytes)
+        )
+        if end > nbytes or start < 0:
+            return None  # recorded entry can't cover the request
+        if into is not None:
+            dest = memoryview(into).cast("B")
+            if dest.nbytes != end - start:
+                return None
+        else:
+            dest = self._alloc(end - start)
+        # The FIRST ranged slice of an entry in this process verifies the
+        # whole entry (read it all, hash, then slice) — a crash-torn
+        # populate (no fsync by design) is only detectable against the
+        # full-content digest.  Later slices, and entries without a
+        # digest, read just their range.
+        with self._lock:
+            full_verify = (
+                byte_range is not None
+                and bool(meta.get("digest"))
+                and key not in self._verified_keys
+            )
+        if full_verify:
+            read_start, read_view = 0, self._alloc(nbytes)
+        else:
+            read_start, read_view = start, dest
+        try:
+            fd = os.open(data_path, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            if os.fstat(fd).st_size != nbytes:
+                self._drop(key)  # torn/foreign debris
+                return None
+            ok = self._read_into(fd, data_path, read_start, read_view)
+        finally:
+            os.close(fd)
+        if not ok:
+            self._drop(key)
+            return None
+        if (byte_range is None or full_verify) and not self._verify(
+            meta, read_view
+        ):
+            logger.warning(
+                "cache entry %s failed verification; dropping and "
+                "re-fetching from origin",
+                key,
+            )
+            self._drop(key)
+            return None
+        if byte_range is None or full_verify:
+            with self._lock:
+                self._verified_keys.add(key)
+        if full_verify:
+            dest[:] = read_view[start:end]
+        try:
+            os.utime(data_path)  # LRU touch (best effort)
+        except OSError:
+            pass
+        return True if into is not None else dest
+
+    @staticmethod
+    def _alloc(nbytes: int) -> memoryview:
+        # np.empty, not bytearray: bytearray(n) memsets n bytes under the
+        # GIL, which serialized concurrent multi-MB hits (measured: the
+        # zeroing pass alone cost as much as the read it preceded).
+        import numpy as np
+
+        return memoryview(np.empty(nbytes, dtype=np.uint8))
+
+    def _read_into(
+        self, fd: int, data_path: str, start: int, dest: memoryview
+    ) -> bool:
+        """Fill ``dest`` from the entry at byte ``start``.  The native
+        pool's parallel pread is the fast path (concurrent same-process
+        Python read loops serialize on some kernels; the C++ pool runs at
+        memory bandwidth); it opens by path, so if eviction already
+        unlinked the name the held ``fd`` serves the bytes instead."""
+        native = self._native
+        if native is not None:
+            span = [start, start + dest.nbytes]
+            try:
+                if native.has_ranged_read:
+                    native.read_ranges_into(
+                        data_path,
+                        [(span[0], span[1])],
+                        [dest],
+                        want_hash=False,
+                    )
+                else:
+                    native.read_file_into(
+                        data_path, span, dest, want_hash=False
+                    )
+                return True
+            except OSError:
+                pass  # name gone (evicted) or native hiccup: use the fd
+        filled = 0
+        while filled < dest.nbytes:
+            # preadv lands directly in the destination (one copy); pread
+            # would materialize an intermediate bytes object per call.
+            n = os.preadv(fd, [dest[filled:]], start + filled)
+            if not n:
+                return False
+            filled += n
+        return True
+
+    @staticmethod
+    def _verify(meta: Dict[str, Any], data) -> bool:
+        expected = meta.get("digest")
+        if not expected:
+            return True  # no hash backend at populate time: nothing provable
+        from . import integrity
+
+        actual = integrity.digest_as(data, expected)
+        return actual is None or actual == expected
+
+    def resident_nbytes(self, key: str) -> Optional[int]:
+        """Size of a resident entry, or None.  Meta-only — no data read."""
+        data_path, meta_path = self._paths(key)
+        meta = self._read_meta(meta_path)
+        if meta is None or not os.path.exists(data_path):
+            return None
+        return int(meta["nbytes"])
+
+    # --------------------------------------------------------------- writes
+
+    def put(
+        self, key: str, data, expect_digest: Optional[str] = None
+    ) -> bool:
+        """Populate ``key`` atomically (tmp + rename; data before meta, so
+        a reader never trusts a half-written entry).  ``expect_digest``:
+        the content's known digest (a full CAS chunk's name) — verified
+        BEFORE caching, so a corrupt origin fetch is never laundered into
+        a "verified" cache entry.  Returns False when verification failed
+        or the write did (the caller still has the origin bytes; a populate
+        failure must never fail the read)."""
+        from . import integrity
+
+        view = memoryview(data).cast("B")
+        digest = integrity.digest_as(view, expect_digest)
+        if expect_digest is not None:
+            if digest is not None and digest != expect_digest:
+                logger.warning(
+                    "refusing to cache %s: content hashes to %s", key, digest
+                )
+                return False
+        data_path, meta_path = self._paths(key)
+        try:
+            os.makedirs(os.path.dirname(data_path), exist_ok=True)
+            tmp = f"{data_path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "wb") as f:
+                f.write(view)
+            # Cache entries are self-verifying (digest checked on read), so
+            # a torn rename after a crash is detected and re-fetched — no
+            # fsync needed on this hot path.
+            # tpusnap-lint: disable=durability-discipline
+            os.replace(tmp, data_path)
+            meta = {
+                "key": key,
+                "nbytes": view.nbytes,
+                "digest": digest,
+            }
+            mtmp = f"{meta_path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(mtmp, "w", encoding="utf-8") as f:
+                f.write(json.dumps(meta))
+            # Same self-verifying argument as the data file above.
+            # tpusnap-lint: disable=durability-discipline
+            os.replace(mtmp, meta_path)
+        except OSError:
+            logger.warning("cache populate failed for %s", key, exc_info=True)
+            return False
+        with self._lock:
+            # Fresh content: any slice-path verification of the replaced
+            # entry no longer applies.
+            self._verified_keys.discard(key)
+            self._populates_since_check += 1
+            check = self._populates_since_check >= _EVICT_CHECK_EVERY
+            if check:
+                self._populates_since_check = 0
+        if check:
+            self.maybe_evict()
+        return True
+
+    def _drop(self, key: str) -> None:
+        with self._lock:
+            self._verified_keys.discard(key)
+        data_path, meta_path = self._paths(key)
+        for p in (meta_path, data_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- populate lock
+
+    def try_acquire_populate_lock(self, key: str) -> Optional[int]:
+        """One NON-blocking attempt at the per-key populate lock that makes
+        cold-start fetches single-flight.  Returns the held fd (release
+        with :meth:`release_populate_lock`) or None — held by a sibling,
+        or locking unavailable.  Deliberately never blocks: callers poll
+        from their event loop (CacheReaderPlugin), because a blocking
+        flock parked on a bounded executor can deadlock the very populate
+        it waits for once every worker thread is a waiter.  The lock
+        auto-releases if its holder dies (flock semantics)."""
+        import fcntl
+
+        data_path, _ = self._paths(key)
+        lock_path = data_path + _LOCK_SUFFIX
+        try:
+            os.makedirs(os.path.dirname(lock_path), exist_ok=True)
+            fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            return None
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return None
+        return fd
+
+    @staticmethod
+    def release_populate_lock(fd: Optional[int]) -> None:
+        if fd is None:
+            return
+        import fcntl
+
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        os.close(fd)
+
+    # ------------------------------------------------------------ eviction
+
+    def _walk_entries(self) -> List[Tuple[float, int, str, str]]:
+        """``(atime, nbytes, data_path, meta_path)`` for every complete
+        entry, oldest-access first."""
+        out = []
+        for dirpath, _, files in os.walk(self._objects):
+            for name in files:
+                if name.endswith((_META_SUFFIX, _LOCK_SUFFIX)) or ".tmp." in name:
+                    continue
+                data_path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(data_path)
+                except OSError:
+                    continue
+                out.append(
+                    (
+                        max(st.st_mtime, st.st_atime),
+                        st.st_size,
+                        data_path,
+                        data_path + _META_SUFFIX,
+                    )
+                )
+        out.sort()
+        return out
+
+    def _sweep_stale_tmp(self) -> None:
+        """Unlink tmp files left by crashed populates.  Invisible to
+        ``_walk_entries`` by design (a live populate's tmp must not be
+        evicted under it), so without this sweep a SIGKILL mid-put leaks a
+        chunk-sized file the byte bound never sees.  Age-gated: anything
+        ``.tmp.`` older than an hour has no live writer."""
+        import time as _time
+
+        cutoff = _time.time() - _STALE_TMP_AGE_S
+        for dirpath, _, files in os.walk(self._objects):
+            for name in files:
+                if ".tmp." not in name:
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    if os.stat(path).st_mtime < cutoff:
+                        os.unlink(path)
+                except OSError:
+                    continue
+
+    def stats(self) -> Dict[str, int]:
+        entries = self._walk_entries()
+        return {
+            "entries": len(entries),
+            "bytes": sum(e[1] for e in entries),
+            "max_bytes": self.max_bytes,
+        }
+
+    def maybe_evict(self) -> int:
+        """Evict least-recently-used entries until the cache fits its byte
+        bound; returns the bytes reclaimed.  Serialized across processes on
+        an advisory lock (non-blocking: if a sibling is already sweeping,
+        this pass is its work anyway).  Safe against concurrent readers by
+        POSIX unlink semantics — an open fd keeps the evicted entry fully
+        readable until the reader closes it."""
+        import fcntl
+
+        try:
+            lock_fd = os.open(
+                os.path.join(self.root, _MAINT_LOCK),
+                os.O_CREAT | os.O_RDWR,
+                0o644,
+            )
+        except OSError:
+            return 0
+        try:
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return 0  # a sibling process is sweeping
+            self._sweep_stale_tmp()
+            if not self.max_bytes:
+                return 0
+            entries = self._walk_entries()
+            total = sum(e[1] for e in entries)
+            evicted_bytes = 0
+            evicted = 0
+            for _, nbytes, data_path, meta_path in entries:
+                if total - evicted_bytes <= self.max_bytes:
+                    break
+                for p in (meta_path, data_path):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                evicted_bytes += nbytes
+                evicted += 1
+            if evicted:
+                from .event import Event
+                from .event_handlers import log_event
+                from .telemetry import metrics as tmetrics
+
+                _add_totals(evictions=evicted, evicted_bytes=evicted_bytes)
+                tmetrics.record_cache_evicted(evicted, evicted_bytes)
+                log_event(
+                    Event(
+                        name="cache.evict",
+                        metadata={
+                            "entries": evicted,
+                            "bytes": evicted_bytes,
+                            "max_bytes": self.max_bytes,
+                        },
+                    )
+                )
+                logger.info(
+                    "cache: evicted %d entr%s (%.1f MB) to fit %.1f MB bound",
+                    evicted,
+                    "y" if evicted == 1 else "ies",
+                    evicted_bytes / 1e6,
+                    self.max_bytes / 1e6,
+                )
+            return evicted_bytes
+        finally:
+            os.close(lock_fd)
+
+
+# ------------------------------------------------------------ reader plugin
+
+
+class CacheReaderPlugin(StoragePlugin):
+    """Serves payload reads from the shared host cache, populating on miss.
+
+    Read-tier only: writes, deletes, listings pass straight through.
+    Sits OUTSIDE the CAS reader (``cas://`` paths are the digest keys) and
+    over whatever the resolver built below (faults wrapper included — a
+    cache hit legitimately bypasses origin faults, which is exactly the
+    serving story).  Protocol metadata (dot-files, ``telemetry/``) is never
+    cached: the commit marker's absence IS a protocol signal.
+    """
+
+    def __init__(
+        self, inner: StoragePlugin, store: CacheStore, namespace: str
+    ) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._inner = inner
+        self._store = store
+        self._ns = namespace
+        self.supports_scatter = getattr(inner, "supports_scatter", False)
+        self.supports_write_hash = getattr(inner, "supports_write_hash", False)
+        # Own pool, deliberately larger than the io-concurrency cap: lock
+        # waiters park here during a sibling's populate, and sharing the
+        # inner plugin's pool could deadlock the populate behind its own
+        # waiters.
+        self._executor = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="tpusnap_cache"
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        self.miss_bytes = 0
+        self._closed = False
+
+    def _get_executor(self):
+        return self._executor
+
+    @property
+    def store(self) -> CacheStore:
+        return self._store
+
+    @staticmethod
+    def _cacheable(path: str) -> bool:
+        name = path.rsplit("/", 1)[-1]
+        return not (
+            path.startswith(".")
+            or name.startswith(".")
+            or path.startswith("telemetry/")
+        )
+
+    def _try_get(
+        self,
+        exact_key: str,
+        full_key: Optional[str],
+        byte_range: Optional[List[int]],
+        into: Optional[memoryview],
+    ):
+        """Sync (executor-side) lookup: the exact key first, then a ranged
+        slice out of a resident full object."""
+        hit = self._store.get(exact_key, into=into)
+        if hit is not None:
+            return hit
+        if full_key is not None:
+            return self._store.get(full_key, into=into, byte_range=byte_range)
+        return None
+
+    def _probe_resident(
+        self,
+        exact_key: str,
+        full_key: Optional[str],
+        byte_range: Optional[List[int]],
+    ) -> bool:
+        """Meta-only residency probe — the ONLY cache work allowed while
+        holding the populate lock.  Reading the entry's data under the
+        lock would serialize every waiter's multi-MB copy behind one
+        flock (measured: a 5s convoy per worker on an 8-worker cold
+        start); the probe is two stats, and the data read runs outside."""
+        if self._store.resident_nbytes(exact_key) is not None:
+            return True
+        if full_key is not None:
+            nbytes = self._store.resident_nbytes(full_key)
+            if nbytes is not None and (
+                byte_range is None or byte_range[1] <= nbytes
+            ):
+                return True
+        return False
+
+    def _record_hit(self, nbytes: int) -> None:
+        with self._lock:
+            self.hits += 1
+            self.hit_bytes += nbytes
+
+    def _record_miss(self, nbytes: int) -> None:
+        with self._lock:
+            self.misses += 1
+            self.miss_bytes += nbytes
+
+    async def read(self, read_io: ReadIO) -> None:
+        import asyncio
+
+        from . import phase_stats
+
+        if not self._cacheable(read_io.path):
+            await self._inner.read(read_io)
+            return
+        exact_key, full_key, expect = keys_for(
+            self._ns, read_io.path, read_io.byte_range
+        )
+        loop = asyncio.get_running_loop()
+
+        def _lookup():
+            import time
+
+            begin = time.monotonic()
+            hit = self._try_get(
+                exact_key, full_key, read_io.byte_range, read_io.into
+            )
+            if hit is not None:
+                nbytes = (
+                    memoryview(read_io.into).nbytes
+                    if hit is True
+                    else len(hit)
+                )
+                phase_stats.add(
+                    "cache_read", time.monotonic() - begin, nbytes
+                )
+            return hit
+
+        hit = await loop.run_in_executor(self._executor, _lookup)
+        if hit is None:
+            # Single-flight the cold fetch: poll the per-key advisory lock
+            # with NON-blocking attempts from this event loop.  Waiters
+            # sleep here instead of parking executor threads in a blocking
+            # flock — with a bounded pool, enough blocked waiters would
+            # starve the holder's own populate and deadlock the key.  A
+            # sibling's populate landing mid-wait ends the wait early; on
+            # timeout the fetch proceeds lock-less (duplicated origin
+            # traffic, never an error).
+            lock_fd = None
+            deadline = loop.time() + _POPULATE_LOCK_TIMEOUT_S
+            while True:
+                lock_fd = await loop.run_in_executor(
+                    self._executor,
+                    self._store.try_acquire_populate_lock,
+                    exact_key,
+                )
+                if lock_fd is not None or loop.time() >= deadline:
+                    break
+                await asyncio.sleep(0.02)
+                if await loop.run_in_executor(
+                    self._executor,
+                    self._probe_resident,
+                    exact_key,
+                    full_key,
+                    read_io.byte_range,
+                ):
+                    break  # the holder finished: read it below
+            try:
+                resident = await loop.run_in_executor(
+                    self._executor,
+                    self._probe_resident,
+                    exact_key,
+                    full_key,
+                    read_io.byte_range,
+                )
+                if not resident:
+                    await self._inner.read(read_io)
+                    # No defensive copy: the populate below is awaited
+                    # before this read returns, so the caller cannot
+                    # mutate buf concurrently — put() reads it in place.
+                    data = memoryview(read_io.buf).cast("B")
+                    self._record_miss(data.nbytes)
+
+                    def _populate() -> None:
+                        with phase_stats.timed(
+                            "cache_populate", data.nbytes
+                        ):
+                            self._store.put(
+                                exact_key,
+                                data,
+                                expect_digest=(
+                                    expect
+                                    if read_io.byte_range is None
+                                    else None
+                                ),
+                            )
+
+                    await loop.run_in_executor(self._executor, _populate)
+                    return
+            finally:
+                await loop.run_in_executor(
+                    self._executor,
+                    self._store.release_populate_lock,
+                    lock_fd,
+                )
+            # A sibling populated while we queued: read it outside the
+            # lock.  A failed read here (evicted/corrupt in the window) is
+            # a plain origin fallback.
+            hit = await loop.run_in_executor(self._executor, _lookup)
+            if hit is None:
+                await self._inner.read(read_io)
+                self._record_miss(memoryview(read_io.buf).nbytes)
+                return
+        # Cache hit: the bytes never touched origin.
+        if hit is True:
+            read_io.buf = read_io.into
+            nbytes = memoryview(read_io.into).nbytes
+        else:
+            read_io.buf = hit
+            nbytes = len(hit)
+        read_io.hash64 = None  # consumers verify with their own pass
+        self._record_hit(nbytes)
+
+    # ------------------------------------------------------- passthroughs
+
+    async def write(self, write_io: WriteIO) -> None:
+        await self._inner.write(write_io)
+
+    async def exists(self, path: str) -> bool:
+        return await self._inner.exists(path)
+
+    async def list_dir(self, path: str) -> List[str]:
+        return await self._inner.list_dir(path)
+
+    async def delete(self, path: str) -> None:
+        await self._inner.delete(path)
+
+    async def delete_dir(self, path: str) -> None:
+        await self._inner.delete_dir(path)
+
+    async def copy_from_sibling(self, src_root: str, path: str) -> bool:
+        return await self._inner.copy_from_sibling(src_root, path)
+
+    async def close(self) -> None:
+        self._emit_summary()
+        try:
+            await self._inner.close()
+        finally:
+            self._executor.shutdown(wait=False)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_bytes": self.hit_bytes,
+                "miss_bytes": self.miss_bytes,
+            }
+
+    def _emit_summary(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            hits, misses = self.hits, self.misses
+            hit_bytes, miss_bytes = self.hit_bytes, self.miss_bytes
+        if not (hits or misses):
+            return
+        from .event import Event
+        from .event_handlers import log_event
+        from .telemetry import metrics as tmetrics
+
+        _add_totals(
+            hits=hits,
+            misses=misses,
+            hit_bytes=hit_bytes,
+            miss_bytes=miss_bytes,
+        )
+        tmetrics.record_cache(hits, misses, hit_bytes, miss_bytes)
+        if hits:
+            log_event(
+                Event(
+                    name="cache.hit",
+                    metadata={"count": hits, "bytes": hit_bytes},
+                )
+            )
+        if misses:
+            log_event(
+                Event(
+                    name="cache.miss",
+                    metadata={"count": misses, "bytes": miss_bytes},
+                )
+            )
+        logger.debug(
+            "cache: %d hits (%.1f MB local), %d misses (%.1f MB from origin)",
+            hits,
+            hit_bytes / 1e6,
+            misses,
+            miss_bytes / 1e6,
+        )
+
+
+# ----------------------------------------------------------------- wiring
+
+
+def maybe_wrap_cache_reads(storage: StoragePlugin, metadata: Any) -> StoragePlugin:
+    """Wrap a snapshot's (possibly CAS-wrapped) read storage with the host
+    chunk cache when ``TPUSNAP_CACHE_DIR`` is configured; a cache that
+    fails to initialize degrades to direct reads — caching is never
+    load-bearing for correctness."""
+    from . import knobs
+
+    cache_dir = knobs.get_cache_dir()
+    if not cache_dir:
+        return storage
+    try:
+        store = CacheStore(cache_dir)
+    except OSError:
+        logger.warning(
+            "chunk cache disabled: cannot initialize %s", cache_dir,
+            exc_info=True,
+        )
+        return storage
+    return CacheReaderPlugin(
+        inner=storage, store=store, namespace=snapshot_fingerprint(metadata)
+    )
+
+
+def find_reader(storage: StoragePlugin) -> Optional[CacheReaderPlugin]:
+    """The CacheReaderPlugin in a wrapped storage stack, or None."""
+    seen = 0
+    while storage is not None and seen < 8:
+        if isinstance(storage, CacheReaderPlugin):
+            return storage
+        storage = getattr(storage, "_inner", None)
+        seen += 1
+    return None
+
+
+def reader_stats(storage: StoragePlugin) -> Optional[Dict[str, int]]:
+    reader = find_reader(storage)
+    return reader.stats() if reader is not None else None
+
+
+# -------------------------------------------------------------------- warm
+
+
+def payload_locations(metadata: Any) -> List[Tuple[str, int]]:
+    """Distinct ``(location, best-known nbytes)`` for every payload a
+    manifest references — the unit ``warm`` pre-faults (whole objects, so
+    any later ranged read is a slice of a resident entry)."""
+    from .manifest import iter_payload_entries
+    from .serialization import array_nbytes
+
+    sizes: Dict[str, int] = {}
+    for _, entry in iter_payload_entries(metadata.manifest):
+        byte_range = getattr(entry, "byte_range", None)
+        if byte_range:
+            size = int(byte_range[1])
+        else:
+            try:
+                size = array_nbytes(entry.shape, entry.dtype)
+            except (AttributeError, ValueError):
+                size = 0
+        sizes[entry.location] = max(sizes.get(entry.location, 0), size)
+    return sorted(sizes.items())
+
+
+def warm_snapshot(
+    storage: StoragePlugin,
+    metadata: Any,
+    concurrency: int = 8,
+    max_in_flight_bytes: int = 2 << 30,
+) -> Dict[str, int]:
+    """Pre-fault every payload of a snapshot into the cache: one full read
+    per distinct location through ``storage`` (which must already be
+    cache- and CAS-wrapped), fanned across a thread pool — each read runs
+    the normal plugin data plane (native fs reads, ranged cloud fan-out).
+    In-flight bytes are capped at ``max_in_flight_bytes`` (each fetched
+    object is wholly buffered until its populate lands; without the cap,
+    concurrency × multi-GB slabs could OOM the host the warm is meant to
+    prepare — an over-limit object is admitted alone).  Returns totals:
+    locations, bytes, and how many were already resident (cache hits) vs
+    fetched."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    items = payload_locations(metadata)
+    limit = max(1, max_in_flight_bytes)
+    cv = threading.Condition()
+    in_flight = [0]
+
+    def _one(item: Tuple[str, int]) -> int:
+        location, expected = item
+        cost = min(max(expected, 1), limit)
+        with cv:
+            while in_flight[0] + cost > limit:
+                cv.wait(0.2)
+            in_flight[0] += cost
+        try:
+            read_io = ReadIO(path=location)
+            storage.sync_read(read_io)
+            return memoryview(read_io.buf).nbytes
+        finally:
+            with cv:
+                in_flight[0] -= cost
+                cv.notify_all()
+
+    total_bytes = 0
+    with ThreadPoolExecutor(
+        max_workers=max(1, concurrency), thread_name_prefix="tpusnap_warm"
+    ) as pool:
+        for nbytes in pool.map(_one, items):
+            total_bytes += nbytes
+    out = {"locations": len(items), "bytes": total_bytes}
+    stats = reader_stats(storage)
+    if stats is not None:
+        out.update(stats)
+    return out
+
+
+def residency(
+    store: CacheStore, metadata: Any, namespace: str
+) -> Dict[str, int]:
+    """How much of a snapshot's payload set is cache-resident (whole-object
+    entries only — range-keyed strays are a bonus the report ignores)."""
+    items = payload_locations(metadata)
+    resident = resident_bytes = total_bytes = 0
+    for location, nbytes in items:
+        total_bytes += nbytes
+        key, _ = full_key_for(namespace, location)
+        got = store.resident_nbytes(key)
+        if got is not None:
+            resident += 1
+            resident_bytes += got
+    return {
+        "locations": len(items),
+        "resident": resident,
+        "bytes_total": total_bytes,
+        "bytes_resident": resident_bytes,
+    }

@@ -69,6 +69,42 @@ class FaultInjectionError(RuntimeError):
     transient, so no retry layer masks it)."""
 
 
+# ------------------------------------------------------- origin accounting
+#
+# Every read that passes THROUGH a fault wrapper is tallied here (bytes the
+# wrapped backend was actually asked for, per path) — the counting half of
+# the wrapper.  ``TPUSNAP_FAULTS=none`` installs it with zero rules, turning
+# it into a pure origin-traffic meter: the partial-read and serve-cache
+# tests assert "bytes requested from origin" against these counters.
+# Process-wide (wrapper instances are per-operation and unreachable from
+# test code after the operation returns), guarded by one lock.
+
+_READ_COUNTER_LOCK = threading.Lock()
+_READ_BYTES_BY_PATH: dict = {}
+
+
+def reset_read_counters() -> None:
+    with _READ_COUNTER_LOCK:
+        _READ_BYTES_BY_PATH.clear()
+
+
+def read_counters() -> dict:
+    """``{path: bytes requested from the wrapped backend}`` since the last
+    reset.  Ranged reads count their range, whole reads the returned size."""
+    with _READ_COUNTER_LOCK:
+        return dict(_READ_BYTES_BY_PATH)
+
+
+def total_read_bytes() -> int:
+    with _READ_COUNTER_LOCK:
+        return sum(_READ_BYTES_BY_PATH.values())
+
+
+def _record_read(path: str, nbytes: int) -> None:
+    with _READ_COUNTER_LOCK:
+        _READ_BYTES_BY_PATH[path] = _READ_BYTES_BY_PATH.get(path, 0) + nbytes
+
+
 class InjectedTransientError(StorageTransientError):
     """A deliberately injected *transient* fault: retry layers treat it
     exactly like a real retryable storage error."""
@@ -260,6 +296,14 @@ class FaultyStoragePlugin(StoragePlugin):
             self._fire("read", read_io.path), "read", read_io.path
         )
         await self._inner.read(read_io)
+        if read_io.byte_range is not None:
+            nbytes = read_io.byte_range[1] - read_io.byte_range[0]
+        else:
+            try:
+                nbytes = memoryview(read_io.buf).nbytes
+            except (TypeError, ValueError):
+                nbytes = 0
+        _record_read(read_io.path, nbytes)
 
     async def delete(self, path: str) -> None:
         await self._raise_or_delay(self._fire("delete", path), "delete", path)

@@ -64,6 +64,10 @@ STORE_ADDR_ENV_VAR = _ENV_PREFIX + "STORE_ADDR"
 STORE_PATH_ENV_VAR = _ENV_PREFIX + "STORE_PATH"
 RANK_ENV_VAR = _ENV_PREFIX + "RANK"
 WORLD_SIZE_ENV_VAR = _ENV_PREFIX + "WORLD_SIZE"
+CACHE_DIR_ENV_VAR = _ENV_PREFIX + "CACHE_DIR"
+CACHE_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "CACHE_MAX_BYTES"
+PARTIAL_READS_ENV_VAR = _ENV_PREFIX + "PARTIAL_READS"
+PARTIAL_READ_MIN_SAVED_ENV_VAR = _ENV_PREFIX + "PARTIAL_READ_MIN_SAVED_BYTES"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -748,3 +752,78 @@ def get_env_world_size() -> Optional[int]:
     (``TPUSNAP_WORLD_SIZE``), or None."""
     val = os.environ.get(WORLD_SIZE_ENV_VAR)
     return int(val) if val is not None else None
+
+
+# Partial reads skip whole-payload checksum verification for the pieces they
+# shrink (the recorded digest covers bytes that were never fetched), so tiny
+# savings aren't worth it: below this many SAVED bytes the full piece is read
+# and verified as before.
+_DEFAULT_PARTIAL_READ_MIN_SAVED_BYTES = 64 * 1024
+
+
+def get_cache_dir() -> Optional[str]:
+    """Directory of the shared host-side chunk cache (``cache.py``), or
+    None — caching disabled (the default; no wrapper is installed and
+    restores read storage directly).  Point every co-located worker at the
+    same directory so a snapshot's chunks are fetched from GCS/S3/disk once
+    per host instead of once per process."""
+    val = os.environ.get(CACHE_DIR_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_cache_max_bytes() -> int:
+    """LRU size bound on the chunk cache directory; eviction (oldest access
+    first) runs opportunistically after populates.  0 (the default) means
+    unbounded — the operator owns the disk."""
+    return max(0, _get_int_env(CACHE_MAX_BYTES_ENV_VAR, 0))
+
+
+def partial_reads_enabled() -> bool:
+    """Whether sharded restores fetch only the byte ranges their shard plan
+    intersects (``TPUSNAP_PARTIAL_READS``, default on).  A partial piece
+    cannot be verified against its whole-payload digest, so checksum
+    verification is skipped for exactly the pieces this shrinks; ``0``
+    restores the read-whole-piece-and-verify behavior everywhere."""
+    return os.environ.get(PARTIAL_READS_ENV_VAR, "1") not in (
+        "0",
+        "false",
+        "",
+    )
+
+
+def get_partial_read_min_saved_bytes() -> int:
+    """Smallest byte saving that justifies shrinking a piece read (and
+    forgoing its whole-payload checksum verification)."""
+    return max(
+        0,
+        _get_int_env(
+            PARTIAL_READ_MIN_SAVED_ENV_VAR,
+            _DEFAULT_PARTIAL_READ_MIN_SAVED_BYTES,
+        ),
+    )
+
+
+@contextmanager
+def override_cache_dir(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(CACHE_DIR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_cache_max_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(CACHE_MAX_BYTES_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_partial_reads(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(PARTIAL_READS_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+@contextmanager
+def override_partial_read_min_saved_bytes(
+    value: int,
+) -> Generator[None, None, None]:
+    with _override_env(PARTIAL_READ_MIN_SAVED_ENV_VAR, str(value)):
+        yield

@@ -952,6 +952,93 @@ class SnapshotManager:
         snapshot._metadata = merged
         snapshot.restore(app_state)
 
+    def restore_point_times(
+        self,
+    ) -> List[Tuple[int, str, Optional[float]]]:
+        """:meth:`restore_points` plus each point's committed-at timestamp
+        (unix epoch).  Primary source is the root's step-history log —
+        ONE read covers every point, and a compaction-folded full step
+        keeps the timestamp its folded segment recorded under the same
+        step number (the fold is pure metadata with no take of its own).
+        Points absent from history fall back to their own take/async_take
+        telemetry sidecar; None when neither exists (taken with
+        ``TPUSNAP_SIDECAR=0``)."""
+        # step → newest committed-at ts, one history read for the root.
+        history_ts: Dict[int, float] = {}
+        try:
+            storage = url_to_storage_plugin(self.root)
+            try:
+                for entry in thistory.read(storage):
+                    step = entry.get("step")
+                    raw = entry.get("timestamp")
+                    if isinstance(step, int) and isinstance(
+                        raw, (int, float)
+                    ):
+                        history_ts[step] = float(raw)  # later entries win
+            finally:
+                storage.sync_close()
+        except Exception:
+            pass
+        out: List[Tuple[int, str, Optional[float]]] = []
+        for step, kind in self.restore_points():
+            ts: Optional[float] = history_ts.get(step)
+            if ts is None:
+                path = (
+                    self.path_for_step(step)
+                    if kind == "full"
+                    else journal_mod.segment_path(self.root, step)
+                )
+                try:
+                    snap_storage = url_to_storage_plugin(path)
+                    try:
+                        docs = tsidecar.read_all(snap_storage)  # newest-first
+                    finally:
+                        snap_storage.sync_close()
+                    for doc in docs:
+                        if doc.get("action") in ("take", "async_take") and (
+                            doc.get("rank", 1) == 0
+                        ):
+                            raw = doc.get("timestamp")
+                            if isinstance(raw, (int, float)):
+                                ts = float(raw)
+                            break
+                except Exception:
+                    pass
+            out.append((step, kind, ts))
+        return out
+
+    def step_as_of(self, as_of: float) -> int:
+        """The newest restore point committed at or before ``as_of`` (unix
+        epoch) — the point-in-time selector ``restore_as_of`` and the
+        ``warm``/``serve`` CLI's ``--time`` resolve through.  Points
+        without a timestamp (no sidecar) are skipped; raises ValueError
+        when nothing qualifies."""
+        dated = [
+            (step, kind, ts)
+            for step, kind, ts in self.restore_point_times()
+            if ts is not None
+        ]
+        if not dated:
+            raise ValueError(
+                f"no restore point under {self.root} carries a commit "
+                "timestamp (telemetry sidecars absent — taken with "
+                "TPUSNAP_SIDECAR=0?); point-in-time selection needs them"
+            )
+        eligible = [p for p in dated if p[2] <= as_of]
+        if not eligible:
+            raise ValueError(
+                f"no restore point under {self.root} existed at {as_of} "
+                f"(oldest dated point committed at {dated[0][2]})"
+            )
+        return eligible[-1][0]
+
+    def restore_as_of(self, as_of: float, app_state: AppState) -> int:
+        """Restore the snapshot "as of" a wall-clock instant: the newest
+        restore point committed at or before ``as_of``.  ROADMAP item 4's
+        point-in-time selector; same no-fallback contract as
+        :meth:`restore_at` — the caller asked for a specific instant."""
+        return self.restore_at(self.step_as_of(as_of), app_state)
+
     def restore_latest(self, app_state: AppState) -> Optional[int]:
         """Restore the newest committed restore point that actually loads
         — full snapshot or journal segment (replayed over its base) —

@@ -166,16 +166,21 @@ def copy_snapshot(
         metadata = Snapshot(src_path).metadata  # validates src is committed
         from . import cas
 
-        if cas.manifest_uses_cas(metadata.manifest):
-            # A CAS step is NOT self-contained: its payloads live in the
-            # root's shared cas/ store, and copying the step dir alone
-            # would yield a committed-looking snapshot with every chunk
-            # missing.  Materialize first.
-            raise RuntimeError(
-                f"{src_path} references content-addressed chunks (manifest "
-                f"{metadata.version}); run 'python -m torchsnapshot_tpu "
-                "repack <root> --export' to make steps self-contained "
-                "before copying them individually"
+        if cas.manifest_uses_cas(metadata.manifest) or (
+            metadata.journal is not None
+        ):
+            # A CAS step is NOT self-contained (its payloads live in the
+            # root's shared cas/ store) and a journal segment references a
+            # whole replay chain — both replicate chunk-by-chunk through
+            # the roots instead, skipping chunks the destination already
+            # holds (the natural way to seed a serving replica).
+            return _copy_cas_snapshot(
+                src_path,
+                dst_path,
+                metadata,
+                overwrite=overwrite,
+                io_concurrency=io_concurrency,
+                verify=verify,
             )
         if dst.sync_exists(SNAPSHOT_METADATA_FNAME):
             if not overwrite:
@@ -282,4 +287,297 @@ def copy_snapshot(
     finally:
         src.sync_close()
         dst.sync_close()
+    return Snapshot(dst_path)
+
+
+def _copy_cas_snapshot(
+    src_path: str,
+    dst_path: str,
+    metadata,
+    *,
+    overwrite: bool,
+    io_concurrency: int,
+    verify: bool,
+) -> Snapshot:
+    """Chunk-level replication of a content-addressed (or journal) step.
+
+    The step dir alone is not self-contained — its payloads live in the
+    root's shared ``cas/`` store, and a journal segment additionally
+    references its replay chain (base + prior segments).  So the copy runs
+    through the two ROOTS: every referenced chunk is replicated into the
+    destination root's store, **skipping chunks already present there**
+    (cross-snapshot dedup makes seeding a serving replica incremental —
+    the second step of a fine-tune run ships only its delta), then each
+    chain member's non-CAS payloads, then the commit markers — chain
+    members first, the target last, so an interrupted copy never leaves a
+    destination that opens as a valid snapshot but can't replay.
+
+    Chain members already committed at the destination are trusted as
+    shared lineage (their payload copies are skipped; the chunk union was
+    replicated regardless).  ``verify=True`` audits every chain member's
+    checksummed payloads on the destination before any marker is written.
+    """
+    from . import cas
+    from .manifest import SnapshotMetadata, iter_payload_entries
+
+    src_root_url = cas.parent_root_url(src_path)
+    dst_root_url = cas.parent_root_url(dst_path)
+    if src_root_url is None or dst_root_url is None:
+        raise RuntimeError(
+            f"cannot replicate {src_path} -> {dst_path}: a content-"
+            "addressed snapshot must live one level under the root that "
+            "owns its cas/ store on BOTH ends"
+        )
+    src_name = parse_url(src_path)[1].rstrip("/").rsplit("/", 1)[-1]
+    dst_name = parse_url(dst_path)[1].rstrip("/").rsplit("/", 1)[-1]
+    src_root = url_to_storage_plugin(src_root_url)
+    dst_root = url_to_storage_plugin(dst_root_url)
+    try:
+        # The copy set: (src dirname, dst dirname, manifest) per chain
+        # member, target last.
+        chain = []
+        if metadata.journal is not None:
+            if dst_name != src_name:
+                raise RuntimeError(
+                    f"cannot rename a journal segment in transit "
+                    f"({src_name} -> {dst_name}): its chain references "
+                    "segments by step number"
+                )
+            info = metadata.journal
+            members = [f"step_{info['base_step']}"] + [
+                f"seg_{p}" for p in info.get("prior_segments", [])
+            ]
+            for dirname in members:
+                read_io = ReadIO(path=f"{dirname}/{SNAPSHOT_METADATA_FNAME}")
+                try:
+                    src_root.sync_read(read_io)
+                except Exception as e:
+                    raise RuntimeError(
+                        f"cannot replicate {src_path}: chain member "
+                        f"{dirname} is unreadable at the source ({e})"
+                    ) from e
+                chain.append(
+                    (
+                        dirname,
+                        dirname,
+                        SnapshotMetadata.from_json(
+                            bytes(read_io.buf).decode("utf-8")
+                        ),
+                    )
+                )
+        chain.append((src_name, dst_name, metadata))
+
+        target_marker = f"{dst_name}/{SNAPSHOT_METADATA_FNAME}"
+        if dst_root.sync_exists(target_marker):
+            if not overwrite:
+                raise RuntimeError(
+                    f"{dst_path} already holds a committed snapshot "
+                    f"(pass overwrite=True to replace it)"
+                )
+            dst_root.sync_delete(target_marker)
+
+        # Chain members already committed at the destination are only
+        # trusted as shared lineage when their manifest actually matches
+        # the source's — a same-numbered step from a DIFFERENT run would
+        # otherwise become the replica's replay base and every unchanged
+        # entry would resolve to foreign weights.
+        shared_lineage = set()
+        for src_dir, dst_dir, md in chain[:-1]:
+            if not dst_root.sync_exists(
+                f"{dst_dir}/{SNAPSHOT_METADATA_FNAME}"
+            ):
+                continue
+            read_io = ReadIO(path=f"{dst_dir}/{SNAPSHOT_METADATA_FNAME}")
+            try:
+                dst_root.sync_read(read_io)
+                dst_md = SnapshotMetadata.from_json(
+                    bytes(read_io.buf).decode("utf-8")
+                )
+            except Exception:
+                # Torn/unreadable committed-looking member (a prior copy's
+                # crash debris): not lineage evidence either way — recopy
+                # it below, marker included.
+                continue
+            if dst_md.to_json() != md.to_json():
+                raise RuntimeError(
+                    f"cannot replicate {src_path}: the destination root "
+                    f"already holds a committed {dst_dir} whose manifest "
+                    "differs from the source chain member — different "
+                    "lineage; refusing to graft the segment onto foreign "
+                    "base data"
+                )
+            shared_lineage.add(dst_dir)
+
+        chunks = set()
+        for _, _, md in chain:
+            chunks |= cas.referenced_chunk_relpaths(md.manifest)
+
+        copied = skipped = 0
+        src_root_path = parse_url(src_root_url)[1]
+        same_backend = parse_url(src_root_url)[0] == parse_url(dst_root_url)[0]
+
+        def _copy_chunk(relpath: str) -> bool:
+            # Chunks are immutable and digest-named: presence at the
+            # destination means the bytes are already there (torn debris
+            # is the durable-write contract's job; --verify audits).
+            if dst_root.sync_exists(relpath):
+                return False
+            if same_backend:
+                # Server-side duplication (fs hard link, S3 CopyObject,
+                # GCS rewrite): no chunk bytes through this host — the
+                # same fast path the streaming copy uses; False/raise
+                # falls back to the stream below.
+                try:
+                    if run_coro(
+                        lambda: dst_root.copy_from_sibling(
+                            src_root_path, relpath
+                        )
+                    ):
+                        return True
+                except Exception as e:  # noqa: BLE001
+                    logger.debug(
+                        "server-side chunk copy failed for %s (%s); "
+                        "streaming",
+                        relpath,
+                        e,
+                    )
+            read_io = ReadIO(path=relpath)
+            src_root.sync_read(read_io)
+            dst_root.sync_write(
+                WriteIO(path=relpath, buf=read_io.buf, durable=True)
+            )
+            return True
+
+        with ThreadPoolExecutor(
+            max_workers=max(1, io_concurrency),
+            thread_name_prefix="snap_cas_copy",
+        ) as pool:
+            for was_copied in pool.map(_copy_chunk, sorted(chunks)):
+                if was_copied:
+                    copied += 1
+                else:
+                    skipped += 1
+        logger.info(
+            "cas copy %s -> %s: %d chunk(s) replicated, %d already present",
+            src_path,
+            dst_path,
+            copied,
+            skipped,
+        )
+
+        # Non-CAS payloads (mixed manifests are legal) per chain member:
+        # the same pooled, byte-budgeted streaming the plain copy path
+        # uses — a multi-GB non-CAS payload must not be buffered without
+        # a cap, nor many small ones copied one at a time.
+        payload_items = []
+        for src_dir, dst_dir, md in chain:
+            if dst_dir in shared_lineage:
+                continue  # verified-identical committed member at dst
+            sizes = _payload_sizes(md)
+            for location in sorted(
+                {
+                    e.location
+                    for _, e in iter_payload_entries(md.manifest)
+                    if not cas.is_cas_location(e.location)
+                }
+            ):
+                payload_items.append(
+                    (src_dir, dst_dir, location, sizes.get(location, 0))
+                )
+        if payload_items:
+            budget = _ByteBudget(_DEFAULT_MAX_IN_FLIGHT_BYTES)
+            cancel = threading.Event()
+
+            def _copy_payload(item) -> None:
+                p_src_dir, p_dst_dir, location, size = item
+                if cancel.is_set():
+                    raise _CopyCancelled("copy aborted by sibling failure")
+                budget.acquire(size, cancel)
+                try:
+                    read_io = ReadIO(path=f"{p_src_dir}/{location}")
+                    src_root.sync_read(read_io)
+                    # durable like the chunks: the fsynced markers below
+                    # must never commit over page-cache payload bytes.
+                    dst_root.sync_write(
+                        WriteIO(
+                            path=f"{p_dst_dir}/{location}",
+                            buf=read_io.buf,
+                            durable=True,
+                        )
+                    )
+                finally:
+                    budget.release(size)
+
+            with ThreadPoolExecutor(
+                max_workers=max(1, io_concurrency),
+                thread_name_prefix="snap_cas_copy",
+            ) as pool:
+                futures = {
+                    pool.submit(_copy_payload, item): item
+                    for item in payload_items
+                }
+                done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+                failed = next(
+                    (
+                        f
+                        for f in done
+                        if f.exception() is not None
+                        and not isinstance(f.exception(), _CopyCancelled)
+                    ),
+                    None,
+                )
+                if failed is not None:
+                    cancel.set()
+                    for fut in not_done:
+                        fut.cancel()
+                    wait(not_done)
+                    raise RuntimeError(
+                        f"copying {futures[failed][2]} from {src_path} to "
+                        f"{dst_path} failed"
+                    ) from failed.exception()
+
+        if verify:
+            # Before ANY marker lands: a failed audit must leave an
+            # uncommitted destination (same contract as the streaming path).
+            from . import integrity
+            from .integrity import ChecksumError
+
+            total_ok = 0
+            for _, dst_dir, md in chain:
+                dst_step = url_to_storage_plugin(f"{dst_root_url}/{dst_dir}")
+                wrapped = cas.maybe_wrap_cas_reads(
+                    dst_step, f"{dst_root_url}/{dst_dir}", md
+                )
+                try:
+                    ok, corrupt, unreadable, problems = integrity.audit(
+                        wrapped, md, io_concurrency=io_concurrency
+                    )
+                finally:
+                    wrapped.sync_close()
+                if corrupt or unreadable:
+                    raise ChecksumError(
+                        f"copy verification failed for {dst_root_url}/"
+                        f"{dst_dir}: " + "; ".join(problems)
+                    )
+                total_ok += ok
+            if total_ok == 0:
+                raise RuntimeError(
+                    f"cannot verify copy of {src_path}: the source "
+                    f"manifests record no checksums"
+                )
+
+        # Markers last, chain order (base, priors, target): every commit a
+        # reader can see is replayable from what already landed.
+        for src_dir, dst_dir, _ in chain:
+            dst_marker = f"{dst_dir}/{SNAPSHOT_METADATA_FNAME}"
+            if dst_dir in shared_lineage:
+                continue
+            read_io = ReadIO(path=f"{src_dir}/{SNAPSHOT_METADATA_FNAME}")
+            src_root.sync_read(read_io)
+            dst_root.sync_write(
+                WriteIO(path=dst_marker, buf=read_io.buf, durable=True)
+            )
+    finally:
+        src_root.sync_close()
+        dst_root.sync_close()
     return Snapshot(dst_path)

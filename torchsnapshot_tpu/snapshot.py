@@ -561,11 +561,17 @@ class Snapshot:
                 # Digest references (manifest 0.4.0) resolve against the
                 # root's cas/ store transparently; a no-op for per-step
                 # layouts.
+                from . import cache as cache_mod
                 from . import cas as cas_mod
 
                 storage = cas_mod.maybe_wrap_cas_reads(
                     storage, self.path, metadata, self._storage_options
                 )
+                # Shared host chunk cache (TPUSNAP_CACHE_DIR): co-located
+                # workers restoring the same snapshot fetch each payload
+                # from origin once per host.  Outside the CAS wrapper so
+                # cas:// digests are the cache keys.
+                storage = cache_mod.maybe_wrap_cache_reads(storage, metadata)
                 app_state = dict(app_state)
                 rng_state_item = self._pop_rng_state(app_state)
                 global_keys = self._gather_keys(app_state, pg)
@@ -600,6 +606,15 @@ class Snapshot:
                     )
                 phases_delta = phase_stats.delta(phases_before)
                 if tsidecar.enabled():
+                    extra = {
+                        "world_size": pg.get_world_size(),
+                        "rss_high_water_bytes": health.rss_high_water(),
+                    }
+                    cache_stats = cache_mod.reader_stats(storage)
+                    if cache_stats is not None:
+                        # Bytes served locally vs fetched from origin — the
+                        # serving tier's per-restore record.
+                        extra["cache"] = cache_stats
                     tsidecar.write(
                         storage,
                         tsidecar.build(
@@ -608,12 +623,7 @@ class Snapshot:
                             rank=rank,
                             duration_s=time.monotonic() - begin,
                             phases=phases_delta,
-                            extra={
-                                "world_size": pg.get_world_size(),
-                                "rss_high_water_bytes": (
-                                    health.rss_high_water()
-                                ),
-                            },
+                            extra=extra,
                         ),
                     )
             finally:
@@ -771,11 +781,13 @@ class Snapshot:
             storage = url_to_storage_plugin(self.path, self._storage_options)
             try:
                 metadata = self._get_metadata(storage)
+                from . import cache as cache_mod
                 from . import cas as cas_mod
 
                 storage = cas_mod.maybe_wrap_cas_reads(
                     storage, self.path, metadata, self._storage_options
                 )
+                storage = cache_mod.maybe_wrap_cache_reads(storage, metadata)
                 manifest, _ = get_manifest_for_rank(metadata, int(rank_str))
                 if logical_path not in manifest:
                     raise RuntimeError(
@@ -851,11 +863,13 @@ class Snapshot:
         storage = url_to_storage_plugin(self.path, self._storage_options)
         try:
             metadata = self._get_metadata(storage)
+            from . import cache as cache_mod
             from . import cas as cas_mod
 
             storage = cas_mod.maybe_wrap_cas_reads(
                 storage, self.path, metadata, self._storage_options
             )
+            storage = cache_mod.maybe_wrap_cache_reads(storage, metadata)
             rank = 0 if replicate_from_rank0 else self._pg.get_rank()
             local_manifest, _ = get_manifest_for_rank(metadata, rank)
             prefix = key + "/"

@@ -61,8 +61,13 @@ PHASE_GROUPS: Dict[str, frozenset] = {
     # wall spent driving storage, so they classify as storage_io (the
     # folded-in hash work is exactly what no longer exists as a separate
     # serialize-group pass).  native_read also matches the _read suffix;
-    # native_write_hash needs the explicit entry.
-    "storage_io": frozenset({"native_write_hash", "native_read"}),
+    # native_write_hash needs the explicit entry.  The chunk cache's
+    # phases (cache.py) are local-disk I/O standing in for origin storage,
+    # so they classify the same way (cache_read would suffix-match anyway;
+    # both are listed so the registry is explicit).
+    "storage_io": frozenset(
+        {"native_write_hash", "native_read", "cache_read", "cache_populate"}
+    ),
 }
 _STORAGE_SUFFIXES = ("_write", "_read")
 # A wait group only names the limiting resource when it covers at least

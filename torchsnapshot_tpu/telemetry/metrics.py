@@ -385,6 +385,49 @@ def record_cas_dedup(hits: int, bytes_saved: int) -> None:
     ).inc(bytes_saved)
 
 
+def record_cache(
+    hits: int, misses: int, hit_bytes: int, miss_bytes: int
+) -> None:
+    """One read operation's chunk-cache outcome (cache.py): how many
+    payload reads were served from the shared host cache vs fetched from
+    origin storage, and the byte split — the serving tier's headline."""
+    if not enabled() or not (hits or misses):
+        return
+    if hits:
+        counter(
+            "tpusnap_cache_hits_total",
+            "Payload reads served from the shared host chunk cache",
+        ).inc(hits)
+        counter(
+            "tpusnap_cache_hit_bytes_total",
+            "Payload bytes served from the shared host chunk cache",
+        ).inc(hit_bytes)
+    if misses:
+        counter(
+            "tpusnap_cache_misses_total",
+            "Payload reads that missed the chunk cache (fetched from origin)",
+        ).inc(misses)
+        counter(
+            "tpusnap_cache_miss_bytes_total",
+            "Payload bytes fetched from origin on chunk-cache misses",
+        ).inc(miss_bytes)
+
+
+def record_cache_evicted(entries: int, nbytes: int) -> None:
+    """An LRU eviction pass reclaimed cache entries to fit the
+    ``TPUSNAP_CACHE_MAX_BYTES`` bound."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_cache_evicted_bytes_total",
+        "Chunk-cache bytes reclaimed by LRU eviction",
+    ).inc(nbytes)
+    counter(
+        "tpusnap_cache_evicted_entries_total",
+        "Chunk-cache entries removed by LRU eviction",
+    ).inc(entries)
+
+
 def record_journal_segment(delta_entries: int, delta_bytes: int) -> None:
     """One committed journal delta segment (journal.py): how many manifest
     entries changed and their logical payload bytes — the per-step append
@@ -495,6 +538,9 @@ DIRECT_METRIC_EVENTS = frozenset(
         "journal.compaction",  # record_journal_compaction
         "journal.fallback",  # record_journal_fallback
         "native.degraded",  # record_native_degraded
+        "cache.hit",  # record_cache
+        "cache.miss",  # record_cache
+        "cache.evict",  # record_cache_evicted
     }
 )
 
