@@ -202,6 +202,117 @@ def test_phase_group_classification():
     assert analyze.classify_phase("h2d_land") == "h2d"
     assert analyze.classify_phase("budget_wait") == "memory_budget"
     assert analyze.classify_phase("io_slot_wait") == "io_concurrency"
+    # The new wait groups: barrier skew and cache single-flight waits
+    # classify as waits, so they can name the limiting resource without
+    # inflating any work group.
+    assert analyze.classify_phase("barrier_wait") == "barrier"
+    assert analyze.classify_phase("cache_wait") == "cache_wait"
+    for group in ("barrier", "cache_wait"):
+        assert group in analyze.WAIT_GROUPS
+
+
+# ----------------------------------------------------------- barrier blame
+
+
+def _barrier_sidecar(rank, arrive_offsets, phases, t0=1700000000.0):
+    """One rank's sidecar carrying the exchanged barrier table."""
+    return {
+        "schema_version": "1.0",
+        "action": "async_take",
+        "op_id": OP,
+        "rank": rank,
+        "timestamp": t0 + 30,
+        "success": True,
+        "duration_s": 30.0,
+        "bytes": 1 << 30,
+        "phases": phases,
+        "knobs": {},
+        "barrier": {
+            "world_size": len(arrive_offsets),
+            "arrivals": {
+                str(r): {"arrive": t0 + off, "depart": t0 + 10.0}
+                for r, off in arrive_offsets.items()
+            },
+        },
+    }
+
+
+@pytest.fixture
+def barrier_fixture(tmp_path):
+    """Two ranks: rank 1 arrives 5 s late with fs_write as its dominant
+    pre-barrier work phase; rank 0 burned the skew in barrier_wait."""
+    offsets = {0: 0.0, 1: 5.0}
+    docs = [
+        _barrier_sidecar(
+            0,
+            offsets,
+            {
+                "fs_write": {"s": 2.0, "wall": 2.0, "bytes": 1 << 30, "n": 4},
+                "barrier_wait": {"s": 5.0, "wall": 5.0, "bytes": 0, "n": 1},
+            },
+        ),
+        _barrier_sidecar(
+            1,
+            offsets,
+            {
+                "fs_write": {"s": 7.0, "wall": 7.0, "bytes": 1 << 30, "n": 4},
+                "d2h": {"s": 1.0, "wall": 1.0, "bytes": 1 << 30, "n": 4},
+                "barrier_wait": {"s": 0.01, "wall": 0.01, "bytes": 0, "n": 1},
+            },
+        ),
+    ]
+    snap_dir = tmp_path / "snap"
+    (snap_dir / "telemetry").mkdir(parents=True)
+    for doc in docs:
+        path = (
+            snap_dir
+            / "telemetry"
+            / f"async_take-{OP[:8]}-rank{doc['rank']}.json"
+        )
+        path.write_text(json.dumps(doc))
+    return docs, snap_dir
+
+
+def test_barrier_blame_golden(barrier_fixture):
+    """The golden two-rank case: skew 5 s, rank 1 blamed, fs_write named
+    as the phase the fleet waited on (barrier_wait excluded from blame)."""
+    docs, _ = barrier_fixture
+    (rep,) = analyze.barrier_blame(docs)
+    assert rep["kind"] == "async_take" and rep["world"] == 2
+    assert rep["skew_s"] == pytest.approx(5.0)
+    assert rep["first_rank"] == 0
+    assert rep["blamed_rank"] == 1
+    assert rep["blamed_phase"] == "fs_write"
+    assert rep["blamed_phase_wall_s"] == pytest.approx(7.0)
+    assert rep["arrivals_rel_s"] == {"0": 0.0, "1": 5.0}
+    assert rep["barrier_wait_s"]["0"] == pytest.approx(5.0)
+
+
+def test_barrier_blame_cli_json_and_human(barrier_fixture, capsys):
+    _, snap_dir = barrier_fixture
+    rc = cli_main(["analyze", str(snap_dir), "--barrier", "--json"])
+    assert rc == 0
+    (rep,) = json.loads(capsys.readouterr().out)
+    assert rep["blamed_rank"] == 1 and rep["blamed_phase"] == "fs_write"
+    rc = cli_main(["analyze", str(snap_dir), "--barrier"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "skew 5.000s" in out
+    assert "rank 1 arrived last" in out
+    assert "fs_write" in out and "<< straggler" in out
+
+
+def test_barrier_blame_requires_two_ranks(tmp_path, capsys):
+    """Single-rank sidecars (or none) yield no report and exit 2."""
+    snap_dir = tmp_path / "snap"
+    (snap_dir / "telemetry").mkdir(parents=True)
+    doc = _barrier_sidecar(0, {0: 0.0}, {})
+    (snap_dir / "telemetry" / "async_take-x-rank0.json").write_text(
+        json.dumps(doc)
+    )
+    assert analyze.barrier_blame([doc]) == []
+    assert cli_main(["analyze", str(snap_dir), "--barrier"]) == 2
+    assert "no barrier data" in capsys.readouterr().out
 
 
 # ------------------------------------------------------------ step history

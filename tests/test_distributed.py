@@ -322,6 +322,48 @@ def test_elastic_upscale_restore():
     _restore4_body()
 
 
+@run_with_procs(nproc=2)
+def _async_take_barrier_sidecar_body():
+    import glob
+    import json
+    import shutil
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+
+    pg = make_test_pg()
+    rank = pg.get_rank()
+    path = os.path.join(SNAP_ROOT, "barrier_blame")
+    if rank == 0:
+        shutil.rmtree(path, ignore_errors=True)
+    pg.barrier()
+    app_state = {
+        "m": StateDict({"w": np.full((8,), float(rank), np.float32)})
+    }
+    pending = Snapshot.async_take(path, app_state, pg=pg)
+    pending.wait()
+    pg.barrier()
+    if rank == 0:
+        docs = [
+            json.load(open(p))
+            for p in glob.glob(
+                os.path.join(path, "telemetry", "async_take-*.json")
+            )
+        ]
+        assert len(docs) == 2, docs
+        tables = [d.get("barrier") for d in docs if d.get("barrier")]
+        assert tables, docs
+        arrivals = tables[0]["arrivals"]
+        assert set(arrivals) == {"0", "1"}
+        assert all("arrive" in row for row in arrivals.values())
+
+
+def test_async_take_sidecar_carries_barrier_table():
+    """2-rank async commit: each rank's sidecar records every rank's
+    store-exchanged arrive/depart stamps — the raw input for
+    `analyze --barrier`'s cross-rank blame table."""
+    _async_take_barrier_sidecar_body()
+
+
 @run_with_procs(nproc=4)
 def _save4_sharded_meta_body():
     """Each of 4 ranks contributes sharded records via plain manifests:

@@ -4,12 +4,14 @@
 #
 #   1. tpusnap lint            — project-invariant static analysis (always)
 #   2. tpusnap lint --external — ruff + mypy when installed (skip = ok)
-#   3. tier-1 pytest           — the ROADMAP verify suite (not slow-marked)
-#   4. sanitizer smoke         — TSAN race-regression legs, only when the
+#   3. bench trajectory        — banked BENCH_r*/SERVE_r* rounds vs their
+#                                trailing medians (perf-regression gate)
+#   4. tier-1 pytest           — the ROADMAP verify suite (not slow-marked)
+#   5. sanitizer smoke         — TSAN race-regression legs, only when the
 #                                toolchain can build+host the instrumented
 #                                library (the suite itself skips otherwise)
 #
-# Usage: tools/check.sh [--fast]   (--fast = lint tiers only, no pytest)
+# Usage: tools/check.sh [--fast]   (--fast = lint + trajectory, no pytest)
 
 set -u -o pipefail
 
@@ -25,6 +27,13 @@ python -m torchsnapshot_tpu lint "$REPO_ROOT" || fail=1
 
 step "tpusnap lint --external (ruff + mypy; missing tools skip)"
 python -m torchsnapshot_tpu lint "$REPO_ROOT" --external || fail=1
+
+# Perf-trajectory gate: the banked BENCH_r*/SERVE_r* rounds folded into
+# per-series trends with trailing-median regression detection (reuses
+# telemetry/history.py's logic) — a PR that tanks a banked number fails
+# here, not in the next human's head.
+step "bench trajectory (banked rounds, trailing-median regression gate)"
+python tools/bench_trajectory.py "$REPO_ROOT" --fail-on-regression || fail=1
 
 if [ "${1:-}" = "--fast" ]; then
   [ "$fail" -eq 0 ] && echo "check.sh --fast: OK" || echo "check.sh --fast: FAILED"

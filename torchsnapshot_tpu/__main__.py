@@ -12,14 +12,19 @@
     python -m torchsnapshot_tpu stats <snapshot-url> [--json] [--metrics]
     python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
     python -m torchsnapshot_tpu analyze <trace-dir> [--snapshot URL] [--json]
+    python -m torchsnapshot_tpu analyze <snapshot-url> --barrier [--json]
     python -m torchsnapshot_tpu history <manager-root-url> [--json]
     python -m torchsnapshot_tpu lint [root] [--external] [--json]
     python -m torchsnapshot_tpu warm <root-or-snapshot> [--step N | --time T]
     python -m torchsnapshot_tpu serve <root-or-snapshot> [--step N | --time T]
+    python -m torchsnapshot_tpu top [spool-or-root] [--json | --prometheus]
 
-Read-only except ``cp``, ``gc --apply`` and ``warm`` (which populates the
-host chunk cache); works against any storage backend URL.  (Beyond
-reference parity: the reference ships no CLI.)
+Read-only except ``cp``, ``gc --apply``, ``warm`` (which populates the
+host chunk cache), the best-effort telemetry sidecars ``warm``/``serve``
+record next to the snapshot's (``TPUSNAP_SIDECAR=0`` opts out), and
+``top``'s live mode (which sweeps stale spool entries; ``--json``/
+``--prometheus`` are pure reads); works against any storage backend URL.
+(Beyond reference parity: the reference ships no CLI.)
 """
 
 from __future__ import annotations
@@ -631,10 +636,24 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     (telemetry/analyze.py): per-phase exclusive wall, scheduler idle, the
     limiting resource (d2h vs serialize vs storage vs budget/io-cap
     throttling), and the straggler rank.  ``--snapshot`` enriches the
-    report with that snapshot's telemetry sidecars."""
+    report with that snapshot's telemetry sidecars.  ``--barrier``
+    switches to the cross-rank commit-barrier blame report (skew, last
+    arriver, and its dominant pre-barrier phase) computed from the
+    per-rank barrier stamps the sidecars carry — the positional argument
+    is then the snapshot URL itself."""
     import json
 
     from .telemetry import analyze, trace
+
+    if args.barrier:
+        snapshot_url = args.snapshot or args.trace_dir
+        sidecars = analyze.load_sidecars(snapshot_url)
+        reports = analyze.barrier_blame(sidecars)
+        if args.json:
+            print(json.dumps(reports, indent=1))
+        else:
+            print(analyze.render_barrier(reports))
+        return 0 if reports else 2
 
     try:
         docs = analyze.load_trace_dir(args.trace_dir)
@@ -673,6 +692,48 @@ def cmd_history(args: argparse.Namespace) -> int:
         print(json.dumps(entries, indent=1))
     else:
         print(history.render(entries, limit=args.limit))
+    return 0
+
+
+def cmd_top(args: argparse.Namespace) -> int:
+    """Live cross-process fleet view (telemetry/fleet.py): every op
+    publishing into the ``TPUSNAP_FLEET_TELEMETRY`` spool renders as one
+    row — phase state, bytes staged/written, ETA — plus aggregate
+    bandwidth, cache hit ratio/origin bytes, and the straggler.  Plain
+    table refreshed every ``--interval`` (Ctrl-C exits); ``--json`` is a
+    one-shot machine-readable snapshot, ``--prometheus`` a merged text
+    exposition so one scrape sees the whole fleet."""
+    import json
+    import os as _os
+    import time as _time
+
+    from .telemetry import fleet
+
+    spool = fleet.resolve_spool(args.path)
+    if spool is None or not _os.path.isdir(spool):
+        print(
+            "no fleet telemetry spool found: pass a spool dir (or a root "
+            "with telemetry/live under it) or set TPUSNAP_FLEET_TELEMETRY"
+        )
+        return 2
+    if args.prometheus:
+        entries = fleet.collect(spool, stale_s=args.stale, sweep=False)
+        print(fleet.render_prometheus(entries), end="")
+        return 0
+    if args.json:
+        entries = fleet.collect(spool, stale_s=args.stale, sweep=False)
+        print(json.dumps(fleet.aggregate(entries), indent=1))
+        return 0
+    try:
+        while True:
+            entries = fleet.collect(spool, stale_s=args.stale)
+            print(fleet.render(fleet.aggregate(entries), spool))
+            if args.once:
+                return 0
+            print()
+            _time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -779,12 +840,18 @@ def cmd_warm(args: argparse.Namespace) -> int:
     (``TPUSNAP_CACHE_DIR``), so the N restore workers that follow hit
     local disk instead of origin storage.  Parallel full-object reads
     through the normal plugin data plane (native fs reads, ranged cloud
-    fan-out); idempotent — already-resident chunks are cache hits."""
+    fan-out); idempotent — already-resident chunks are cache hits.
+    Writes a ``warm`` telemetry sidecar next to the snapshot's (like
+    take/restore do; ``TPUSNAP_SIDECAR=0`` opts out) and publishes fleet
+    telemetry when ``TPUSNAP_FLEET_TELEMETRY`` is set."""
     import contextlib
     import time as _time
+    import uuid as _uuid
 
     from . import cache as cache_mod
-    from . import knobs
+    from . import knobs, phase_stats
+    from .telemetry import monitor as tmonitor
+    from .telemetry import sidecar as tsidecar
 
     ctx = (
         knobs.override_cache_dir(args.cache_dir)
@@ -805,14 +872,42 @@ def cmd_warm(args: argparse.Namespace) -> int:
             storage.sync_close()
             print(f"cache directory {cache_dir} could not be initialized")
             return 2
+        op_id = _uuid.uuid4().hex
+        phases_before = phase_stats.snapshot()
+        health = tmonitor.op_started("warm", op_id, 0, watchdog=False)
         begin = _time.monotonic()
         try:
-            stats = cache_mod.warm_snapshot(
-                storage, metadata, concurrency=args.concurrency
-            )
+            try:
+                stats = cache_mod.warm_snapshot(
+                    storage, metadata, concurrency=args.concurrency
+                )
+            except BaseException:
+                tmonitor.op_finished(health, success=False)
+                raise
+            wall = _time.monotonic() - begin
+            tmonitor.op_finished(health, success=True)
+            if tsidecar.enabled():
+                cache_stats = {
+                    k: stats.get(k, 0)
+                    for k in ("hits", "misses", "hit_bytes", "miss_bytes")
+                }
+                tsidecar.write(
+                    storage,
+                    tsidecar.build(
+                        action="warm",
+                        unique_id=op_id,
+                        rank=0,
+                        duration_s=wall,
+                        phases=phase_stats.delta(phases_before),
+                        nbytes=stats["bytes"],
+                        extra={
+                            "cache": cache_stats,
+                            "locations": stats["locations"],
+                        },
+                    ),
+                )
         finally:
             storage.sync_close()
-        wall = _time.monotonic() - begin
         store = cache_mod.CacheStore(cache_dir)
         res = cache_mod.residency(
             store, metadata, cache_mod.snapshot_fingerprint(metadata)
@@ -835,12 +930,20 @@ def cmd_warm(args: argparse.Namespace) -> int:
 def cmd_serve(args: argparse.Namespace) -> int:
     """Report a snapshot's cache residency — how ready this host is to
     serve N concurrent restores from local disk — plus the cache
-    directory's totals.  Read-only (run ``warm`` to change the answer)."""
+    directory's totals.  Payload-read-only (run ``warm`` to change the
+    answer); like take/restore it records a ``serve`` telemetry sidecar
+    with the residency probe (``TPUSNAP_SIDECAR=0`` opts out) and shows
+    up in the ``tpusnap top`` fleet view when publishing is on."""
     import contextlib
     import json
+    import time as _time
+    import uuid as _uuid
 
     from . import cache as cache_mod
-    from . import knobs
+    from . import knobs, phase_stats
+    from .storage_plugin import url_to_storage_plugin
+    from .telemetry import monitor as tmonitor
+    from .telemetry import sidecar as tsidecar
 
     ctx = (
         knobs.override_cache_dir(args.cache_dir)
@@ -855,12 +958,40 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 "--cache-dir"
             )
             return 2
-        snap_path, metadata = _serving_target(args.path, args.step, args.time)
-        store = cache_mod.CacheStore(cache_dir)
-        res = cache_mod.residency(
-            store, metadata, cache_mod.snapshot_fingerprint(metadata)
-        )
-        totals = store.stats()
+        op_id = _uuid.uuid4().hex
+        phases_before = phase_stats.snapshot()
+        health = tmonitor.op_started("serve", op_id, 0, watchdog=False)
+        begin = _time.monotonic()
+        try:
+            snap_path, metadata = _serving_target(
+                args.path, args.step, args.time
+            )
+            store = cache_mod.CacheStore(cache_dir)
+            res = cache_mod.residency(
+                store, metadata, cache_mod.snapshot_fingerprint(metadata)
+            )
+            totals = store.stats()
+        except BaseException:
+            tmonitor.op_finished(health, success=False)
+            raise
+        tmonitor.op_finished(health, success=True)
+        if tsidecar.enabled():
+            sidecar_storage = url_to_storage_plugin(snap_path)
+            try:
+                tsidecar.write(
+                    sidecar_storage,
+                    tsidecar.build(
+                        action="serve",
+                        unique_id=op_id,
+                        rank=0,
+                        duration_s=_time.monotonic() - begin,
+                        phases=phase_stats.delta(phases_before),
+                        nbytes=res["bytes_resident"],
+                        extra={"residency": res, "cache_dir": cache_dir},
+                    ),
+                )
+            finally:
+                sidecar_storage.sync_close()
         if args.json:
             print(
                 json.dumps(
@@ -1022,8 +1153,50 @@ def main(argv=None) -> int:
         default=None,
         help="snapshot URL whose telemetry sidecars enrich the report",
     )
+    p.add_argument(
+        "--barrier",
+        action="store_true",
+        help="cross-rank commit-barrier blame report from the snapshot's "
+        "sidecars (the positional argument is the snapshot URL)",
+    )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser(
+        "top",
+        help="live fleet view over a TPUSNAP_FLEET_TELEMETRY spool",
+    )
+    p.add_argument(
+        "path",
+        nargs="?",
+        default=None,
+        help="spool dir, or a root with telemetry/live under it "
+        "(default: $TPUSNAP_FLEET_TELEMETRY)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="one-shot aggregated snapshot"
+    )
+    p.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="one-shot merged Prometheus exposition across the fleet",
+    )
+    p.add_argument(
+        "--once", action="store_true", help="render the table once and exit"
+    )
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        help="refresh seconds for the live table",
+    )
+    p.add_argument(
+        "--stale",
+        type=float,
+        default=None,
+        help="age-out seconds (default: TPUSNAP_FLEET_TELEMETRY_STALE_S)",
+    )
+    p.set_defaults(fn=cmd_top)
 
     for name, fn, extra_help in (
         (

@@ -672,6 +672,24 @@ class CacheReaderPlugin(StoragePlugin):
             self.misses += 1
             self.miss_bytes += nbytes
 
+    def _record_wait(self, wait_s: float, path: str) -> None:
+        """One completed single-flight populate wait (cache.py's per-key
+        lock): phase + counter + event, recorded at wait END so the
+        watchdog's phase fingerprint isn't re-armed by a parked waiter."""
+        from . import phase_stats
+        from .event import Event
+        from .event_handlers import log_event
+        from .telemetry import metrics as tmetrics
+
+        phase_stats.add("cache_wait", wait_s)
+        tmetrics.record_cache_wait(wait_s)
+        log_event(
+            Event(
+                name="cache.wait",
+                metadata={"path": path, "wait_s": round(wait_s, 4)},
+            )
+        )
+
     async def read(self, read_io: ReadIO) -> None:
         import asyncio
 
@@ -715,6 +733,8 @@ class CacheReaderPlugin(StoragePlugin):
             # traffic, never an error).
             lock_fd = None
             deadline = loop.time() + _POPULATE_LOCK_TIMEOUT_S
+            wait_begin = loop.time()
+            wait_turns = 0
             while True:
                 lock_fd = await loop.run_in_executor(
                     self._executor,
@@ -723,6 +743,7 @@ class CacheReaderPlugin(StoragePlugin):
                 )
                 if lock_fd is not None or loop.time() >= deadline:
                     break
+                wait_turns += 1
                 await asyncio.sleep(0.02)
                 if await loop.run_in_executor(
                     self._executor,
@@ -732,6 +753,12 @@ class CacheReaderPlugin(StoragePlugin):
                     read_io.byte_range,
                 ):
                     break  # the holder finished: read it below
+            if wait_turns:
+                # The single-flight wait was real wall blocked on a
+                # SIBLING's origin fetch — metered as its own phase
+                # (`cache_wait`, a wait group in analyze) so convoying on
+                # hot keys is attributable instead of reading as idle.
+                self._record_wait(loop.time() - wait_begin, read_io.path)
             try:
                 resident = await loop.run_in_executor(
                     self._executor,

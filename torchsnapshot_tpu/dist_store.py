@@ -28,9 +28,9 @@ import os
 import tempfile
 import time
 import uuid
-from typing import Optional
+from typing import Dict, Optional
 
-from . import knobs
+from . import knobs, phase_stats
 
 
 class StorePeerError(RuntimeError):
@@ -363,14 +363,31 @@ class LinearBarrier:
             raise StorePeerError(err.decode("utf-8", errors="replace"))
 
     def _blocking_wait(self, key: str, timeout_s: Optional[float]) -> None:
+        # Timed as `barrier_wait` (classified as a wait group in
+        # analyze.PHASE_GROUPS): commit-barrier skew used to be invisible
+        # wall — the straggler's peers burned it here with no phase record.
+        begin = time.monotonic()
         try:
             self._store.get(key, timeout_s=resolve_wait_timeout_s(timeout_s))
         except TimeoutError:
             self._check_error()
             raise TimeoutError(f"LinearBarrier timed out waiting on {key}")
+        finally:
+            phase_stats.add("barrier_wait", time.monotonic() - begin)
         self._check_error()
 
+    def _stamp(self, phase: str) -> None:
+        """Best-effort wall-clock stamp of this rank reaching ``phase`` —
+        the raw input for analyze's cross-rank barrier-blame table.  Epoch
+        time on purpose: the stamps are compared ACROSS ranks (clock skew
+        is noise well below the multi-second skews worth blaming)."""
+        try:
+            self._store.set(f"ts_{phase}/{self._rank}", repr(time.time()).encode())
+        except Exception:
+            pass  # telemetry, never load-bearing for the barrier protocol
+
     def arrive(self, timeout_s: Optional[float] = None) -> None:
+        self._stamp("arrive")
         if self._store.add("arrived", 1) >= self._world_size:
             self._store.set("all_arrived", b"1")
         if self._rank == self._leader_rank:
@@ -381,10 +398,32 @@ class LinearBarrier:
             self._store.set("departed", b"1")
         else:
             self._blocking_wait("departed", timeout_s)
+        self._stamp("depart")
         # Per-rank completion mark: the barrier's keys may only be swept once
         # this counter reaches world_size — a peer's completion thread can
         # still be parked on `departed` long after the leader moved on.
         self._store.add("done", 1)
+
+    def arrival_table(self) -> Dict[int, Dict[str, float]]:
+        """Every rank's arrive/depart wall-clock stamps, read non-blocking
+        after the barrier completed (post-``arrive`` every rank's arrive
+        stamp is provably present; depart stamps are best-effort).  Keys
+        live under the barrier's own prefix, so the normal retire sweep
+        reclaims them with the rest."""
+        table: Dict[int, Dict[str, float]] = {}
+        for rank in range(self._world_size):
+            row: Dict[str, float] = {}
+            for phase in ("arrive", "depart"):
+                raw = self._store.try_get(f"ts_{phase}/{rank}")
+                if raw is None:
+                    continue
+                try:
+                    row[phase] = float(raw)
+                except ValueError:
+                    continue
+            if row:
+                table[rank] = row
+        return table
 
     def done_guard(self) -> tuple:
         """(key, target) telling a sweeper when this barrier's keys are dead."""

@@ -65,6 +65,9 @@ STORE_PATH_ENV_VAR = _ENV_PREFIX + "STORE_PATH"
 RANK_ENV_VAR = _ENV_PREFIX + "RANK"
 WORLD_SIZE_ENV_VAR = _ENV_PREFIX + "WORLD_SIZE"
 CACHE_DIR_ENV_VAR = _ENV_PREFIX + "CACHE_DIR"
+FLEET_TELEMETRY_ENV_VAR = _ENV_PREFIX + "FLEET_TELEMETRY"
+FLEET_TELEMETRY_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "FLEET_TELEMETRY_INTERVAL_S"
+FLEET_TELEMETRY_STALE_S_ENV_VAR = _ENV_PREFIX + "FLEET_TELEMETRY_STALE_S"
 CACHE_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "CACHE_MAX_BYTES"
 PARTIAL_READS_ENV_VAR = _ENV_PREFIX + "PARTIAL_READS"
 PARTIAL_READ_MIN_SAVED_ENV_VAR = _ENV_PREFIX + "PARTIAL_READ_MIN_SAVED_BYTES"
@@ -801,6 +804,73 @@ def get_partial_read_min_saved_bytes() -> int:
             _DEFAULT_PARTIAL_READ_MIN_SAVED_BYTES,
         ),
     )
+
+
+# The fleet-telemetry publish cadence and age-out default: one small JSON
+# write per op per second is invisible next to any real save/restore, and
+# 30 s keeps a crashed worker's last entry visible long enough for `top`
+# to show it died mid-op without littering the spool forever.
+_DEFAULT_FLEET_TELEMETRY_INTERVAL_S = 1.0
+_DEFAULT_FLEET_TELEMETRY_STALE_S = 30.0
+
+
+def get_fleet_telemetry_dir() -> Optional[str]:
+    """Spool directory of the fleet telemetry plane
+    (``telemetry/fleet.py``), or None — publishing disabled (the default).
+    Every op (take/async_take/restore, serve/warm workers) periodically
+    writes an atomic progress+metrics entry under it; ``tpusnap top``
+    aggregates the spool into the live fleet view.  Point every worker of
+    a job at the same directory — by convention ``<root>/telemetry/live``."""
+    val = os.environ.get(FLEET_TELEMETRY_ENV_VAR, "").strip()
+    if not val or val.lower() in ("0", "false", "off", "none"):
+        return None
+    return val
+
+
+def get_fleet_telemetry_interval_s() -> float:
+    """Seconds between an op's fleet-telemetry publishes (terminal state
+    always publishes once more on completion)."""
+    val = os.environ.get(FLEET_TELEMETRY_INTERVAL_S_ENV_VAR)
+    return (
+        max(0.05, float(val))
+        if val is not None
+        else _DEFAULT_FLEET_TELEMETRY_INTERVAL_S
+    )
+
+
+def get_fleet_telemetry_stale_s() -> float:
+    """Age past which a spool entry is considered dead: the collector
+    skips (and sweeps) entries whose publish timestamp is older, so
+    crashed workers drop out of the fleet view instead of reading as
+    eternally in-flight."""
+    val = os.environ.get(FLEET_TELEMETRY_STALE_S_ENV_VAR)
+    return (
+        max(1.0, float(val))
+        if val is not None
+        else _DEFAULT_FLEET_TELEMETRY_STALE_S
+    )
+
+
+@contextmanager
+def override_fleet_telemetry(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(FLEET_TELEMETRY_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_fleet_telemetry_interval_s(
+    value: float,
+) -> Generator[None, None, None]:
+    with _override_env(FLEET_TELEMETRY_INTERVAL_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_fleet_telemetry_stale_s(
+    value: float,
+) -> Generator[None, None, None]:
+    with _override_env(FLEET_TELEMETRY_STALE_S_ENV_VAR, str(value)):
+        yield
 
 
 @contextmanager

@@ -1309,6 +1309,18 @@ class PendingSnapshot:
                         else None
                     ),
                 }
+                if barrier is not None:
+                    # Every rank's commit-barrier arrive/depart stamps
+                    # (exchanged through the store) — the raw input for
+                    # `analyze --barrier`'s cross-rank blame table.
+                    arrivals = barrier.arrival_table()
+                    if arrivals:
+                        extra["barrier"] = {
+                            "world_size": self.pg.get_world_size(),
+                            "arrivals": {
+                                str(r): row for r, row in arrivals.items()
+                            },
+                        }
                 cas_stats = cas_mod.writer_stats(self._storage)
                 if cas_stats is not None:
                     extra["cas"] = cas_stats

@@ -33,6 +33,11 @@ that turn raw data into operator answers:
 - :mod:`.history` — per-step save history (``telemetry/history.jsonl``
   under a SnapshotManager root) with trailing-median regression
   detection (``telemetry.regression`` events).
+- :mod:`.fleet` — the live cross-process plane: ops publish atomic
+  progress+metrics entries into a shared spool
+  (``TPUSNAP_FLEET_TELEMETRY``), aggregated by ``tpusnap top`` into the
+  fleet view (per-worker state/bytes/ETA, aggregate bandwidth, cache
+  hit ratio, straggler ranking) and a merged Prometheus exposition.
 
 No reference analogue: torchsnapshot's observability is a single
 entry-point event hook (event_handlers.py); production checkpointing
@@ -41,6 +46,14 @@ monitoring) showed per-phase timelines and longitudinal metrics are
 prerequisites for tuning, which is what this package persists.
 """
 
-from . import analyze, history, metrics, monitor, sidecar, trace
+from . import analyze, fleet, history, metrics, monitor, sidecar, trace
 
-__all__ = ["trace", "metrics", "sidecar", "monitor", "analyze", "history"]
+__all__ = [
+    "trace",
+    "metrics",
+    "sidecar",
+    "monitor",
+    "analyze",
+    "history",
+    "fleet",
+]

@@ -56,6 +56,13 @@ PHASE_GROUPS: Dict[str, frozenset] = {
     "h2d": frozenset({"h2d_dispatch", "h2d_land"}),
     "memory_budget": frozenset({"budget_wait"}),
     "io_concurrency": frozenset({"io_slot_wait"}),
+    # Waits, not work: barrier_wait is wall parked in LinearBarrier
+    # arrive/depart (commit-barrier skew — the straggler's peers burn it),
+    # cache_wait is wall parked on a sibling's in-flight cache populate
+    # (the single-flight lock).  Both classify as wait groups so they can
+    # name the limiting resource without inflating any work group.
+    "barrier": frozenset({"barrier_wait"}),
+    "cache_wait": frozenset({"cache_wait"}),
     # The native data plane's fused phases: native_write_hash is hash+write
     # in one call and native_read is the parallel pread fan-out — both are
     # wall spent driving storage, so they classify as storage_io (the
@@ -70,6 +77,10 @@ PHASE_GROUPS: Dict[str, frozenset] = {
     ),
 }
 _STORAGE_SUFFIXES = ("_write", "_read")
+# Groups that are time spent WAITING on a resource rather than doing
+# work; the limiting-resource classifier treats them specially and the
+# dominant-phase ranking excludes them.
+WAIT_GROUPS = ("memory_budget", "io_concurrency", "barrier", "cache_wait")
 # A wait group only names the limiting resource when it covers at least
 # this share of the op (below that it's contention noise, and the real
 # answer is the dominant work group).
@@ -206,14 +217,10 @@ def _classify_limiting(
     attacking the work phases won't help until the throttle moves."""
     if duration_s <= 0 or not group_walls:
         return "unknown"
-    for wait_group in ("memory_budget", "io_concurrency"):
+    for wait_group in WAIT_GROUPS:
         wait = group_walls.get(wait_group, 0.0)
         work_max = max(
-            (
-                v
-                for k, v in group_walls.items()
-                if k not in ("memory_budget", "io_concurrency")
-            ),
+            (v for k, v in group_walls.items() if k not in WAIT_GROUPS),
             default=0.0,
         )
         if wait / duration_s >= _WAIT_DOMINANCE_SHARE and wait >= work_max:
@@ -221,7 +228,7 @@ def _classify_limiting(
     work = {
         k: v
         for k, v in group_walls.items()
-        if k not in ("memory_budget", "io_concurrency", "other")
+        if k not in WAIT_GROUPS and k != "other"
     }
     if not work:
         return "unknown"
@@ -279,7 +286,7 @@ def analyze_traces(
         work_phases = {
             n: i
             for n, i in phases.items()
-            if i["group"] not in ("memory_budget", "io_concurrency")
+            if i["group"] not in WAIT_GROUPS
         }
         dominant_phase = (
             max(work_phases, key=lambda n: work_phases[n]["wall_s"])
@@ -340,6 +347,130 @@ def analyze_traces(
             }
         ops.append(entry)
     return {"ops": ops}
+
+
+# ------------------------------------------------------------ barrier blame
+
+
+def _phase_wall(vals: Dict[str, Any]) -> float:
+    """A sidecar phase record's wall seconds (phase_stats uses `wall`
+    with `s` = thread-seconds; old records may carry only `s`)."""
+    return float(vals.get("wall", vals.get("s", 0.0)) or 0.0)
+
+
+def barrier_blame(
+    sidecars: List[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Cross-rank commit-barrier skew attribution, one report per op.
+
+    Input: telemetry sidecars whose ``barrier`` block carries every
+    rank's arrive/depart wall-clock stamps (recorded by
+    ``LinearBarrier`` through the dist store and exchanged at commit
+    time).  For each op the report names the skew (last arriver minus
+    first), blames the last-arriving rank, and attributes the skew to
+    that rank's dominant pre-barrier WORK phase (its per-rank phase
+    walls ride the same sidecars) — the phase the fleet was actually
+    waiting on.  Ops without barrier data are skipped."""
+    by_op: Dict[Tuple[str, str], Dict[int, Dict[str, Any]]] = {}
+    for doc in sidecars:
+        action = doc.get("action", "?")
+        op_id = str(doc.get("op_id", "?"))
+        rank = int(doc.get("rank", 0))
+        by_op.setdefault((action, op_id), {})[rank] = doc
+
+    reports: List[Dict[str, Any]] = []
+    for (action, op_id), ranks in sorted(by_op.items()):
+        # Any rank's sidecar carries the full exchanged table; merge in
+        # case some ranks' sidecar writes failed.
+        arrivals: Dict[int, float] = {}
+        departs: Dict[int, float] = {}
+        for doc in ranks.values():
+            table = (doc.get("barrier") or {}).get("arrivals") or {}
+            for r, row in table.items():
+                if "arrive" in row:
+                    arrivals[int(r)] = float(row["arrive"])
+                if "depart" in row:
+                    departs[int(r)] = float(row["depart"])
+        if len(arrivals) < 2:
+            continue
+        first_rank = min(arrivals, key=arrivals.get)
+        blamed_rank = max(arrivals, key=arrivals.get)
+        t0 = arrivals[first_rank]
+        skew_s = arrivals[blamed_rank] - t0
+        blamed_doc = ranks.get(blamed_rank)
+        blamed_phase = None
+        blamed_phase_wall_s = None
+        if blamed_doc is not None:
+            work = {
+                name: _phase_wall(vals)
+                for name, vals in (blamed_doc.get("phases") or {}).items()
+                if classify_phase(name) not in WAIT_GROUPS
+            }
+            if work:
+                blamed_phase = max(work, key=work.get)
+                blamed_phase_wall_s = round(work[blamed_phase], 6)
+        barrier_wait_s = {
+            str(r): round(
+                _phase_wall((doc.get("phases") or {}).get("barrier_wait", {})),
+                6,
+            )
+            for r, doc in sorted(ranks.items())
+        }
+        reports.append(
+            {
+                "kind": action,
+                "op": op_id,
+                "world": len(arrivals),
+                "skew_s": round(skew_s, 6),
+                "first_rank": first_rank,
+                "blamed_rank": blamed_rank,
+                "blamed_phase": blamed_phase,
+                "blamed_phase_wall_s": blamed_phase_wall_s,
+                "arrivals_rel_s": {
+                    str(r): round(t - t0, 6)
+                    for r, t in sorted(arrivals.items())
+                },
+                "departs_rel_s": {
+                    str(r): round(t - t0, 6)
+                    for r, t in sorted(departs.items())
+                },
+                "barrier_wait_s": barrier_wait_s,
+            }
+        )
+    return reports
+
+
+def render_barrier(reports: List[Dict[str, Any]]) -> str:
+    """Human-readable barrier-blame table."""
+    if not reports:
+        return (
+            "no barrier data (sidecars predate barrier stamping, the op "
+            "was single-rank, or sidecars are disabled)"
+        )
+    lines: List[str] = []
+    for rep in reports:
+        lines.append(
+            f"{rep['kind']} {rep['op'][:8]} — commit barrier, "
+            f"{rep['world']} rank(s), skew {rep['skew_s']:.3f}s"
+        )
+        blame = f"rank {rep['blamed_rank']} arrived last"
+        if rep["blamed_phase"] is not None:
+            blame += (
+                f"; its dominant pre-barrier phase: {rep['blamed_phase']} "
+                f"({rep['blamed_phase_wall_s']:.2f}s wall)"
+            )
+        lines.append(f"  blame: {blame}")
+        lines.append(
+            f"  {'rank':>6} {'arrived+':>10} {'barrier_wait':>13}"
+        )
+        for r, rel in rep["arrivals_rel_s"].items():
+            wait = rep["barrier_wait_s"].get(r, 0.0)
+            marker = "  << straggler" if int(r) == rep["blamed_rank"] else ""
+            lines.append(
+                f"  {r:>6} {rel:>9.3f}s {wait:>12.3f}s{marker}"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
 
 
 # ---------------------------------------------------------------- rendering

@@ -77,7 +77,14 @@ def summarize_sidecar(
             name: round(v.get("wall", v.get("s", 0.0)), 4) for name, v in top
         },
     }
-    for key in ("rss_high_water_bytes", "staging_mode", "stall_s", "cas"):
+    for key in (
+        "rss_high_water_bytes",
+        "staging_mode",
+        "stall_s",
+        "cas",
+        "cache",
+        "barrier",
+    ):
         if key in doc:
             entry[key] = doc[key]
     return entry
@@ -222,6 +229,12 @@ def render(entries: List[Dict[str, Any]], limit: int = 50) -> str:
                 flag = f"  dedup={cas['logical_bytes'] / physical:.1f}x"
             else:
                 flag = "  dedup=all"  # every payload hit the CAS
+        cache = e.get("cache")
+        if isinstance(cache, dict):
+            hit = int(cache.get("hit_bytes", 0) or 0)
+            miss = int(cache.get("miss_bytes", 0) or 0)
+            if hit or miss:
+                flag += f"  cache={hit / (hit + miss):.0%}"
         if "regression" in e:
             reg = e["regression"]
             flag += f"  << REGRESSION {reg.get('ratio', '?')}x median"

@@ -152,6 +152,44 @@ def reset() -> None:
         _REGISTRY.clear()
 
 
+def dump_registry() -> List[Dict]:
+    """JSON-serializable snapshot of every metric family — what the fleet
+    telemetry publisher embeds in its spool entries so one ``tpusnap top
+    --prometheus`` scrape can merge every worker's registry (fleet.py).
+    Empty when nothing has been recorded (metrics disabled)."""
+    with _LOCK:
+        metrics = list(_REGISTRY.values())
+    out: List[Dict] = []
+    for m in metrics:
+        with _LOCK:
+            children = list(m._children.items())
+        if not children:
+            continue
+        out.append(
+            {
+                "name": m.name,
+                "type": m.mtype,
+                "help": m.help,
+                "buckets": list(m._buckets) if m._buckets else None,
+                "children": [
+                    {
+                        "labels": dict(key),
+                        "value": child.value,
+                        "sum": child.sum,
+                        "count": child.count,
+                        "buckets": (
+                            list(child.buckets)
+                            if child.buckets is not None
+                            else None
+                        ),
+                    }
+                    for key, child in children
+                ],
+            }
+        )
+    return out
+
+
 def _fmt_labels(key: LabelKey, extra: str = "") -> str:
     parts = [f'{k}="{v}"' for k, v in key]
     if extra:
@@ -413,6 +451,31 @@ def record_cache(
         ).inc(miss_bytes)
 
 
+def record_cache_wait(seconds: float) -> None:
+    """Wall one cold read spent parked on a sibling's in-flight populate
+    (the cache's per-key single-flight lock, cache.py).  A fleet whose
+    waits dwarf its misses is convoying on too few distinct keys."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_cache_wait_seconds_total",
+        "Wall spent waiting on another process's in-flight cache populate",
+    ).inc(max(0.0, float(seconds)))
+
+
+def record_telemetry_overhead(seconds: float) -> None:
+    """Self-metering for the fleet telemetry plane (fleet.py): the wall
+    each spool publish costs the op that performed it.  The observability
+    layer's own bill, so "telemetry is slowing the fleet" is answerable
+    from the telemetry itself."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_telemetry_overhead_seconds_total",
+        "Wall spent publishing fleet telemetry spool entries",
+    ).inc(max(0.0, float(seconds)))
+
+
 def record_cache_evicted(entries: int, nbytes: int) -> None:
     """An LRU eviction pass reclaimed cache entries to fit the
     ``TPUSNAP_CACHE_MAX_BYTES`` bound."""
@@ -541,6 +604,7 @@ DIRECT_METRIC_EVENTS = frozenset(
         "cache.hit",  # record_cache
         "cache.miss",  # record_cache
         "cache.evict",  # record_cache_evicted
+        "cache.wait",  # record_cache_wait
     }
 )
 

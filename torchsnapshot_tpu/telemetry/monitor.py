@@ -43,6 +43,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import knobs, phase_stats, rss_profiler
+from . import fleet
 from ..event import Event
 from ..event_handlers import log_event
 
@@ -96,9 +97,14 @@ class OpMonitor:
         # (watchdog=False) completing mid-save must not overwrite the
         # in-flight save's heartbeat with its own terminal done:true.
         self._heartbeat_path = knobs.get_heartbeat_file() if watchdog else None
+        # Fleet telemetry applies to EVERY monitored op (serve workers are
+        # read ops): each entry is keyed by (pid, kind, rank), so a
+        # read_object can never clobber an in-flight save's entry.
+        self._fleet = fleet.enabled()
+        self._fleet_next = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        if self._stall_timeout_s > 0 or self._heartbeat_path:
+        if self._stall_timeout_s > 0 or self._heartbeat_path or self._fleet:
             self._thread = threading.Thread(
                 target=self._run,
                 name=f"tpusnap-monitor-{kind}",
@@ -226,6 +232,8 @@ class OpMonitor:
             candidates.append(self._stall_timeout_s / 4.0)
         if self._heartbeat_path:
             candidates.append(min(knobs.get_progress_interval_s() or 5.0, 5.0))
+        if self._fleet:
+            candidates.append(knobs.get_fleet_telemetry_interval_s())
         return max(_MIN_TICK_S, min(min(candidates), _MAX_TICK_S))
 
     def _run(self) -> None:
@@ -237,6 +245,15 @@ class OpMonitor:
             self.watermark.sample()
             if self._heartbeat_path:
                 self._write_heartbeat()
+            if self._fleet:
+                now = time.monotonic()
+                if now >= self._fleet_next and fleet.within_overhead_budget(
+                    self, now - self._begin
+                ):
+                    self._fleet_next = (
+                        now + knobs.get_fleet_telemetry_interval_s()
+                    )
+                    fleet.publish(self)
             if self._stall_timeout_s <= 0:
                 continue
             fp = self._fingerprint()
@@ -415,7 +432,10 @@ class OpMonitor:
         try:
             doc = self.progress()
             doc["heartbeat_time"] = time.time()
-            tmp = f"{path}.tmp.{os.getpid()}"
+            # Per-thread tmp name: concurrent ops' monitor threads share
+            # one heartbeat path (and one pid) — interleaved writes into
+            # a shared tmp would rename torn JSON into place.
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(doc, f)
             # Best-effort liveness beacon rewritten every tick; an fsync
@@ -433,6 +453,12 @@ class OpMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        # Terminal fleet publish: the entry flips to done/success and the
+        # op's final byte counts fold into the process totals (exactly
+        # once).  Runs for every monitored op — short read ops that never
+        # lived a full tick still land one entry.
+        if self._fleet:
+            fleet.publish(self, final=True)
         # Release the scheduler containers the debug closures (and the
         # closed event loop) pin: a caller holding the PendingSnapshot
         # between checkpoints must not keep every _WritePipeline / staged

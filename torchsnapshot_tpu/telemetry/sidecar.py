@@ -159,6 +159,16 @@ def summarize(doc: Dict[str, Any]) -> str:
         f"{gbps if gbps is not None else '-':>7} GB/s  "
         f"[{'ok' if doc.get('success', True) else 'ERR'}] {top_str}"
     )
+    cache = doc.get("cache")
+    if isinstance(cache, dict):
+        hit = int(cache.get("hit_bytes", 0) or 0)
+        miss = int(cache.get("miss_bytes", 0) or 0)
+        if hit or miss:
+            # The serving tier's per-op record: local-cache vs origin split.
+            line += (
+                f" cache={hit / (hit + miss):.0%} hit "
+                f"({miss / 1e9:.3f}GB from origin)"
+            )
     cas = doc.get("cas")
     if isinstance(cas, dict) and cas.get("logical_bytes"):
         # Logical vs physical: what the save represents vs what it wrote.
