@@ -717,6 +717,10 @@ def main() -> None:
         _codec, _level = _knobs.get_compression()
         compression_probe = {
             "codec": _codec if _level is None else f"{_codec}:{_level}",
+            # The operator's configured codec IS what ran (resolve() just
+            # confirmed it); surfaced explicitly so every probe shape has
+            # the downgrade answer at top level.
+            "codec_downgraded": False,
             "note": "main save ran compressed (TPUSNAP_COMPRESSION set)",
             "bytes_written": bytes_written,
             "logical_bytes": actual_bytes,
@@ -772,6 +776,10 @@ def main() -> None:
             compression_probe = {
                 "codec": codec,
                 "requested": requested,
+                # Top-level downgrade flag: BENCH_r07's reader had to diff
+                # codec vs requested to notice zlib stood in for zstd —
+                # surface it where nobody can miss it.
+                "codec_downgraded": codec != req_name,
                 "save_s": round(comp_save_s, 2),
                 "bytes_written": comp_bytes,
                 "raw_bytes_written": bytes_written,
@@ -1017,6 +1025,8 @@ def main() -> None:
         _PARTIAL["phase"] = "native_ab_probe"
         import hashlib
 
+        from torchsnapshot_tpu import knobs as _kn
+
         ab_mb = int(os.environ.get("BENCH_NATIVE_AB_MB", "512"))
         n_ab = 8
         per_ab = (ab_mb << 20) // n_ab
@@ -1119,6 +1129,46 @@ def main() -> None:
         leg_native = _ab_leg(ab_native_root, True)
         leg_py = _ab_leg(ab_py_root, False)
         identical = _ab_dir_digest(ab_native_root) == _ab_dir_digest(ab_py_root)
+
+        # --- --direct-io A/B: the same native save through the direct-I/O
+        # ladder (io_uring / O_DIRECT pwrite / buffered fallback) vs the
+        # buffered leg just measured.  Byte identity asserted against the
+        # buffered native leg — direct I/O changes the submission path,
+        # never the bytes.
+        direct_io_probe = None
+        if "--direct-io" in argv:
+            from torchsnapshot_tpu.native_io import NativeFileIO as _NIO
+
+            ab_direct_root = os.path.join(workdir, "ab_direct")
+            with _kn.override_direct_io(True):
+                leg_direct = _ab_leg(ab_direct_root, True)
+                _nio = _NIO.maybe_create()
+                dio_mode = _nio.direct_io_mode() if _nio is not None else 0
+            if _nio is not None:
+                _nio.configure_direct_io(False)
+            direct_identical = _ab_dir_digest(ab_native_root) == _ab_dir_digest(
+                ab_direct_root
+            )
+            shutil.rmtree(ab_direct_root, ignore_errors=True)
+            direct_io_probe = {
+                "mode": {0: "off", 1: "io_uring", 2: "odirect", 3: "buffered"}.get(
+                    dio_mode, str(dio_mode)
+                ),
+                "direct": leg_direct,
+                "buffered_save_s": leg_native["save_s"],
+                "buffered_restore_s": leg_native["restore_s"],
+                "bytes_identical": direct_identical,
+                "save_wall_ratio_buffered_over_direct": round(
+                    leg_native["save_s"] / leg_direct["save_s"], 2
+                )
+                if leg_direct["save_s"]
+                else None,
+            }
+            log(
+                f"direct-io A/B: mode={direct_io_probe['mode']}, save "
+                f"{leg_direct['save_s']}s direct vs {leg_native['save_s']}s "
+                f"buffered; bytes identical: {direct_identical}"
+            )
         shutil.rmtree(ab_native_root, ignore_errors=True)
         shutil.rmtree(ab_py_root, ignore_errors=True)
         native_ab_probe = {
@@ -1156,6 +1206,184 @@ def main() -> None:
             f"thread-s/GB {native_ab_probe['write_checksum_cpu_s_per_gb']}; "
             f"proc cpu save {leg_native['save_proc_cpu_s']}s vs "
             f"{leg_py['save_proc_cpu_s']}s; bytes identical: {identical}"
+        )
+        if direct_io_probe is not None:
+            native_ab_probe["direct_io_probe"] = direct_io_probe
+
+        # --- compressed leg: the requested codec (zstd) through the native
+        # encode-into-frame path vs TPUSNAP_NATIVE=0 resolution.  Per-leg
+        # codec resolution is reported — the fallback leg may resolve to
+        # the wheel or degrade to raw, which is exactly the story this leg
+        # exists to tell — and byte identity is NOT asserted across legs
+        # (raw-vs-compressed frames differ); decode equality is.
+        _PARTIAL["phase"] = "native_ab_compressed"
+        from torchsnapshot_tpu import compression as _ab_compression
+
+        comp_requested = "zstd"
+        comp_arrays = {
+            # float32 in [0,1): compressible exponent structure, the same
+            # character as real model weights (random uint8 would measure
+            # the incompressible-store path instead).
+            f"c{i}": np.random.RandomState(200 + i)
+            .rand(per_ab // 4)
+            .astype(np.float32)
+            for i in range(n_ab)
+        }
+        comp_logical = sum(a.nbytes for a in comp_arrays.values())
+
+        def _comp_leg(root, native_on):
+            shutil.rmtree(root, ignore_errors=True)
+            with _kn.override_native(native_on):
+                resolved = _ab_compression.resolve(comp_requested)
+                with _kn.override_compression(comp_requested):
+                    _drain_writeback()
+                    phase_stats.reset()
+                    t0 = time.monotonic()
+                    snap = Snapshot.take(
+                        root, {"m": StateDict(dict(comp_arrays))}
+                    )
+                    comp_save_s = time.monotonic() - t0
+                    ph = phase_stats.snapshot()
+            nbytes = _dir_bytes(root)
+            return snap, {
+                "codec_resolved": resolved,
+                "codec_downgraded": resolved != comp_requested,
+                "save_s": round(comp_save_s, 3),
+                "bytes_written": nbytes,
+                "ratio": round(comp_logical / nbytes, 3) if nbytes else None,
+                "effective_gbps": round(comp_logical / 1e9 / comp_save_s, 3),
+                "phases": _phases_brief(ph),
+            }
+
+        ab_comp_native_root = os.path.join(workdir, "ab_comp_native")
+        ab_comp_py_root = os.path.join(workdir, "ab_comp_fallback")
+        _comp_leg(os.path.join(workdir, "ab_comp_warm"), True)  # warm pass
+        shutil.rmtree(os.path.join(workdir, "ab_comp_warm"), ignore_errors=True)
+        snap_comp_native, comp_native = _comp_leg(ab_comp_native_root, True)
+        snap_comp_py, comp_py = _comp_leg(ab_comp_py_root, False)
+        decode_equal = True
+        for snap in (snap_comp_native, snap_comp_py):
+            dstc = {
+                "m": StateDict(
+                    {k: np.zeros_like(v) for k, v in comp_arrays.items()}
+                )
+            }
+            snap.restore(dstc)
+            for k, v in comp_arrays.items():
+                if not np.array_equal(np.asarray(dstc["m"][k]), v):
+                    decode_equal = False
+        shutil.rmtree(ab_comp_native_root, ignore_errors=True)
+        shutil.rmtree(ab_comp_py_root, ignore_errors=True)
+        native_ab_probe["compressed"] = {
+            "requested": comp_requested,
+            "state_bytes": comp_logical,
+            "native": comp_native,
+            "fallback": comp_py,
+            "decode_equal": decode_equal,
+            "effective_gbps_speedup": round(
+                comp_native["effective_gbps"] / comp_py["effective_gbps"], 2
+            )
+            if comp_py["effective_gbps"]
+            else None,
+        }
+        log(
+            f"compressed A/B ({comp_logical / 1e9:.2f} GB, requested "
+            f"{comp_requested}): native resolved "
+            f"{comp_native['codec_resolved']} at "
+            f"{comp_native['effective_gbps']} GB/s effective (ratio "
+            f"{comp_native['ratio']}x), fallback resolved "
+            f"{comp_py['codec_resolved']} at {comp_py['effective_gbps']} "
+            f"GB/s; decode equal: {decode_equal}"
+        )
+
+        # --- batched-dispatch leg: a thousand-leaf state, one file per
+        # leaf (slab batching off), TPUSNAP_NATIVE_BATCH on vs off — the
+        # per-payload dispatch overhead story.
+        _PARTIAL["phase"] = "native_ab_batch"
+        n_small = int(os.environ.get("BENCH_AB_BATCH_LEAVES", "1000"))
+        small_leaf_bytes = 64 << 10
+        small_arrays = {
+            f"s{i}": np.frombuffer(
+                np.random.RandomState(i).bytes(small_leaf_bytes), np.uint8
+            ).copy()
+            for i in range(n_small)
+        }
+
+        def _batch_leg(root, batch):
+            shutil.rmtree(root, ignore_errors=True)
+            with _kn.override_env(_kn.DISABLE_BATCHING_ENV_VAR, "1"):
+                with _kn.override_native_batch(batch):
+                    _drain_writeback()
+                    phase_stats.reset()
+                    c0, t0 = _proc_cpu_s(), time.monotonic()
+                    Snapshot.take(root, {"m": StateDict(dict(small_arrays))})
+                    return (
+                        round(time.monotonic() - t0, 3),
+                        round(_proc_cpu_s() - c0, 3),
+                    )
+
+        _batch_leg(os.path.join(workdir, "ab_batch_warm"), 16)  # warm pass
+        shutil.rmtree(os.path.join(workdir, "ab_batch_warm"), ignore_errors=True)
+        batch_root = os.path.join(workdir, "ab_batch_on")
+        single_root = os.path.join(workdir, "ab_batch_off")
+        # Median of 3 alternating trials per leg: per-file syscall latency
+        # on shared hosts is noisy enough that a single sample can invert
+        # the verdict (observed: 1.09x and 0.76x CPU from consecutive
+        # runs) — the same best-of-N discipline the round-2 verdict forced
+        # on the sync/async sections.
+        import statistics as _stats
+
+        batch_trials, single_trials = [], []
+        for _trial in range(3):
+            batch_trials.append(_batch_leg(batch_root, 16))
+            single_trials.append(_batch_leg(single_root, 0))
+        batched_save_s = _stats.median(t[0] for t in batch_trials)
+        batched_cpu_s = _stats.median(t[1] for t in batch_trials)
+        single_save_s = _stats.median(t[0] for t in single_trials)
+        single_cpu_s = _stats.median(t[1] for t in single_trials)
+        batch_identical = _ab_dir_digest(batch_root) == _ab_dir_digest(
+            single_root
+        )
+        shutil.rmtree(batch_root, ignore_errors=True)
+        shutil.rmtree(single_root, ignore_errors=True)
+        native_ab_probe["batch_probe"] = {
+            "leaves": n_small,
+            "leaf_bytes": small_leaf_bytes,
+            "batched_save_s": batched_save_s,
+            "single_save_s": single_save_s,
+            # THE dispatch-overhead metric: real process CPU (getrusage,
+            # all threads) per payload.  Wall can tie on hosts where the
+            # filesystem round-trip is the bottleneck (this sandbox's v9fs)
+            # while the per-payload FFI/pool-handshake CPU still drops —
+            # CPU that a storage-bound host returns to training threads
+            # and a fast-NVMe host converts to wall.
+            "per_payload_cpu_us": {
+                "batched": round(batched_cpu_s / n_small * 1e6, 1),
+                "single": round(single_cpu_s / n_small * 1e6, 1),
+            },
+            "per_payload_wall_us": {
+                "batched": round(batched_save_s / n_small * 1e6, 1),
+                "single": round(single_save_s / n_small * 1e6, 1),
+            },
+            "bytes_identical": batch_identical,
+            "cpu_speedup": round(single_cpu_s / batched_cpu_s, 2)
+            if batched_cpu_s
+            else None,
+            "wall_speedup": round(single_save_s / batched_save_s, 2)
+            if batched_save_s
+            else None,
+            "trials": {
+                "batched": batch_trials,
+                "single": single_trials,
+            },
+        }
+        log(
+            f"batched dispatch ({n_small} x {small_leaf_bytes >> 10} KiB "
+            f"leaves): per-payload CPU "
+            f"{native_ab_probe['batch_probe']['per_payload_cpu_us']} us "
+            f"({native_ab_probe['batch_probe']['cpu_speedup']}x), wall "
+            f"{batched_save_s}s batched vs {single_save_s}s single-call; "
+            f"bytes identical: {batch_identical}"
         )
         _PARTIAL["banked"]["sync"]["native_ab_probe"] = native_ab_probe
 

@@ -247,6 +247,16 @@ def test_native_abi_repo_contract():
     probed = set(probed_symbols(py))
     assert exported, "no exported symbols parsed from tpustore.cc"
     assert exported == probed, (exported - probed, probed - exported)
+    # The raw-speed-frontier exports (PR 12) are part of the fenced ABI:
+    # dropping any of them from either surface must fail tier-1, not
+    # silently degrade the fast path forever.
+    assert {
+        "tpusnap_zstd_encode",
+        "tpusnap_zstd_decode",
+        "tpusnap_write_parts_hash_batch",
+        "tpusnap_direct_io_configure",
+        "tpusnap_direct_io_mode",
+    } <= exported
     m = re.search(r"int\s+tpusnap_abi_version\s*\(\s*\)\s*\{\s*return\s+(\d+)", cc)
     assert m and int(m.group(1)) == NATIVE_ABI_VERSION
 

@@ -193,6 +193,74 @@ def test_tsan_concurrent_ranged_reads_with_verify():
     _assert_clean(proc)
 
 
+def test_tsan_concurrent_batched_dispatch():
+    """Many threads × batched write+hash: per-file write tasks AND
+    per-part hash tasks from several batches interleave on one shared
+    pool — the access pattern the micro-batcher drives under a drain."""
+    _preflight("tsan")
+    proc = _run_driver(
+        "tsan",
+        """
+        assert io.has_batch_write, "library is missing the batch symbol"
+        def leg(tid, tmp):
+            for round in range(3):
+                jobs = [
+                    (os.path.join(tmp, f"b{tid}_{round}_{j}"),
+                     [bytes([tid + j + i & 0xFF]) * (32 << 10)
+                      for i in range(4)])
+                    for j in range(6)
+                ]
+                results = io.write_parts_hash_batch(jobs)
+                assert all(not isinstance(r, OSError) for r in results)
+                assert all(len(r) == 4 for r in results)
+        with tempfile.TemporaryDirectory() as tmp:
+            threads = [threading.Thread(target=leg, args=(t, tmp))
+                       for t in range(6)]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+        print('DRIVER_OK')
+        """,
+    )
+    _assert_clean(proc)
+
+
+def test_tsan_direct_io_write_path():
+    """Concurrent fused writes with TPUSNAP_DIRECT_IO on: whatever rung
+    the host resolves (io_uring submission+completion, aligned
+    pwrite+O_DIRECT, or the buffered fallback), the bounce-buffer
+    streaming and per-file degrade bookkeeping race against pool hashing
+    and sibling writers.  Byte identity is asserted so a racy bounce
+    buffer shows up as corruption even where the sanitizer misses it."""
+    _preflight("tsan")
+    proc = _run_driver(
+        "tsan",
+        """
+        assert io.has_direct_io, "library is missing the direct-io symbols"
+        mode = io.configure_direct_io(True)
+        assert mode in (1, 2, 3), mode
+        payload = bytes(range(256)) * (64 << 4)  # 1 MiB, unaligned tail below
+        def leg(tid, tmp):
+            for round in range(4):
+                path = os.path.join(tmp, f"d{tid}_{round}")
+                parts = [payload, payload[: 4096 * 3 + 17]]
+                hashes = io.write_parts_hash(path, parts)
+                assert len(hashes) == 2
+                with open(path, 'rb') as f:
+                    assert f.read() == b''.join(parts)
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                threads = [threading.Thread(target=leg, args=(t, tmp))
+                           for t in range(6)]
+                [t.start() for t in threads]
+                [t.join() for t in threads]
+        finally:
+            io.configure_direct_io(False)
+        print('DRIVER_OK')
+        """,
+    )
+    _assert_clean(proc)
+
+
 def test_asan_fork_resets_pool():
     """Fork while the pool is hot, then drive the pool in BOTH processes:
     the pthread_atfork reset must hand the child a lazily re-created fresh

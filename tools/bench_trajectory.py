@@ -139,6 +139,25 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Compressed-save throughput: the compression probe's effective
+        # GB/s (logical bytes over compressed-save wall).  Its own series
+        # so the --fail-on-regression gate covers compressed saves — the
+        # r07→r12 frontier — not just the raw headline.  Rounds where the
+        # main save ran compressed bank ratio-only probes (no wall) and
+        # simply contribute no record.
+        comp = aux.get("compression_probe") or {}
+        eff = comp.get("effective_gbps")
+        if isinstance(eff, (int, float)):
+            records.append(
+                {
+                    "series": f"{bank}:compressed_save_gbps:{backend}",
+                    "round": rnd,
+                    "value": float(eff),
+                    "unit": "GB/s",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
     return records
 
 

@@ -14,6 +14,13 @@ the first compile attempt links ``-lz`` with ``-DTPUSNAP_WITH_ZLIB``; if
 that fails (no zlib dev files), the library builds without it and
 ``tpusnap_has_zlib()`` reports 0.
 
+zstd is probed the same way per attempt (``-DTPUSNAP_WITH_ZSTD -lzstd``
+when the dev headers exist), but unlike zlib a header-less build is NOT a
+dead end: the source carries a dlopen shim over the stable ``ZSTD_*`` C
+API, so any build linked with ``-ldl`` resolves the runtime
+``libzstd.so.1`` most images ship without the -dev package —
+``tpusnap_has_zstd()`` reports what the RUNNING process actually found.
+
 Sanitizer builds (``TPUSNAP_NATIVE_SANITIZE={tsan,asan,ubsan}``): the same
 source compiles with ``-fsanitize=...`` into a separately-named
 ``libtpusnap-<mode>.so`` so the production library is never replaced by an
@@ -82,8 +89,17 @@ def _build(extra_flags=None, out: Optional[str] = None) -> None:
     out = out or _LIB
     extra = list(extra_flags or [])
     tmp = out + ".tmp"
+    # Ordered best-to-degraded: each attempt drops one optional dependency.
+    # -ldl is unconditional (glibc always provides it; the zstd dlopen shim
+    # needs it when the dev headers are absent).
     attempts = (
-        _BASE_CMD + extra + ["-DTPUSNAP_WITH_ZLIB", _SRC, "-o", tmp, "-lz"],
+        _BASE_CMD
+        + extra
+        + ["-DTPUSNAP_WITH_ZLIB", "-DTPUSNAP_WITH_ZSTD", _SRC, "-o", tmp,
+           "-lz", "-lzstd", "-ldl"],
+        _BASE_CMD + extra + ["-DTPUSNAP_WITH_ZLIB", _SRC, "-o", tmp, "-lz",
+                             "-ldl"],
+        _BASE_CMD + extra + [_SRC, "-o", tmp, "-ldl"],
         _BASE_CMD + extra + [_SRC, "-o", tmp],
     )
     last_error: Optional[Exception] = None

@@ -26,8 +26,10 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <dlfcn.h>
 #include <fcntl.h>
 #include <functional>
 #include <map>
@@ -47,6 +49,26 @@
 
 #ifdef TPUSNAP_WITH_ZLIB
 #include <zlib.h>
+#endif
+
+#ifdef TPUSNAP_WITH_ZSTD
+#include <zstd.h>
+#endif
+
+// io_uring write submission (TPUSNAP_DIRECT_IO): raw syscalls against the
+// uapi header — no liburing dependency.  Compiled whenever the build host's
+// headers describe the interface; availability on the RUNNING kernel is a
+// separate runtime probe (uring_available), so a binary built on a new
+// image still degrades cleanly on an old kernel.
+#if defined(__linux__)
+#include <sys/syscall.h>
+#if defined(__NR_io_uring_setup) && defined(__NR_io_uring_enter) && \
+    __has_include(<linux/io_uring.h>)
+#define TPUSNAP_HAVE_URING 1
+#include <linux/io_uring.h>
+#include <sys/mman.h>
+#include <sys/uio.h>
+#endif
 #endif
 
 namespace {
@@ -227,6 +249,11 @@ struct Client {
   std::mutex mu;
 };
 
+// Defined with the direct-I/O plane below; the payload writer every
+// write entry point funnels through.
+int write_one_file(const char* path, const void* const* bufs,
+                   const int64_t* sizes, int n);
+
 }  // namespace
 
 extern "C" {
@@ -393,25 +420,11 @@ void tpustore_client_close(void* handle) {
 // ------------------------------------------------------------ file I/O
 // Native data plane for the fs storage plugin: plain p{read,write} with the
 // GIL released on the Python side (ctypes releases it for us).  Returns 0 on
-// success, -errno on failure.
+// success, -errno on failure.  All writers funnel through write_one_file so
+// the opt-in direct-I/O plane (TPUSNAP_DIRECT_IO) covers every entry point.
 
 int tpusnap_write_file(const char* path, const void* buf, int64_t nbytes) {
-  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0) return -errno;
-  const char* p = static_cast<const char*>(buf);
-  int64_t put = 0;
-  while (put < nbytes) {
-    ssize_t r = ::write(fd, p + put, static_cast<size_t>(nbytes - put));
-    if (r < 0) {
-      if (errno == EINTR) continue;
-      int err = errno;
-      ::close(fd);
-      return -err;
-    }
-    put += r;
-  }
-  if (::close(fd) < 0) return -errno;
-  return 0;
+  return write_one_file(path, &buf, &nbytes, 1);
 }
 
 // Scatter-gather file write: the member buffers of a slab are written
@@ -420,24 +433,7 @@ int tpusnap_write_file(const char* path, const void* buf, int64_t nbytes) {
 // 1-vCPU dev box and a TPU host busy with HBM D2H staging).
 int tpusnap_write_file_parts(const char* path, const void** bufs,
                              const int64_t* sizes, int n) {
-  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0) return -errno;
-  for (int i = 0; i < n; ++i) {
-    const char* p = static_cast<const char*>(bufs[i]);
-    int64_t put = 0;
-    while (put < sizes[i]) {
-      ssize_t r = ::write(fd, p + put, static_cast<size_t>(sizes[i] - put));
-      if (r < 0) {
-        if (errno == EINTR) continue;
-        int err = errno;
-        ::close(fd);
-        return -err;
-      }
-      put += r;
-    }
-  }
-  if (::close(fd) < 0) return -errno;
-  return 0;
+  return write_one_file(path, bufs, sizes, n);
 }
 
 int tpusnap_read_range(const char* path, void* buf, int64_t offset,
@@ -785,6 +781,424 @@ uint64_t combine_stripe_digests(const std::vector<uint64_t>& digests,
                           static_cast<int64_t>(packed.size()), seed);
 }
 
+// ----------------------------------------------------------- zstd backend
+// Bound against <zstd.h> when build.py's header probe succeeds
+// (TPUSNAP_WITH_ZSTD); otherwise a dlopen shim resolves the stable ZSTD_*
+// C API out of the runtime libzstd.so.1 most images ship WITHOUT the -dev
+// package — the codec tier must not need build-time headers to reach
+// native compression speed.  Either way the symbols resolve once, lazily,
+// thread-safe via static-local init.
+struct ZstdApi {
+  size_t (*compress)(void*, size_t, const void*, size_t, int) = nullptr;
+  size_t (*decompress)(void*, size_t, const void*, size_t) = nullptr;
+  unsigned (*is_error)(size_t) = nullptr;
+  size_t (*compress_bound)(size_t) = nullptr;
+  bool ok = false;
+};
+
+const ZstdApi& zstd_api() {
+  static const ZstdApi api = [] {
+    ZstdApi a;
+#ifdef TPUSNAP_WITH_ZSTD
+    a.compress = &ZSTD_compress;
+    a.decompress = &ZSTD_decompress;
+    a.is_error = &ZSTD_isError;
+    a.compress_bound = &ZSTD_compressBound;
+    a.ok = true;
+#else
+    void* h = dlopen("libzstd.so.1", RTLD_NOW | RTLD_LOCAL);
+    if (h == nullptr) h = dlopen("libzstd.so", RTLD_NOW | RTLD_LOCAL);
+    if (h != nullptr) {
+      a.compress = reinterpret_cast<size_t (*)(void*, size_t, const void*,
+                                               size_t, int)>(
+          dlsym(h, "ZSTD_compress"));
+      a.decompress = reinterpret_cast<size_t (*)(void*, size_t, const void*,
+                                                 size_t)>(
+          dlsym(h, "ZSTD_decompress"));
+      a.is_error =
+          reinterpret_cast<unsigned (*)(size_t)>(dlsym(h, "ZSTD_isError"));
+      a.compress_bound =
+          reinterpret_cast<size_t (*)(size_t)>(dlsym(h, "ZSTD_compressBound"));
+      a.ok = a.compress && a.decompress && a.is_error && a.compress_bound;
+      // The handle is deliberately kept for the life of the process.
+    }
+#endif
+    return a;
+  }();
+  return api;
+}
+
+// ------------------------------------------------------- direct I/O plane
+// Opt-in (TPUSNAP_DIRECT_IO → tpusnap_direct_io_configure): payload writes
+// bypass the page cache so banked NVMe numbers measure the device, not
+// writeback RAM.  Capability ladder, probed at configure time and degraded
+// per-process at first incompatibility:
+//   1 = io_uring submission of aligned O_DIRECT chunk writes,
+//   2 = aligned pwrite + O_DIRECT (no io_uring on this kernel),
+//   3 = buffered fallback (filesystem rejected O_DIRECT) — the state the
+//       Python side reports once as a native.degraded event.
+// Unaligned payloads stream through DIO_ALIGN-aligned bounce buffers; the
+// final partial block is zero-padded for the aligned write and the file
+// truncated back to its logical size, so on-disk bytes are identical to
+// the buffered path's in every mode.
+enum DirectMode {
+  DIO_OFF = 0,
+  DIO_URING = 1,
+  DIO_ODIRECT = 2,
+  DIO_BUFFERED = 3,
+};
+
+std::atomic<int> g_direct_mode{DIO_OFF};
+
+constexpr int64_t DIO_ALIGN = 4096;
+constexpr int64_t DIO_BOUNCE = 4 << 20;
+
+bool uring_available() {
+#ifdef TPUSNAP_HAVE_URING
+  static const bool avail = [] {
+    io_uring_params p{};
+    memset(&p, 0, sizeof(p));
+    int fd = static_cast<int>(syscall(__NR_io_uring_setup, 4, &p));
+    if (fd >= 0) {
+      ::close(fd);
+      return true;
+    }
+    return false;
+  }();
+  return avail;
+#else
+  return false;
+#endif
+}
+
+#ifdef TPUSNAP_HAVE_URING
+// Minimal single-threaded submission ring (one per file write, never
+// shared): enough for double-buffered sequential chunk writes.  SQ/CQ
+// indices shared with the kernel are accessed with acquire/release
+// atomics per the io_uring memory model.
+struct Uring {
+  int ring_fd = -1;
+  void* sq_ring = MAP_FAILED;
+  size_t sq_ring_sz = 0;
+  void* cq_ring = MAP_FAILED;
+  size_t cq_ring_sz = 0;
+  void* sqe_mem = MAP_FAILED;
+  size_t sqe_sz = 0;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_mask = nullptr;
+  unsigned* sq_array = nullptr;
+  io_uring_sqe* sqes = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned* cq_mask = nullptr;
+  io_uring_cqe* cqes = nullptr;
+
+  bool init(unsigned entries) {
+    io_uring_params p{};
+    memset(&p, 0, sizeof(p));
+    ring_fd = static_cast<int>(syscall(__NR_io_uring_setup, entries, &p));
+    if (ring_fd < 0) return false;
+    sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+    sq_ring = mmap(nullptr, sq_ring_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQ_RING);
+    cq_ring = mmap(nullptr, cq_ring_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_CQ_RING);
+    sqe_sz = p.sq_entries * sizeof(io_uring_sqe);
+    sqe_mem = mmap(nullptr, sqe_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, ring_fd, IORING_OFF_SQES);
+    if (sq_ring == MAP_FAILED || cq_ring == MAP_FAILED ||
+        sqe_mem == MAP_FAILED) {
+      return false;
+    }
+    auto* sqb = static_cast<uint8_t*>(sq_ring);
+    sq_tail = reinterpret_cast<unsigned*>(sqb + p.sq_off.tail);
+    sq_mask = reinterpret_cast<unsigned*>(sqb + p.sq_off.ring_mask);
+    sq_array = reinterpret_cast<unsigned*>(sqb + p.sq_off.array);
+    sqes = static_cast<io_uring_sqe*>(sqe_mem);
+    auto* cqb = static_cast<uint8_t*>(cq_ring);
+    cq_head = reinterpret_cast<unsigned*>(cqb + p.cq_off.head);
+    cq_tail = reinterpret_cast<unsigned*>(cqb + p.cq_off.tail);
+    cq_mask = reinterpret_cast<unsigned*>(cqb + p.cq_off.ring_mask);
+    cqes = reinterpret_cast<io_uring_cqe*>(cqb + p.cq_off.cqes);
+    return true;
+  }
+
+  ~Uring() {
+    if (sq_ring != MAP_FAILED) munmap(sq_ring, sq_ring_sz);
+    if (cq_ring != MAP_FAILED) munmap(cq_ring, cq_ring_sz);
+    if (sqe_mem != MAP_FAILED) munmap(sqe_mem, sqe_sz);
+    if (ring_fd >= 0) ::close(ring_fd);
+  }
+
+  // Submit one IORING_OP_WRITEV (iov must outlive the completion).
+  int submit_writev(int fd, const iovec* iov, int64_t off, uint64_t tag) {
+    unsigned tail = *sq_tail;
+    unsigned idx = tail & *sq_mask;
+    io_uring_sqe* sqe = &sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sqe->opcode = IORING_OP_WRITEV;
+    sqe->fd = fd;
+    sqe->addr = reinterpret_cast<uint64_t>(iov);
+    sqe->len = 1;
+    sqe->off = static_cast<uint64_t>(off);
+    sqe->user_data = tag;
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    // Retry EINTR like every other syscall loop here: a profiler signal
+    // mid-enter must not read as a capability failure (the caller treats
+    // a submit error as "degrade the process off io_uring" — permanent).
+    // A retry after the kernel already consumed the SQE submits zero
+    // entries and returns harmlessly.
+    long rc;
+    do {
+      rc = syscall(__NR_io_uring_enter, ring_fd, 1, 0, 0, nullptr, 0);
+    } while (rc < 0 && errno == EINTR);
+    return rc < 0 ? -errno : 0;
+  }
+
+  // Block for one completion; *res is the CQE result (bytes or -errno).
+  int wait_one(int64_t* res, uint64_t* tag) {
+    for (;;) {
+      unsigned head = *cq_head;
+      unsigned tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+      if (head != tail) {
+        io_uring_cqe* cqe = &cqes[head & *cq_mask];
+        *res = cqe->res;
+        *tag = cqe->user_data;
+        __atomic_store_n(cq_head, head + 1, __ATOMIC_RELEASE);
+        return 0;
+      }
+      long rc = syscall(__NR_io_uring_enter, ring_fd, 0, 1,
+                        IORING_ENTER_GETEVENTS, nullptr, 0);
+      if (rc < 0 && errno != EINTR) return -errno;
+    }
+  }
+};
+#endif  // TPUSNAP_HAVE_URING
+
+struct AlignedBuf {
+  uint8_t* p = nullptr;
+  explicit AlignedBuf(size_t n) {
+    void* mem = nullptr;
+    if (posix_memalign(&mem, static_cast<size_t>(DIO_ALIGN), n) == 0) {
+      p = static_cast<uint8_t*>(mem);
+    }
+  }
+  ~AlignedBuf() { free(p); }
+};
+
+// Streams the parts' bytes through aligned bounce buffers into an
+// O_DIRECT fd; with use_uring, chunk N+1 fills while chunk N's write is
+// in flight (double buffering — the only asynchrony the sequential
+// payload layout permits).  Any io_uring rejection at runtime degrades
+// the PROCESS to the pwrite ladder rung and retries the chunk — bytes
+// never diverge, only the submission mechanism.  Short/failed aligned
+// writes fall back to pwrite of the remainder (O_DIRECT keeps alignment
+// because chunk offsets and the bounce base are both DIO_ALIGN-aligned).
+int write_parts_direct(int fd, const void* const* bufs, const int64_t* sizes,
+                       int n, bool use_uring) {
+  int64_t total = 0;
+  for (int i = 0; i < n; ++i) total += sizes[i];
+  if (total == 0) return 0;
+  // Size the bounce to the payload: a 64 KB batch member must not pay two
+  // 4 MB allocations (plus a ring) per file — the batcher's small-file
+  // drains are exactly where per-file setup would dominate.  A payload
+  // fitting one chunk also skips io_uring outright: ring setup + enter
+  // costs more than the single pwrite it would replace.
+  int64_t rounded = ((total + DIO_ALIGN - 1) / DIO_ALIGN) * DIO_ALIGN;
+  int64_t bounce_sz = rounded < DIO_BOUNCE ? rounded : DIO_BOUNCE;
+  bool multi_chunk = total > bounce_sz;
+  if (!multi_chunk) use_uring = false;
+  AlignedBuf a(static_cast<size_t>(bounce_sz));
+  AlignedBuf b(static_cast<size_t>(multi_chunk ? bounce_sz : DIO_ALIGN));
+  if (a.p == nullptr || b.p == nullptr) return -ENOMEM;
+  uint8_t* bounce[2] = {a.p, b.p};
+  bool inflight[2] = {false, false};
+  int64_t inflight_len[2] = {0, 0};
+  int64_t inflight_off[2] = {0, 0};
+#ifdef TPUSNAP_HAVE_URING
+  Uring ring;
+  iovec iov[2];
+  if (use_uring && !ring.init(4)) {
+    g_direct_mode.store(DIO_ODIRECT);
+    use_uring = false;
+  }
+  // Process the completion of ANY in-flight chunk (at most two).
+  auto reap_one = [&]() -> int {
+    int64_t res;
+    uint64_t tag;
+    int rc = ring.wait_one(&res, &tag);
+    if (rc != 0) {
+      // The RING itself failed (not a chunk's write): no completion is
+      // ever coming, so clear both in-flight flags — a drain loop keyed
+      // on them would otherwise spin on the dead ring forever.  The
+      // bounce buffers stay alive to function exit regardless, so even a
+      // kernel-side straggler write cannot touch freed memory.
+      inflight[0] = false;
+      inflight[1] = false;
+      return rc;
+    }
+    int k = static_cast<int>(tag);
+    inflight[k] = false;
+    if (res == -EINVAL || res == -EOPNOTSUPP || res == -ENOTSUP) {
+      // Kernel/fs rejected the uring write (not the bytes): degrade and
+      // redo this chunk synchronously.
+      g_direct_mode.store(DIO_ODIRECT);
+      use_uring = false;
+      return pwrite_full(fd, bounce[k], inflight_len[k], inflight_off[k]);
+    }
+    if (res < 0) return static_cast<int>(res);
+    if (res < inflight_len[k]) {
+      return pwrite_full(fd, bounce[k] + res, inflight_len[k] - res,
+                         inflight_off[k] + res);
+    }
+    return 0;
+  };
+#else
+  (void)use_uring;
+  use_uring = false;
+#endif
+  int err = 0;
+  int cur = 0;
+  int64_t file_off = 0;
+  int part = 0;
+  int64_t part_off = 0;
+  bool padded = false;
+  while (part < n && err == 0) {
+#ifdef TPUSNAP_HAVE_URING
+    // Reap gated on inflight alone, NOT use_uring: a mid-stream degrade
+    // (reap/submit saw EINVAL) clears use_uring while the OTHER bounce
+    // buffer's write may still be in flight with the kernel — reusing it
+    // before its CQE lands would hand the kernel a buffer we are
+    // memcpy'ing fresh data into.
+    while (inflight[cur] && err == 0) err = reap_one();
+    if (err != 0) break;
+#endif
+    int64_t fill = 0;
+    while (fill < bounce_sz && part < n) {
+      int64_t take = sizes[part] - part_off;
+      if (take > bounce_sz - fill) take = bounce_sz - fill;
+      if (take > 0) {
+        memcpy(bounce[cur] + fill,
+               static_cast<const uint8_t*>(bufs[part]) + part_off,
+               static_cast<size_t>(take));
+      }
+      fill += take;
+      part_off += take;
+      if (part_off >= sizes[part]) {
+        ++part;
+        part_off = 0;
+      }
+    }
+    if (fill == 0) break;
+    int64_t wlen = fill;
+    if (part >= n && (wlen % DIO_ALIGN) != 0) {
+      int64_t up = ((wlen + DIO_ALIGN - 1) / DIO_ALIGN) * DIO_ALIGN;
+      memset(bounce[cur] + wlen, 0, static_cast<size_t>(up - wlen));
+      wlen = up;
+      padded = true;
+    }
+#ifdef TPUSNAP_HAVE_URING
+    if (use_uring) {
+      iov[cur].iov_base = bounce[cur];
+      iov[cur].iov_len = static_cast<size_t>(wlen);
+      int rc = ring.submit_writev(fd, &iov[cur], file_off,
+                                  static_cast<uint64_t>(cur));
+      if (rc != 0) {
+        g_direct_mode.store(DIO_ODIRECT);
+        use_uring = false;
+        err = pwrite_full(fd, bounce[cur], wlen, file_off);
+      } else {
+        inflight[cur] = true;
+        inflight_len[cur] = wlen;
+        inflight_off[cur] = file_off;
+      }
+    } else
+#endif
+    {
+      err = pwrite_full(fd, bounce[cur], wlen, file_off);
+    }
+    file_off += wlen;
+    cur ^= 1;
+  }
+#ifdef TPUSNAP_HAVE_URING
+  while ((inflight[0] || inflight[1])) {
+    int rc = reap_one();
+    if (rc != 0 && err == 0) err = rc;
+  }
+#endif
+  if (err == 0 && padded && ::ftruncate(fd, total) < 0) err = -errno;
+  return err;
+}
+
+// Opens path for writing under the process direct-io policy; *strategy
+// reports the rung actually taken for THIS file.  A filesystem rejecting
+// O_DIRECT degrades the process to buffered (sticky while enabled — the
+// Python side reports it once) instead of failing the save; every other
+// open failure propagates.
+int open_for_write(const char* path, int* strategy) {
+  int mode = g_direct_mode.load(std::memory_order_relaxed);
+  if (mode == DIO_URING || mode == DIO_ODIRECT) {
+    int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC | O_DIRECT, 0644);
+    if (fd >= 0) {
+      *strategy = mode;
+      return fd;
+    }
+    if (errno != EINVAL && errno != EOPNOTSUPP) return -errno;
+    g_direct_mode.store(DIO_BUFFERED);
+  }
+  *strategy = DIO_OFF;
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  return fd < 0 ? -errno : fd;
+}
+
+int write_parts_buffered(int fd, const void* const* bufs,
+                         const int64_t* sizes, int n) {
+  int err = 0;
+  int64_t off = 0;
+  for (int i = 0; i < n && err == 0; ++i) {
+    if (sizes[i]) err = pwrite_full(fd, bufs[i], sizes[i], off);
+    off += sizes[i];
+  }
+  return err;
+}
+
+// One payload file under the direct-io policy: open, write all parts
+// sequentially, close.  The shared writer behind every native write entry
+// point (whole-file, scatter parts, fused single, batch members), so
+// TPUSNAP_DIRECT_IO covers them identically and the buffered default
+// stays the exact pwrite loop the parity suite has always pinned.
+int write_one_file(const char* path, const void* const* bufs,
+                   const int64_t* sizes, int n) {
+  int strategy = DIO_OFF;
+  int fd = open_for_write(path, &strategy);
+  if (fd < 0) return fd;
+  int err = 0;
+  if (strategy == DIO_URING || strategy == DIO_ODIRECT) {
+    err = write_parts_direct(fd, bufs, sizes, n, strategy == DIO_URING);
+    if (err == -EINVAL || err == -EOPNOTSUPP) {
+      // Some filesystems (FUSE, network mounts) accept O_DIRECT at open
+      // but reject the direct write itself: same degrade contract as an
+      // open-time rejection — fall to buffered for the process and redo
+      // THIS file from scratch (O_TRUNC resets the partial direct write).
+      g_direct_mode.store(DIO_BUFFERED);
+      ::close(fd);
+      fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+      if (fd < 0) return -errno;
+      err = write_parts_buffered(fd, bufs, sizes, n);
+    }
+  } else {
+    err = write_parts_buffered(fd, bufs, sizes, n);
+  }
+  if (err != 0) {
+    ::close(fd);
+    return err;
+  }
+  if (::close(fd) < 0) return -errno;
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -897,8 +1311,6 @@ int tpusnap_write_parts_hash(const char* path, const void** bufs,
                              const int64_t* sizes, int n, uint64_t seed,
                              int64_t stripe_bytes, int64_t striped_min_bytes,
                              uint64_t* out_hashes) {
-  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
-  if (fd < 0) return -errno;
   // Per-part stripe digest storage for striped parts (index aligned).
   std::vector<std::vector<uint64_t>> stripes(static_cast<size_t>(n));
   TaskSet ts;
@@ -924,30 +1336,114 @@ int tpusnap_write_parts_hash(const char* path, const void** bufs,
       });
     }
   }
-  // Hashers start on the pool; this thread writes sequentially meanwhile.
+  // Hashers start on the pool; this thread writes sequentially meanwhile
+  // (concurrent pwrites to ONE file serialize on the inode lock — see the
+  // division-of-labor note above; the batch call below parallelizes across
+  // DIFFERENT files instead).
   ts.launch();
-  int write_err = 0;
-  int64_t file_off = 0;
-  for (int i = 0; i < n && write_err == 0; ++i) {
-    if (sizes[i]) {
-      write_err = pwrite_full(fd, bufs[i], sizes[i], file_off);
-    }
-    file_off += sizes[i];
-  }
+  int write_err = write_one_file(path, bufs, sizes, n);
   ts.finish();  // digests all landed (must complete even on write error)
-  if (write_err != 0) {
-    ::close(fd);
-    return write_err;
-  }
+  if (write_err != 0) return write_err;
   for (int i = 0; i < n; ++i) {
     if (!stripes[static_cast<size_t>(i)].empty()) {
       out_hashes[i] =
           combine_stripe_digests(stripes[static_cast<size_t>(i)], seed);
     }
   }
-  if (::close(fd) < 0) return -errno;
   return 0;
 }
+
+// Batched fused write+hash: N payloads (each its own file + parts list,
+// flattened into bufs/sizes with parts_per_file counts) cross the FFI
+// boundary and enter the pool as ONE task set — a drain of small requests
+// (thousand-leaf optimizer trees, per-chunk compressed payloads) stops
+// paying one native call + one pool submission per payload.  Writes to
+// DIFFERENT files are pool tasks (no shared inode, unlike the single
+// call's one-file parts) overlapping the per-part hashing; each payload's
+// write outcome is isolated in out_errs[f] (0 / -errno) so one member's
+// failure never discards siblings' completed writes.  Digests land in
+// out_hashes exactly as N single calls would compute them (same size
+// policy, same stripe combination).  Returns 0 when every payload
+// succeeded, else the first failing member's -errno.
+int tpusnap_write_parts_hash_batch(const char* const* paths, int n_files,
+                                   const int* parts_per_file,
+                                   const void* const* bufs,
+                                   const int64_t* sizes, int n_parts_total,
+                                   uint64_t seed, int64_t stripe_bytes,
+                                   int64_t striped_min_bytes,
+                                   uint64_t* out_hashes, int* out_errs) {
+  for (int f = 0; f < n_files; ++f) out_errs[f] = 0;
+  int64_t declared = 0;
+  for (int f = 0; f < n_files; ++f) declared += parts_per_file[f];
+  if (declared != n_parts_total) return -EINVAL;
+  std::vector<std::vector<uint64_t>> stripes(
+      static_cast<size_t>(n_parts_total));
+  TaskSet ts;
+  int part_index = 0;
+  for (int f = 0; f < n_files; ++f) {
+    int np = parts_per_file[f];
+    const char* path = paths[f];
+    const void* const* fbufs = bufs + part_index;
+    const int64_t* fsizes = sizes + part_index;
+    int* errp = &out_errs[f];
+    ts.tasks.emplace_back(
+        [=] { *errp = write_one_file(path, fbufs, fsizes, np); });
+    for (int i = 0; i < np; ++i) {
+      int gi = part_index + i;
+      const uint8_t* buf = static_cast<const uint8_t*>(bufs[gi]);
+      int64_t sz = sizes[gi];
+      bool striped = striped_min_bytes > 0 && stripe_bytes > 0 &&
+                     sz >= striped_min_bytes && sz > stripe_bytes;
+      if (!striped) {
+        ts.tasks.emplace_back(
+            [=] { out_hashes[gi] = tpusnap_xxhash64(buf, sz, seed); });
+        continue;
+      }
+      int64_t n_stripes = (sz + stripe_bytes - 1) / stripe_bytes;
+      stripes[static_cast<size_t>(gi)].resize(static_cast<size_t>(n_stripes));
+      std::vector<uint64_t>* out = &stripes[static_cast<size_t>(gi)];
+      for (int64_t j = 0; j < n_stripes; ++j) {
+        int64_t s_off = j * stripe_bytes;
+        int64_t s_sz = sz - s_off < stripe_bytes ? sz - s_off : stripe_bytes;
+        ts.tasks.emplace_back([=] {
+          (*out)[static_cast<size_t>(j)] =
+              tpusnap_xxhash64(buf + s_off, s_sz, seed);
+        });
+      }
+    }
+    part_index += np;
+  }
+  ts.run_all();
+  for (int gi = 0; gi < n_parts_total; ++gi) {
+    if (!stripes[static_cast<size_t>(gi)].empty()) {
+      out_hashes[gi] =
+          combine_stripe_digests(stripes[static_cast<size_t>(gi)], seed);
+    }
+  }
+  for (int f = 0; f < n_files; ++f) {
+    if (out_errs[f] != 0) return out_errs[f];
+  }
+  return 0;
+}
+
+// Direct-I/O opt-in (TPUSNAP_DIRECT_IO): resolves the capability ladder at
+// configure time — io_uring when the running kernel has it, aligned
+// pwrite+O_DIRECT otherwise; a filesystem that later rejects O_DIRECT
+// degrades the process to buffered writes (mode 3, sticky while enabled),
+// which the Python side surfaces once as a native.degraded event.  Returns
+// the resolved mode: 0 off, 1 io_uring, 2 O_DIRECT pwrite, 3 buffered.
+int tpusnap_direct_io_configure(int enabled) {
+  if (!enabled) {
+    g_direct_mode.store(DIO_OFF);
+    return DIO_OFF;
+  }
+  if (g_direct_mode.load() == DIO_BUFFERED) return DIO_BUFFERED;
+  int mode = uring_available() ? DIO_URING : DIO_ODIRECT;
+  g_direct_mode.store(mode);
+  return mode;
+}
+
+int tpusnap_direct_io_mode() { return g_direct_mode.load(); }
 
 // Parallel multi-range read with optional fused per-range hashing: the
 // restore/audit fan-out that replaces the per-range Python loop.  Each
@@ -1091,6 +1587,51 @@ int64_t tpusnap_zlib_encode(const void* src, int64_t src_len, void* dst,
   (void)level;
   return -2;
 #endif
+}
+
+// ------------------------------------------------------------ zstd codec
+// Native zstd directly into/out of the compression frame's payload region
+// — the codec the checkpoint hot path actually wants (BENCH_r07: Python
+// zlib at 0.14 GB/s was 15.7 s of a 16.5 s compressed save).  Frames are
+// standard single-segment zstd frames: the `zstandard` wheel decodes
+// native output and vice versa (the cross-decode matrix in the parity
+// suite pins this).  Availability is runtime-probed (see ZstdApi): built
+// against zstd.h when build.py's probe finds it, else dlopen of the
+// runtime libzstd.
+
+int tpusnap_has_zstd() { return zstd_api().ok ? 1 : 0; }
+
+// Returns the encoded size, -1 when the output does not fit dst_cap (the
+// incompressible case callers turn into a raw frame), -2 on any other
+// zstd error or when the backend is unavailable.
+int64_t tpusnap_zstd_encode(const void* src, int64_t src_len, void* dst,
+                            int64_t dst_cap, int level) {
+  const ZstdApi& z = zstd_api();
+  if (!z.ok) return -2;
+  size_t rc = z.compress(dst, static_cast<size_t>(dst_cap), src,
+                         static_cast<size_t>(src_len), level);
+  if (z.is_error(rc)) {
+    // Below the bound the expected failure is dstSize_tooSmall — the
+    // didn't-shrink signal; at/above it any failure is a real error
+    // (conflating them would silently store compressible payloads raw).
+    return static_cast<size_t>(dst_cap) <
+                   z.compress_bound(static_cast<size_t>(src_len))
+               ? -1
+               : -2;
+  }
+  return static_cast<int64_t>(rc);
+}
+
+// Returns the decoded size (callers compare it against the frame header's
+// recorded uncompressed length), or -2 on any decode error.
+int64_t tpusnap_zstd_decode(const void* src, int64_t src_len, void* dst,
+                            int64_t dst_cap) {
+  const ZstdApi& z = zstd_api();
+  if (!z.ok) return -2;
+  size_t rc = z.decompress(dst, static_cast<size_t>(dst_cap), src,
+                           static_cast<size_t>(src_len));
+  if (z.is_error(rc)) return -2;
+  return static_cast<int64_t>(rc);
 }
 
 }  // extern "C"

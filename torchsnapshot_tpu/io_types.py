@@ -89,6 +89,12 @@ class WriteIO:
     # applies (plain xxh64 below STRIPED_MIN_BYTES, striped xxh64s at or
     # above), set by the plugin when it fused hashing into the write.
     part_hash64: Optional[List[int]] = None
+    # Scheduler hint that sibling write requests are in flight or queued:
+    # plugins that micro-batch small fused writes into one native call
+    # (fs + TPUSNAP_NATIVE_BATCH) route this write through their
+    # group-commit gate.  False for a lone write, which skips the gate
+    # machinery entirely.
+    batch_hint: bool = False
 
 
 @dataclass

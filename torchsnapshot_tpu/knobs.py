@@ -52,6 +52,8 @@ JOURNAL_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "JOURNAL_MAX_BYTES"
 NATIVE_ENV_VAR = _ENV_PREFIX + "NATIVE"
 NATIVE_THREADS_ENV_VAR = _ENV_PREFIX + "NATIVE_THREADS"
 NATIVE_SANITIZE_ENV_VAR = _ENV_PREFIX + "NATIVE_SANITIZE"
+NATIVE_BATCH_ENV_VAR = _ENV_PREFIX + "NATIVE_BATCH"
+DIRECT_IO_ENV_VAR = _ENV_PREFIX + "DIRECT_IO"
 CHECKSUM_ENV_VAR = _ENV_PREFIX + "CHECKSUM"
 CHECKSUM_ON_SAVE_ENV_VAR = _ENV_PREFIX + "CHECKSUM_ON_SAVE"
 D2H_BITCAST_ENV_VAR = _ENV_PREFIX + "D2H_BITCAST"
@@ -111,6 +113,11 @@ _DEFAULT_JOURNAL_MAX_BYTES = 0
 # their size is unknown at plan time) and skip per-chunk codec overhead
 # that dwarfs any saving at that scale.
 _DEFAULT_COMPRESSION_MIN_BYTES = 64 * 1024
+# Max payloads the fs plugin's micro-batcher groups into ONE native
+# write+hash batch call.  8 stays below the default 16-slot io
+# concurrency, so a full batch can form from in-flight producers while
+# the previous batch's native call is still executing (group commit).
+_DEFAULT_NATIVE_BATCH = 8
 
 
 def _get_int_env(name: str, default: int) -> int:
@@ -566,6 +573,40 @@ def get_native_threads() -> int:
 @contextmanager
 def override_native(enabled: bool) -> Generator[None, None, None]:
     with _override_env(NATIVE_ENV_VAR, "1" if enabled else "0"):
+        yield
+
+
+def get_native_batch() -> int:
+    """Max payloads the fs plugin's fused write+hash path groups into one
+    native batch call (``TPUSNAP_NATIVE_BATCH``): a drain of small write
+    requests then crosses the FFI boundary once per batch, not once per
+    payload.  ``0``/``1`` disables micro-batching (every payload keeps its
+    own call — today's behavior)."""
+    return max(0, _get_int_env(NATIVE_BATCH_ENV_VAR, _DEFAULT_NATIVE_BATCH))
+
+
+@contextmanager
+def override_native_batch(value: int) -> Generator[None, None, None]:
+    with _override_env(NATIVE_BATCH_ENV_VAR, str(value)):
+        yield
+
+
+def direct_io_enabled() -> bool:
+    """Opt-in direct-I/O write path in the native data plane
+    (``TPUSNAP_DIRECT_IO=1``): payload writes bypass the page cache via
+    io_uring when the kernel supports it, aligned pwrite+``O_DIRECT``
+    otherwise, degrading to buffered writes (with a one-time
+    ``native.degraded`` event) on filesystems that reject ``O_DIRECT``.
+    Off by default — buffered writes win on page-cache-sized working sets;
+    this exists so NVMe-bound fleets measure (and pay) the device, not
+    writeback RAM.  On-disk bytes are identical in every mode, and the
+    tmp+fsync+rename durability discipline is unchanged."""
+    return _get_bool_env(DIRECT_IO_ENV_VAR)
+
+
+@contextmanager
+def override_direct_io(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(DIRECT_IO_ENV_VAR, "1" if enabled else "0"):
         yield
 
 

@@ -11,10 +11,19 @@ Beyond per-call GIL release, the library runs an internal C++ worker pool
 - ``write_parts_hash`` — ONE call per payload/slab that writes all member
   buffers AND returns each member's digest, hash and write fused over the
   same cache-resident bytes;
+- ``write_parts_hash_batch`` — N payloads in ONE call and ONE pool
+  submission (the fs plugin's micro-batcher feeds it), so thousand-leaf
+  drains stop being FFI-dispatch-bound;
 - ``xxhash64_striped`` — the parallel "xxh64s" digest for large buffers
   (independent per-stripe xxh64s combined over the digest stream);
 - ``read_ranges_hash`` — multi-range pread fan-out with optional fused
-  per-range hashing for restore and audit.
+  per-range hashing for restore and audit;
+- native codec encode/decode straight into/out of compression frames
+  (zlib byte-identical to Python's; zstd as standard frames the
+  ``zstandard`` wheel cross-decodes);
+- an opt-in direct-I/O write plane (``TPUSNAP_DIRECT_IO``): io_uring →
+  aligned pwrite+O_DIRECT → buffered capability ladder with a one-time
+  ``native.degraded`` event when a filesystem forces the last rung.
 
 ``TPUSNAP_NATIVE=0`` disables the whole native plane (``maybe_create``
 returns None); every consumer then takes a byte-identical pure-Python path.
@@ -53,6 +62,41 @@ class NativeZlibError(RuntimeError):
     """Native deflate could not run (unavailable, bad level, Z_MEM_ERROR) —
     distinct from the None 'did not fit' result; callers fall back to the
     Python codec, whose output is byte-identical."""
+
+
+class NativeZstdError(RuntimeError):
+    """Native zstd could not run (backend unavailable or a real codec
+    error) — distinct from the None 'did not fit' result.  Callers fall
+    back to the ``zstandard`` wheel; frames are standard zstd frames, so
+    the two backends decode each other's output."""
+
+
+def _contiguous_views(parts: Sequence[Any]) -> "List[memoryview]":
+    """Each part as a C-contiguous uint8 memoryview (non-contiguous parts
+    are copied once) — the ONE normalization every native call shares."""
+    views = []
+    for part in parts:
+        view = memoryview(part)
+        if not view.c_contiguous:
+            view = memoryview(bytes(view))
+        views.append(view.cast("B"))
+    return views
+
+
+def _views_ctypes(views: Sequence[Any]):
+    """(arrs, bufs, sizes) ctypes marshalling for a view list.  ``arrs``
+    alias the views' memory zero-copy (np.frombuffer works on read-only
+    buffers — the jax staging case) and MUST stay referenced for the
+    duration of the native call.  Empty views marshal as NULL/0."""
+    import numpy as np
+
+    n = max(len(views), 1)
+    arrs = [np.frombuffer(v, np.uint8) if v.nbytes else None for v in views]
+    bufs = (ctypes.c_void_p * n)(
+        *(a.ctypes.data if a is not None else None for a in arrs)
+    )
+    sizes = (ctypes.c_int64 * n)(*(v.nbytes for v in views))
+    return arrs, bufs, sizes
 
 
 def striped_hash64(view: memoryview, hash64) -> int:
@@ -196,6 +240,26 @@ class NativeFileIO:
                 ctypes.POINTER(ctypes.c_uint64),
             ],
         )
+        self.has_batch_write = _bind(
+            "tpusnap_write_parts_hash_batch",
+            ctypes.c_int,
+            [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int,
+                ctypes.c_uint64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int),
+            ],
+        )
+        self.has_direct_io = _bind(
+            "tpusnap_direct_io_configure", ctypes.c_int, [ctypes.c_int]
+        ) and _bind("tpusnap_direct_io_mode", ctypes.c_int, [])
         self.has_zlib = False
         if _bind("tpusnap_has_zlib", ctypes.c_int, []):
             _bind(
@@ -210,6 +274,34 @@ class NativeFileIO:
                 ],
             )
             self.has_zlib = bool(lib.tpusnap_has_zlib())
+        self.has_zstd = False
+        if (
+            _bind("tpusnap_has_zstd", ctypes.c_int, [])
+            and _bind(
+                "tpusnap_zstd_encode",
+                ctypes.c_int64,
+                [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_int,
+                ],
+            )
+            and _bind(
+                "tpusnap_zstd_decode",
+                ctypes.c_int64,
+                [
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                    ctypes.c_void_p,
+                    ctypes.c_int64,
+                ],
+            )
+        ):
+            # Runtime-probed: 1 only when the library actually resolved a
+            # zstd backend (compile-time link or the dlopen shim).
+            self.has_zstd = bool(lib.tpusnap_has_zstd())
         if self.has_pool:
             from . import knobs
 
@@ -300,23 +392,12 @@ class NativeFileIO:
         of >= STRIPED_MIN_BYTES are "xxh64s" digests, smaller ones plain
         "xxh64" — ``integrity.format_digest`` applies the same policy).
         Zero-length parts are kept (their digest is the empty hash)."""
-        import numpy as np
-
-        views = []
-        for part in parts:
-            view = memoryview(part)
-            if not view.c_contiguous:
-                view = memoryview(bytes(view))
-            views.append(view.cast("B"))
+        views = _contiguous_views(parts)
         n = len(views)
         if n == 0:
             with open(path, "wb"):
                 return []
-        arrs = [np.frombuffer(v, np.uint8) if v.nbytes else None for v in views]
-        bufs = (ctypes.c_void_p * n)(
-            *(a.ctypes.data if a is not None else None for a in arrs)
-        )
-        sizes = (ctypes.c_int64 * n)(*(v.nbytes for v in views))
+        arrs, bufs, sizes = _views_ctypes(views)
         out = (ctypes.c_uint64 * n)()
         rc = self._lib.tpusnap_write_parts_hash(
             path.encode(),
@@ -331,6 +412,60 @@ class NativeFileIO:
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)
         return list(out)
+
+    def write_parts_hash_batch(
+        self, jobs: Sequence[Tuple[str, Sequence[Any]]]
+    ) -> List[Any]:
+        """Batched fused write+hash: every ``(path, parts)`` job crosses
+        the FFI boundary in ONE call and enters the native pool as one
+        task set — the per-payload dispatch cost a drain of small requests
+        (thousand-leaf optimizer trees) otherwise pays per file.  Returns
+        one result per job, in order: the job's per-part digest list
+        (identical to what ``write_parts_hash`` would return), or an
+        ``OSError`` instance when that job's write failed — error
+        isolation per member, so one full disk never discards siblings'
+        completed writes.  Requires ``has_batch_write``."""
+        njobs = len(jobs)
+        if njobs == 0:
+            return []
+        paths: List[bytes] = []
+        parts_per: List[int] = []
+        views: List[Any] = []
+        for path, parts in jobs:
+            paths.append(path.encode())
+            job_views = _contiguous_views(parts)
+            views.extend(job_views)
+            parts_per.append(len(job_views))
+        total = len(views)
+        arrs, bufs, sizes = _views_ctypes(views)
+        out = (ctypes.c_uint64 * max(total, 1))()
+        errs = (ctypes.c_int * njobs)()
+        c_paths = (ctypes.c_char_p * njobs)(*paths)
+        c_parts = (ctypes.c_int * njobs)(*parts_per)
+        rc = self._lib.tpusnap_write_parts_hash_batch(
+            c_paths,
+            njobs,
+            c_parts,
+            bufs,
+            sizes,
+            total,
+            0,
+            STRIPE_BYTES,
+            STRIPED_MIN_BYTES,
+            out,
+            errs,
+        )
+        del rc  # per-job outcomes live in errs; rc is just the first of them
+        results: List[Any] = []
+        index = 0
+        for job_i, count in enumerate(parts_per):
+            err = int(errs[job_i])
+            if err != 0:
+                results.append(OSError(-err, os.strerror(-err), paths[job_i].decode()))
+            else:
+                results.append([int(out[index + k]) for k in range(count)])
+            index += count
+        return results
 
     def read_ranges_into(
         self,
@@ -416,6 +551,118 @@ class NativeFileIO:
             return None  # would not shrink below the cap
         raise NativeZlibError(f"compress2 failed (rc {int(n)})")
 
+    def zstd_encode_into(self, src, dst, level: int) -> Optional[int]:
+        """Native zstd straight into ``dst`` (a writable view sized to the
+        incompressible cap).  Returns the encoded length, or None when the
+        output would not fit ``dst`` — the genuinely-incompressible signal
+        the caller turns into a raw frame.  A real codec failure raises
+        :class:`NativeZstdError`; the caller retries through the
+        ``zstandard`` wheel (standard zstd frames either way)."""
+        if not self.has_zstd:
+            raise NativeZstdError("native zstd unavailable")
+        import numpy as np
+
+        src_view = memoryview(src)
+        if not src_view.c_contiguous:
+            src_view = memoryview(bytes(src_view))
+        src_view = src_view.cast("B")
+        if src_view.nbytes == 0:
+            raise NativeZstdError("empty input")
+        dst_view = memoryview(dst)
+        src_arr = np.frombuffer(src_view, np.uint8)
+        dst_arr = np.frombuffer(dst_view, np.uint8)
+        n = self._lib.tpusnap_zstd_encode(
+            ctypes.c_void_p(src_arr.ctypes.data),
+            src_view.nbytes,
+            ctypes.c_void_p(dst_arr.ctypes.data),
+            dst_view.nbytes,
+            int(level),
+        )
+        if n > 0:
+            return int(n)
+        if n == -1:
+            return None  # would not shrink below the cap
+        raise NativeZstdError(f"ZSTD_compress failed (rc {int(n)})")
+
+    def zstd_decode_into(self, src, dst) -> int:
+        """Native zstd decode of one frame's payload into ``dst`` (a
+        writable view of the recorded uncompressed size).  Returns the
+        decoded length; raises :class:`NativeZstdError` on any decode
+        failure (corrupt frame, backend missing) — the caller maps it to
+        the codec tier's FrameError."""
+        if not self.has_zstd:
+            raise NativeZstdError("native zstd unavailable")
+        import numpy as np
+
+        src_view = memoryview(src)
+        if not src_view.c_contiguous:
+            src_view = memoryview(bytes(src_view))
+        src_view = src_view.cast("B")
+        dst_view = memoryview(dst)
+        src_arr = np.frombuffer(src_view, np.uint8)
+        dst_arr = np.frombuffer(dst_view, np.uint8)
+        n = self._lib.tpusnap_zstd_decode(
+            ctypes.c_void_p(src_arr.ctypes.data),
+            src_view.nbytes,
+            ctypes.c_void_p(dst_arr.ctypes.data),
+            dst_view.nbytes,
+        )
+        if n < 0:
+            raise NativeZstdError(f"ZSTD_decompress failed (rc {int(n)})")
+        return int(n)
+
+    # ------------------------------------------------------- direct I/O
+
+    _direct_io_reported = False
+
+    def configure_direct_io(self, enabled: bool) -> int:
+        """Resolve the direct-I/O capability ladder for this process
+        (``TPUSNAP_DIRECT_IO``): io_uring → aligned pwrite+O_DIRECT →
+        buffered.  Returns the resolved mode (0 off, 1 uring, 2 O_DIRECT,
+        3 buffered fallback); 0 when the library predates the symbols."""
+        if not self.has_direct_io:
+            return 0
+        return int(self._lib.tpusnap_direct_io_configure(1 if enabled else 0))
+
+    def direct_io_mode(self) -> int:
+        """Current resolved direct-I/O mode (see configure_direct_io);
+        may degrade from 1/2 to 3 at the first write to a filesystem that
+        rejects O_DIRECT."""
+        if not self.has_direct_io:
+            return 0
+        return int(self._lib.tpusnap_direct_io_mode())
+
+    def check_direct_io_degrade(self) -> None:
+        """One-time ``native.degraded`` event when direct I/O was
+        requested but the process degraded to buffered writes (mode 3 —
+        the filesystem rejected O_DIRECT).  Called by the fs plugin after
+        native writes while the knob is on; writes themselves already
+        succeeded through the fallback, this only makes the loss
+        observable."""
+        if NativeFileIO._direct_io_reported or not self.has_direct_io:
+            return
+        if self.direct_io_mode() != 3:
+            return
+        NativeFileIO._direct_io_reported = True
+        logger.warning(
+            "TPUSNAP_DIRECT_IO requested but the filesystem rejected "
+            "O_DIRECT; payload writes fall back to buffered I/O"
+        )
+        try:
+            from .event import Event
+            from .event_handlers import log_event
+            from .telemetry import metrics as tmetrics
+
+            tmetrics.record_native_degraded("direct_io")
+            log_event(
+                Event(
+                    name="native.degraded",
+                    metadata={"missing": ["direct_io"], "mode": "buffered"},
+                )
+            )
+        except Exception:
+            pass  # telemetry must never break the data plane
+
     @classmethod
     def maybe_create(cls) -> Optional["NativeFileIO"]:
         from . import knobs
@@ -462,24 +709,12 @@ class NativeFileIO:
     def write_file_parts(self, path: str, parts: List[Any]) -> None:
         """Scatter-gather write: parts land sequentially in one file with no
         pack memcpy.  The GIL is released for the whole C write loop."""
-        import numpy as np
-
-        views = []
-        for part in parts:
-            view = memoryview(part)
-            if not view.c_contiguous:
-                view = memoryview(bytes(view))
-            views.append(view.cast("B"))
-        views = [v for v in views if v.nbytes]
+        views = [v for v in _contiguous_views(parts) if v.nbytes]
         n = len(views)
         if n == 0:
             with open(path, "wb"):
                 return
-        # np.frombuffer aliases each buffer (read-only ok) without copying;
-        # keep the arrays alive for the duration of the native call.
-        arrs = [np.frombuffer(v, np.uint8) for v in views]
-        bufs = (ctypes.c_void_p * n)(*(a.ctypes.data for a in arrs))
-        sizes = (ctypes.c_int64 * n)(*(v.nbytes for v in views))
+        arrs, bufs, sizes = _views_ctypes(views)
         rc = self._lib.tpusnap_write_file_parts(path.encode(), bufs, sizes, n)
         if rc != 0:
             raise OSError(-rc, os.strerror(-rc), path)

@@ -105,6 +105,10 @@ class _WritePipeline:
         self.buf_sz_bytes = 0
         self._io_credited = False
         self._digests_done = False
+        # Set at io-dispatch time (on_staged): whether other write work is
+        # in flight or queued — the signal plugins use to micro-batch
+        # small fused writes (WriteIO.batch_hint).
+        self.batch_hint = False
 
     def release_after_io(self, budget: "_BudgetTracker") -> None:
         """Release the staged buffer and credit its bytes, exactly once.
@@ -173,7 +177,9 @@ class _WritePipeline:
     async def write_buffer(self) -> "_WritePipeline":
         assert self.buf is not None
         sinks = self._hash_sinks()
-        write_io = WriteIO(path=self.write_req.path, buf=self.buf)
+        write_io = WriteIO(
+            path=self.write_req.path, buf=self.buf, batch_hint=self.batch_hint
+        )
         fused = (
             bool(sinks)
             and not self._digests_done
@@ -496,6 +502,13 @@ async def execute_write_reqs(
         staged_bytes += pipeline.buf_sz_bytes
         reporter.staged += 1
         reporter.bytes_staged += pipeline.buf_sz_bytes
+        # Anything else in flight or still queued means more writes will
+        # reach the plugin around the same time — worth a micro-batch
+        # gather window there.  A lone write keeps batch_hint False and
+        # never waits on the gate.
+        pipeline.batch_hint = bool(
+            io_tasks or staging_tasks or ready_for_staging
+        )
         io_task = asyncio.ensure_future(_io(pipeline))
         io_tasks.add(io_task)
         all_io_tasks.append(io_task)

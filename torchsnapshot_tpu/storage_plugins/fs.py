@@ -25,6 +25,107 @@ _DEFAULT_IO_THREADS = 16
 _PARALLEL_READ_MIN_BYTES = 64 * 1024 * 1024
 _ADAPTIVE_REPROBE_EVERY = 16
 
+# Micro-batching (TPUSNAP_NATIVE_BATCH): only payloads at or below this
+# join a batch — the gains are per-call dispatch overhead, which only
+# matters for small files; a large slab behind the gather gate would
+# serialize siblings behind its write instead.
+_BATCH_MAX_MEMBER_BYTES = 8 * 1024 * 1024
+
+
+class _FusedWriteBatcher:
+    """Group-commit gate in front of ``write_parts_hash_batch``: small
+    fused writes arriving on concurrent fs_io threads coalesce into ONE
+    native call and ONE pool submission per batch, so a drain of
+    thousand-leaf small payloads stops paying per-payload FFI dispatch.
+
+    No gather window: the first free member leads whatever is pending
+    RIGHT NOW (possibly just itself — a batch of one costs what the single
+    call costs), and members arriving while that native call runs pile up
+    for the next leader.  Batch size therefore self-tunes to arrival rate
+    × call duration — the classic group-commit shape — and a lone write
+    never waits on a gate nobody else will join.  A member's failure is
+    isolated (its OSError re-raises on its own thread); a whole-call
+    failure falls back to per-member single calls so batching can never
+    lose a write the single path would have made."""
+
+    def __init__(self, native, max_batch: int) -> None:
+        self._native = native
+        self._max = max_batch
+        self._cond = threading.Condition()
+        self._pending: list = []
+        self._leader_active = False
+
+    def write(self, path: str, parts) -> list:
+        """Write ``parts`` to ``path`` through the current batch; blocks
+        until this member's digests are back.  Raises the member's own
+        OSError on failure, exactly like ``write_parts_hash``."""
+        member = {"path": path, "parts": parts, "done": False,
+                  "result": None, "error": None}
+        with self._cond:
+            self._pending.append(member)
+            while not member["done"]:
+                if self._leader_active or not self._pending:
+                    # A batch is executing (ours may be in it), or ours was
+                    # taken and is in flight: wait for results / the next
+                    # leadership vacancy.
+                    self._cond.wait()
+                    continue
+                # Leadership: take up to max_batch pending members —
+                # including this one unless a full batch formed ahead of it
+                # — and execute outside the lock.
+                self._leader_active = True
+                batch = self._pending[: self._max]
+                del self._pending[: self._max]
+                self._cond.release()
+                try:
+                    self._execute(batch)
+                finally:
+                    self._cond.acquire()
+                    self._leader_active = False
+                    self._cond.notify_all()
+        if member["error"] is not None:
+            raise member["error"]
+        return member["result"]
+
+    def _execute(self, batch: list) -> None:
+        # Every member MUST come out of here done (result or error): a
+        # member left pending would park its fs_io thread forever, so the
+        # done-marking lives in a finally and the fallback catches
+        # everything, not just OSError.
+        try:
+            try:
+                results = self._native.write_parts_hash_batch(
+                    [(m["path"], m["parts"]) for m in batch]
+                )
+            except Exception:  # noqa: BLE001 — whole-call failure only
+                results = None
+            if results is None:
+                # The batch path itself broke (never expected): every
+                # member falls back to its own single call, preserving
+                # single-path semantics exactly.
+                for m in batch:
+                    try:
+                        m["result"] = self._native.write_parts_hash(
+                            m["path"], m["parts"]
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        m["error"] = e
+            else:
+                for m, res in zip(batch, results):
+                    if isinstance(res, OSError):
+                        m["error"] = res
+                    else:
+                        m["result"] = res
+        finally:
+            with self._cond:
+                for m in batch:
+                    if m["result"] is None and m["error"] is None:
+                        m["error"] = RuntimeError(
+                            f"batched write of {m['path']} aborted"
+                        )
+                    m["done"] = True
+                self._cond.notify_all()
+
 
 class FSStoragePlugin(StoragePlugin):
     supports_scatter = True  # writes ScatterBuffer parts with no join
@@ -52,6 +153,31 @@ class FSStoragePlugin(StoragePlugin):
             self._native: Optional[NativeFileIO] = NativeFileIO.maybe_create()
         except Exception:
             self._native = None
+        self._write_batcher: Optional[_FusedWriteBatcher] = None
+        self._direct_io = False
+        if self._native is not None:
+            from .. import knobs
+
+            if self._native.has_direct_io:
+                # The direct-I/O mode is PROCESS-global (one atomic in the
+                # native library) with the env knob as its source of
+                # truth.  Reconfigure only when the knob disagrees with
+                # the current mode: an unconditional re-store from every
+                # plugin constructor would flip the mode under sibling
+                # instances mid-save and reset the sticky
+                # buffered-degrade state a rejected O_DIRECT left behind.
+                self._direct_io = knobs.direct_io_enabled()
+                if self._direct_io != (self._native.direct_io_mode() != 0):
+                    self._native.configure_direct_io(self._direct_io)
+            batch_max = knobs.get_native_batch()
+            if (
+                batch_max > 1
+                and self._native.has_fused_write
+                and self._native.has_batch_write
+            ):
+                self._write_batcher = _FusedWriteBatcher(
+                    self._native, batch_max
+                )
         # Adaptive strategy for large UNchecksummed into-reads (checksummed
         # ones always take the sequential fused read+hash path): the first
         # two qualifying reads measure sequential vs parallel once, then the
@@ -130,11 +256,22 @@ class FSStoragePlugin(StoragePlugin):
                     # computed from the same cache-resident bytes on the
                     # native worker pool — the off-GIL data plane that
                     # replaces the separate Python-level checksum + write
-                    # passes.
+                    # passes.  Small payloads with in-flight siblings
+                    # (batch_hint) coalesce further: the micro-batcher
+                    # groups them into one write_parts_hash_batch call.
                     parts = buf.parts if scatter else [buf]
-                    write_io.part_hash64 = self._native.write_parts_hash(
-                        tmp, parts
-                    )
+                    if (
+                        self._write_batcher is not None
+                        and getattr(write_io, "batch_hint", False)
+                        and nbytes <= _BATCH_MAX_MEMBER_BYTES
+                    ):
+                        write_io.part_hash64 = self._write_batcher.write(
+                            tmp, parts
+                        )
+                    else:
+                        write_io.part_hash64 = self._native.write_parts_hash(
+                            tmp, parts
+                        )
                 elif scatter:
                     # Slab members land sequentially with no pack memcpy.
                     if self._native is not None:
@@ -161,6 +298,10 @@ class FSStoragePlugin(StoragePlugin):
                         os.fsync(dfd)
                     finally:
                         os.close(dfd)
+            if self._direct_io and self._native is not None:
+                # One-time native.degraded event if this write (or an
+                # earlier one) forced the buffered fallback rung.
+                self._native.check_direct_io_degrade()
         except BaseException:
             try:
                 os.unlink(tmp)
