@@ -1559,12 +1559,12 @@ def main() -> None:
             # Launcher-side child-env exports: the workers read them back
             # through knobs accessors.
             if cache_dir:
-                env["TPUSNAP_CACHE_DIR"] = cache_dir  # tpusnap-lint: disable=knob-discipline
+                env["TPUSNAP_CACHE_DIR"] = cache_dir
             else:
-                env.pop("TPUSNAP_CACHE_DIR", None)  # tpusnap-lint: disable=knob-discipline
-            env["TPUSNAP_FLEET_TELEMETRY"] = fleet_spool  # tpusnap-lint: disable=knob-discipline
-            env["TPUSNAP_FLEET_TELEMETRY_INTERVAL_S"] = "0.2"  # tpusnap-lint: disable=knob-discipline
-            env["TPUSNAP_FLEET_TELEMETRY_STALE_S"] = "600"  # tpusnap-lint: disable=knob-discipline
+                env.pop("TPUSNAP_CACHE_DIR", None)
+            env["TPUSNAP_FLEET_TELEMETRY"] = fleet_spool
+            env["TPUSNAP_FLEET_TELEMETRY_INTERVAL_S"] = "0.2"
+            env["TPUSNAP_FLEET_TELEMETRY_STALE_S"] = "600"
             procs = [
                 subprocess.Popen(
                     [

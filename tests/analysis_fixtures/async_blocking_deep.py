@@ -1,0 +1,58 @@
+"""async-blocking-deep fixtures: blocking reached through sync helpers.
+
+``bad_two_hops`` is the interprocedural evasion: ``time.sleep``'s
+nearest enclosing function is sync, two call hops below the async
+frontier — invisible to the PR 9 lexical async-blocking rule (which
+must stay silent on every line here: the direct-call half is its own
+fixture)."""
+
+import asyncio
+import time
+
+
+def _blocking_helper():
+    time.sleep(0.1)
+
+
+def _hop():
+    _blocking_helper()
+
+
+async def bad_calls_helper():
+    _blocking_helper()  # LINT-EXPECT: async-blocking-deep
+
+
+async def bad_two_hops():
+    _hop()  # LINT-EXPECT: async-blocking-deep
+
+
+def _reads_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+async def bad_sync_open_helper():
+    return _reads_file("x")  # LINT-EXPECT: async-blocking-deep
+
+
+async def ok_executor_target():
+    # Value reference, not a call: no call-graph edge, no finding.
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, _blocking_helper)
+
+
+async def ok_nested_executor_def():
+    def _target():
+        _blocking_helper()
+
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, _target)
+
+
+async def ok_async_sleep():
+    await asyncio.sleep(0.1)
+
+
+def ok_sync_caller():
+    # Blocking from a sync context is fine — nothing parks a loop.
+    _blocking_helper()

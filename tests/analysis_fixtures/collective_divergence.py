@@ -1,0 +1,61 @@
+"""collective-divergence fixtures: rank-divergent collective reach.
+
+``bad_two_hop_guard`` is the interprocedural evasion: the barrier call
+sits two resolved call hops below the rank guard, where no lexical
+PR 9 rule (and no single-function scan) could connect the two."""
+
+
+def bad_direct_guard(pg, barrier):
+    if pg.get_rank() == 0:
+        barrier.arrive()  # LINT-EXPECT: collective-divergence
+
+
+def _commit_path(barrier):
+    _deeper(barrier)
+
+
+def _deeper(barrier):
+    barrier.depart()
+
+
+def bad_two_hop_guard(pg, barrier):
+    if pg.get_rank() == 0:
+        _commit_path(barrier)  # LINT-EXPECT: collective-divergence
+
+
+def bad_guard_return(pg, store):
+    # Guard-return shape: everything after the early return is
+    # effectively rank-conditional.
+    if pg.get_rank() != 0:
+        return None
+    return store.get("decision")  # LINT-EXPECT: collective-divergence
+
+
+def bad_divergent_raise(pg, keys, state):
+    for key in keys:
+        if key not in state:
+            raise RuntimeError(key)  # LINT-EXPECT: collective-divergence
+        pg.barrier()
+
+
+def _leader_only_bookkeeping():
+    return 42
+
+
+def ok_symmetric_with_leader_work(pg, barrier):
+    # Rank-0-only NON-collective work between symmetric barriers is the
+    # normal commit pattern.
+    barrier.arrive()
+    if pg.get_rank() == 0:
+        _leader_only_bookkeeping()
+    barrier.depart()
+
+
+def ok_rank_guarded_storage(pg, storage):
+    if pg.get_rank() == 0:
+        storage.delete("tmp")
+
+
+def ok_loop_without_conditional_raise(pg, keys):
+    for _key in keys:
+        pg.barrier()

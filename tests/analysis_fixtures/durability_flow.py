@@ -1,0 +1,72 @@
+"""durability-flow fixtures: tmp+fsync+rename, followed across callees.
+
+The interprocedural halves below are the exact evasions the PR 9
+lexical rule could not see: an un-synced write published by a rename in
+a *callee* (must trigger), and an fsync performed *in a callee* before
+a local rename (must stay silent — the shape the lexical rule forced a
+suppression for)."""
+
+import os
+
+
+def bad_commit(tmp, path):
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    os.replace(tmp, path)  # LINT-EXPECT: durability-flow
+
+
+def _publish(tmp, path):
+    # Publish helper: renames bytes it neither wrote nor synced — the
+    # fsync obligation escapes to its callers.
+    os.replace(tmp, path)
+
+
+def bad_commit_via_helper(tmp, path):
+    # Interprocedural evasion of the lexical rule: the rename lives in
+    # the callee; the un-synced write lives here.
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    _publish(tmp, path)  # LINT-EXPECT: durability-flow
+
+
+def _sync_bytes(tmp):
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def ok_fsync_in_callee(tmp, path):
+    # The lexical rule flagged this SAFE shape (no fsync lexically in
+    # this body) and demanded a suppression; the flow rule follows the
+    # fsync into the callee.
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    _sync_bytes(tmp)
+    os.replace(tmp, path)
+
+
+def ok_pristine_rename(lock, broken):
+    # Lock-steal shuffle: no bytes written anywhere in this flow, so
+    # there is nothing torn to publish — the other suppression class the
+    # lexical rule used to force.
+    os.rename(lock, broken)
+
+
+def ok_durable_commit(tmp, path):
+    with open(tmp, "wb") as f:
+        f.write(b"payload")
+    fd = os.open(tmp, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+
+
+def ok_suppressed(tmp, path):
+    with open(tmp, "wb") as f:
+        f.write(b"telemetry")
+    # Deliberately non-durable publish (telemetry-spool style).
+    os.replace(tmp, path)  # tpusnap-lint: disable=durability-flow

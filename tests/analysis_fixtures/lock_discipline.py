@@ -1,0 +1,44 @@
+"""lock-discipline fixtures: hold-across-await, inversion, relock.
+
+``bad_order_ba_via_call`` + ``bad_order_ab`` form the interprocedural
+evasion: no single function body shows both acquisition orders — the
+B→A half happens through a callee, so only a call-graph-aware rule can
+pair them."""
+
+import asyncio
+import threading
+
+_LOCK_A = threading.Lock()
+_LOCK_B = threading.Lock()
+
+
+async def bad_await_under_lock():
+    with _LOCK_A:  # LINT-EXPECT: lock-discipline
+        await asyncio.sleep(0)
+
+
+def bad_order_ab():
+    with _LOCK_A:
+        with _LOCK_B:  # LINT-EXPECT: lock-discipline
+            pass
+
+
+def _takes_a():
+    with _LOCK_A:
+        pass
+
+
+def bad_order_ba_via_call():
+    with _LOCK_B:
+        _takes_a()
+
+
+def bad_relock():
+    with _LOCK_A:
+        _takes_a()  # LINT-EXPECT: lock-discipline
+
+
+async def ok_sync_lock_no_await():
+    with _LOCK_B:
+        pass
+    await asyncio.sleep(0)

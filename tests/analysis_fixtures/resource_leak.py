@@ -1,0 +1,52 @@
+"""resource-leak fixtures: fd lifetime on the exception path."""
+
+import fcntl
+import os
+
+
+def bad_never_closed(path):
+    fd = os.open(path, os.O_RDONLY)  # LINT-EXPECT: resource-leak
+    return os.fstat(fd).st_size
+
+
+def bad_straight_line_close(path):
+    # The flock makes it worse: an exception in os.read leaks the fd AND
+    # wedges the advisory lock for the process lifetime.
+    fd = os.open(path, os.O_CREAT | os.O_RDWR)  # LINT-EXPECT: resource-leak
+    fcntl.flock(fd, fcntl.LOCK_EX)
+    data = os.read(fd, 16)
+    os.close(fd)
+    return data
+
+
+def ok_try_finally(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        return os.read(fd, 16)
+    finally:
+        os.close(fd)
+
+
+def ok_with(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def ok_fdopen_transfer(path):
+    fd = os.open(path, os.O_RDONLY)
+    return os.fdopen(fd, "rb")
+
+
+def ok_ownership_returned(path):
+    fd = os.open(path, os.O_RDONLY)
+    return fd
+
+
+def ok_close_in_except(path):
+    fd = os.open(path, os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return None
+    return fd

@@ -25,10 +25,15 @@ def test_scheduler_loop_statically_free_of_blocking_calls():
     import os
 
     from torchsnapshot_tpu._analysis import core
-    from torchsnapshot_tpu._analysis.rules_async import AsyncBlockingRule
+    from torchsnapshot_tpu._analysis.rules_async import (
+        AsyncBlockingDeepRule,
+        AsyncBlockingRule,
+    )
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = core.lint_project(repo_root, rules=[AsyncBlockingRule()])
+    findings = core.lint_project(
+        repo_root, rules=[AsyncBlockingRule(), AsyncBlockingDeepRule()]
+    )
     assert findings == [], "blocking calls on the asyncio loop:\n" + "\n".join(
         str(f) for f in findings
     )

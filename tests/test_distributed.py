@@ -907,3 +907,86 @@ def _get_state_dict_for_key_rank_body():
 
 def test_get_state_dict_for_key_rank_semantics():
     _get_state_dict_for_key_rank_body()
+
+
+# --------------------------------------------------------------------------
+# Divergent app-state keys must fail SYMMETRICALLY, never deadlock.
+#
+# Pre-round-13 failure mode (the defect `tpusnap lint`'s
+# collective-divergence rule surfaced at snapshot.py's per-key barrier
+# loops): the union of keys was gathered, then each rank checked its OWN
+# coverage inside the loop — the rank missing a key raised alone while its
+# peers entered that iteration's barrier and hung for the full
+# TPUSNAP_BARRIER_TIMEOUT_S (here: until the 120 s harness timeout killed
+# them).  The fix validates coverage collectively in _gather_keys, so every
+# rank raises the SAME RuntimeError immediately.  These tests deadlocked
+# (rank 0 "timed out") before the fix.
+
+
+@run_with_procs(nproc=2)
+def _divergent_take_keys_body():
+    import time
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import knobs
+
+    pg = make_test_pg()
+    rank = pg.get_rank()
+    snap_dir = os.path.join(knobs.get_store_path(), "snap_divergent_take")
+    app = {"m": StateDict({"w": np.ones(8, np.float32)})}
+    if rank == 0:
+        # Only rank 0 snapshots the optimizer: a real-world elastic-config
+        # bug, not an exotic corner.
+        app["opt"] = StateDict({"lr": 0.1})
+    begin = time.monotonic()
+    with pytest.raises(RuntimeError) as err:
+        Snapshot.take(snap_dir, app, pg=pg)
+    elapsed = time.monotonic() - begin
+    # EVERY rank gets the same actionable error (who is missing what),
+    # immediately — not a TimeoutError after the barrier deadline on one
+    # rank and a RuntimeError on the other.
+    assert "rank 1 is missing" in str(err.value), str(err.value)
+    assert "opt" in str(err.value)
+    assert elapsed < 60.0, f"divergence took {elapsed:.1f}s to surface"
+    # Nothing may have committed.
+    assert not os.path.exists(os.path.join(snap_dir, ".snapshot_metadata"))
+
+
+def test_take_with_divergent_keys_fails_symmetrically():
+    _divergent_take_keys_body()
+
+
+@run_with_procs(nproc=2)
+def _divergent_restore_keys_body():
+    import time
+
+    from torchsnapshot_tpu import Snapshot, StateDict
+    from torchsnapshot_tpu import knobs
+
+    pg = make_test_pg()
+    rank = pg.get_rank()
+    snap_dir = os.path.join(knobs.get_store_path(), "snap_divergent_restore")
+    app = {"m": StateDict({"w": np.full(8, float(rank), np.float32)})}
+    Snapshot.take(snap_dir, app, pg=pg)
+
+    snapshot = Snapshot(snap_dir, pg=pg)
+    dst = {"m": StateDict({"w": np.zeros(8, np.float32)})}
+    if rank == 0:
+        dst["extra"] = StateDict({"x": 0})
+    begin = time.monotonic()
+    with pytest.raises(RuntimeError) as err:
+        snapshot.restore(dst)
+    elapsed = time.monotonic() - begin
+    assert "rank 1 is missing" in str(err.value), str(err.value)
+    assert "extra" in str(err.value)
+    assert elapsed < 60.0, f"divergence took {elapsed:.1f}s to surface"
+    # The snapshot itself stays restorable with symmetric keys.
+    dst_ok = {"m": StateDict({"w": np.zeros(8, np.float32)})}
+    snapshot.restore(dst_ok)
+    np.testing.assert_array_equal(
+        dst_ok["m"]["w"], np.full(8, float(rank), np.float32)
+    )
+
+
+def test_restore_with_divergent_keys_fails_symmetrically():
+    _divergent_restore_keys_body()

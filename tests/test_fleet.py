@@ -228,9 +228,9 @@ def test_multiprocess_fleet_aggregation(tmp_path, capsys):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # Launcher-side child-env exports (read back through knobs accessors).
-    env["TPUSNAP_CACHE_DIR"] = str(tmp_path / "cache")  # tpusnap-lint: disable=knob-discipline
-    env["TPUSNAP_FLEET_TELEMETRY"] = spool  # tpusnap-lint: disable=knob-discipline
-    env["TPUSNAP_FLEET_TELEMETRY_INTERVAL_S"] = "0.1"  # tpusnap-lint: disable=knob-discipline
+    env["TPUSNAP_CACHE_DIR"] = str(tmp_path / "cache")
+    env["TPUSNAP_FLEET_TELEMETRY"] = spool
+    env["TPUSNAP_FLEET_TELEMETRY_INTERVAL_S"] = "0.1"
     env.pop("TPUSNAP_FAULTS", None)
     procs = [
         subprocess.Popen(

@@ -2,8 +2,16 @@
 # The one gate script: everything CI (or a pre-push hook) needs to trust a
 # change.  Ordered cheap-to-expensive so the common failure is fast:
 #
-#   1. tpusnap lint            — project-invariant static analysis (always)
-#   2. tpusnap lint --external — ruff + mypy when installed (skip = ok)
+#   1. tpusnap lint            — project-invariant static analysis (always):
+#                                the lexical rules plus the interprocedural
+#                                family (collective-divergence,
+#                                async-blocking-deep, lock-discipline,
+#                                durability-flow, resource-leak) over the
+#                                package-wide call graph.  For a fast local
+#                                loop use `tpusnap lint --changed` (git-aware;
+#                                the gate here always lints everything).
+#   2. tpusnap lint --external — ruff + mypy when installed (skip = ok);
+#                                mypy runs _analysis/ at non-lenient settings
 #   3. bench trajectory        — banked BENCH_r*/SERVE_r* rounds vs their
 #                                trailing medians (perf-regression gate)
 #   4. tier-1 pytest           — the ROADMAP verify suite (not slow-marked)

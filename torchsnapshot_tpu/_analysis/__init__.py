@@ -1,21 +1,30 @@
 """Project-invariant static analysis (``tpusnap lint``).
 
 The repo's cross-cutting invariants — knob discipline, the event taxonomy,
-the phase registry, the tmp+fsync+rename commit pattern, no blocking calls
-on the asyncio scheduler loop, the shared exception taxonomy, and the
-native ABI's symbol contract — are machine-checked here instead of living
-in reviewer memory.  One AST visitor per rule over a shared file walker,
-structured ``file:line`` findings, per-line suppression via
-``# tpusnap-lint: disable=<rule>``; surfaced as the ``tpusnap lint`` CLI
-subcommand and enforced repo-wide by a tier-1 test
-(tests/test_analysis.py).  Rule catalog: docs/static_analysis.md.
+the phase registry, the tmp+fsync+rename commit pattern (followed
+flow-sensitively across callees), no blocking calls on the asyncio
+scheduler loop (including through sync helper chains), rank-symmetric
+collectives, lock order/hold-across-await discipline, fd/flock lifetime
+on exception paths, the shared exception taxonomy, and the native ABI's
+symbol contract — are machine-checked here instead of living in reviewer
+memory.  Lexical rules are one AST visitor each over a shared file
+walker; the interprocedural family runs over a package-wide call graph
+(``callgraph.py``) with forward-dataflow summaries (``dataflow.py``).
+Structured ``file:line`` findings, per-line suppression via
+``# tpusnap-lint: disable=<rule>`` (kept honest by a stale-suppression
+scan), git-aware ``--changed`` mode over an mtime-keyed AST cache;
+surfaced as the ``tpusnap lint`` CLI subcommand and enforced repo-wide by
+a tier-1 test (tests/test_analysis.py).  Rule catalog:
+docs/static_analysis.md.
 """
 
 from .core import (  # noqa: F401
     Finding,
     Project,
     all_rules,
+    changed_rel_paths,
     lint_project,
     lint_sources,
     rule_names,
+    unused_suppressions,
 )

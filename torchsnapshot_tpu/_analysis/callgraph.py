@@ -1,0 +1,527 @@
+"""Package-wide call graph: the interprocedural substrate for lint rules.
+
+The PR 9 rules were all lexical and intra-function; the invariants that
+matter most in this codebase (rank-symmetric collectives, no blocking
+work on the scheduler loop, fsync-before-rename) are routinely *split
+across functions*.  This module resolves calls across the whole scanned
+file set — module-level functions, classes and their ``self.`` methods
+(with name-resolvable project base classes), ``import``/``from-import``
+aliases including function-local imports, and nested ``def``s — into a
+graph the dataflow framework (:mod:`.dataflow`) propagates summaries
+over.
+
+Resolution is deliberately *honest* about its limits: a call it cannot
+bind to a project function is recorded as an :class:`CallSite` with
+``targets=()`` and the dotted ``chain`` as written, never guessed at.
+Rules may still pattern-match the chain (the collective rule recognizes
+``*.arrive`` / ``*.barrier`` by name), but no summary ever flows through
+an unresolved edge.  Known blind spots — dynamic dispatch through
+instance attributes (``self._inner.read``), callables passed as values
+(``run_in_executor`` targets), and entry-point indirection — are
+documented in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import ModuleFile, dotted_name
+
+
+def _module_name(rel: str) -> str:
+    """'torchsnapshot_tpu/telemetry/fleet.py' -> 'torchsnapshot_tpu.telemetry.fleet'."""
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclass(frozen=True)
+class FunctionInfo:
+    """One function or method in the scanned file set."""
+
+    fid: str  # "<rel>::<qualname>"
+    rel: str
+    qualname: str  # "Class.method", "func", or "outer.<locals>.inner"
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    is_async: bool
+    class_name: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class CallSite:
+    """One call expression owned by (nearest-enclosing in) a function.
+
+    ``targets`` holds the resolved project function ids (empty when the
+    callee could not be bound); ``chain`` is the dotted callee expression
+    as written (None for non-name callees, e.g. ``fns[i]()``)."""
+
+    line: int
+    chain: Optional[str]
+    targets: Tuple[str, ...]
+
+
+@dataclass
+class _ClassInfo:
+    rel: str
+    name: str
+    bases: List[ast.expr] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)  # name -> fid
+
+
+class _Scope:
+    """One lexical scope (module or function): local name bindings the
+    resolver consults innermost-first."""
+
+    def __init__(self) -> None:
+        # name -> ("func", fid) | ("class", (rel, cls)) | ("module", rel)
+        self.names: Dict[str, Tuple[str, object]] = {}
+
+
+class CallGraph:
+    """Call graph over a set of parsed modules (usually the whole repo;
+    fixture tests build one over just the fixture files)."""
+
+    def __init__(self, modules: Sequence[ModuleFile]) -> None:
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.calls: Dict[str, List[CallSite]] = {}
+        self.classes: Dict[Tuple[str, str], _ClassInfo] = {}
+        self.resolved_edges = 0
+        self.unresolved_calls = 0
+        self._module_by_name: Dict[str, str] = {}
+        parsed = [m for m in modules if m.tree is not None]
+        for m in parsed:
+            self._module_by_name[_module_name(m.rel)] = m.rel
+        self._collect_defs(parsed)
+        # Module scopes for every file FIRST: cross-module resolution
+        # (base classes, mod.func calls) must see late files' bindings
+        # while extracting early files' calls.
+        self._scope_cache = {m.rel: self._module_scope(m) for m in parsed}
+        for m in parsed:
+            self._extract_calls(m)
+
+    # ------------------------------------------------------------- indexing
+
+    def _collect_defs(self, modules: Sequence[ModuleFile]) -> None:
+        for m in modules:
+            assert m.tree is not None
+            for node in ast.iter_child_nodes(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._register_function(m.rel, node, node.name, None)
+                elif isinstance(node, ast.ClassDef):
+                    info = _ClassInfo(
+                        rel=m.rel, name=node.name, bases=list(node.bases)
+                    )
+                    self.classes[(m.rel, node.name)] = info
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(
+                            child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fid = self._register_function(
+                                m.rel,
+                                child,
+                                f"{node.name}.{child.name}",
+                                node.name,
+                            )
+                            info.methods[child.name] = fid
+
+    def _register_function(
+        self,
+        rel: str,
+        node: ast.AST,
+        qualname: str,
+        class_name: Optional[str],
+    ) -> str:
+        fid = f"{rel}::{qualname}"
+        self.functions[fid] = FunctionInfo(
+            fid=fid,
+            rel=rel,
+            qualname=qualname,
+            name=qualname.rsplit(".", 1)[-1],
+            node=node,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            class_name=class_name,
+        )
+        self.calls.setdefault(fid, [])
+        return fid
+
+    # ----------------------------------------------------------- resolution
+
+    def _resolve_import_module(self, rel: str, node: ast.AST) -> List[
+        Tuple[str, Tuple[str, object]]
+    ]:
+        """Name bindings an import statement introduces, resolved to
+        project modules/symbols where possible."""
+        out: List[Tuple[str, Tuple[str, object]]] = []
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                target_rel = self._find_module(alias.name)
+                if target_rel is not None:
+                    bound = alias.asname or alias.name.split(".", 1)[0]
+                    # `import a.b.c` binds `a`; only the asname form binds
+                    # the leaf module directly.
+                    if alias.asname is not None:
+                        out.append((bound, ("module", target_rel)))
+                    elif "." not in alias.name:
+                        out.append((bound, ("module", target_rel)))
+        elif isinstance(node, ast.ImportFrom):
+            base = self._absolute_from(rel, node)
+            if base is None:
+                return out
+            for alias in node.names:
+                bound = alias.asname or alias.name
+                as_module = self._find_module(f"{base}.{alias.name}")
+                if as_module is not None:
+                    out.append((bound, ("module", as_module)))
+                    continue
+                base_rel = self._find_module(base)
+                if base_rel is not None:
+                    out.append(
+                        (bound, ("symbol", (base_rel, alias.name)))
+                    )
+        return out
+
+    def _absolute_from(
+        self, rel: str, node: ast.ImportFrom
+    ) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # Relative import: walk up from the importing module's package.
+        parts = _module_name(rel).split(".")
+        # A module's own name does not count as a package level unless it
+        # is a package __init__ (already normalized by _module_name).
+        if not rel.endswith("/__init__.py"):
+            parts = parts[:-1]
+        up = node.level - 1
+        if up:
+            parts = parts[:-up] if up <= len(parts) else []
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) if parts else None
+
+    def _find_module(self, dotted: Optional[str]) -> Optional[str]:
+        if not dotted:
+            return None
+        return self._module_by_name.get(dotted)
+
+    def _module_scope(self, module: ModuleFile) -> _Scope:
+        scope = _Scope()
+        assert module.tree is not None
+        for node in ast.iter_child_nodes(module.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for name, binding in self._resolve_import_module(
+                    module.rel, node
+                ):
+                    scope.names[name] = binding
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.names[node.name] = (
+                    "func",
+                    f"{module.rel}::{node.name}",
+                )
+            elif isinstance(node, ast.ClassDef):
+                scope.names[node.name] = ("class", (module.rel, node.name))
+        return scope
+
+    def mro(self, rel: str, cls: str) -> Iterable["_ClassInfo"]:
+        """Public view of the project-resolvable MRO walk."""
+        return self._mro(rel, cls)
+
+    def _mro(self, rel: str, cls: str, seen: Optional[Set] = None) -> Iterable[_ClassInfo]:
+        """The project-resolvable part of a class's MRO (name-based: a
+        base that is not a project class in the same module or an
+        imported project symbol simply ends the walk on that branch)."""
+        seen = seen if seen is not None else set()
+        key = (rel, cls)
+        if key in seen or key not in self.classes:
+            return
+        seen.add(key)
+        info = self.classes[key]
+        yield info
+        module_scope = self._scope_cache.get(rel)
+        for base in info.bases:
+            base_key: Optional[Tuple[str, str]] = None
+            if isinstance(base, ast.Name):
+                bound = (
+                    module_scope.names.get(base.id)
+                    if module_scope is not None
+                    else None
+                )
+                if bound and bound[0] == "symbol":
+                    brel, bname = bound[1]  # type: ignore[misc]
+                    base_key = (str(brel), str(bname))
+                elif bound and bound[0] == "class":
+                    base_key = bound[1]  # type: ignore[assignment]
+                elif (rel, base.id) in self.classes:
+                    base_key = (rel, base.id)
+            elif isinstance(base, ast.Attribute):
+                chain = dotted_name(base)
+                if chain and module_scope is not None:
+                    root, _, leaf = chain.rpartition(".")
+                    bound = module_scope.names.get(root)
+                    if bound and bound[0] == "module":
+                        base_key = (str(bound[1]), leaf)
+            if base_key is not None:
+                yield from self._mro(base_key[0], base_key[1], seen)
+
+    def _resolve_method(
+        self, rel: str, cls: str, method: str
+    ) -> Optional[str]:
+        for info in self._mro(rel, cls):
+            if method in info.methods:
+                return info.methods[method]
+        return None
+
+    def _resolve_call(
+        self,
+        node: ast.Call,
+        scopes: List[_Scope],
+        rel: str,
+        class_name: Optional[str],
+    ) -> Tuple[Optional[str], Tuple[str, ...]]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            chain: Optional[str] = func.id
+            for scope in reversed(scopes):
+                bound = scope.names.get(func.id)
+                if bound is None:
+                    continue
+                if bound[0] == "func":
+                    return chain, (str(bound[1]),)
+                if bound[0] == "class":
+                    crel, cname = bound[1]  # type: ignore[misc]
+                    init = self._resolve_method(
+                        str(crel), str(cname), "__init__"
+                    )
+                    return chain, (init,) if init else ()
+                if bound[0] == "symbol":
+                    srel, sname = bound[1]  # type: ignore[misc]
+                    fid = f"{srel}::{sname}"
+                    if fid in self.functions:
+                        return chain, (fid,)
+                    if (str(srel), str(sname)) in self.classes:
+                        init = self._resolve_method(
+                            str(srel), str(sname), "__init__"
+                        )
+                        return chain, (init,) if init else ()
+                return chain, ()
+            return chain, ()
+        chain = dotted_name(func)
+        if chain is None:
+            return None, ()
+        parts = chain.split(".")
+        if (
+            len(parts) == 2
+            and parts[0] in ("self", "cls")
+            and class_name is not None
+        ):
+            target = self._resolve_method(rel, class_name, parts[1])
+            return chain, (target,) if target else ()
+        if len(parts) >= 2:
+            root, leaf = parts[0], parts[-1]
+            for scope in reversed(scopes):
+                bound = scope.names.get(root)
+                if bound is None:
+                    continue
+                if bound[0] == "module" and len(parts) == 2:
+                    target_rel = str(bound[1])
+                    fid = f"{target_rel}::{leaf}"
+                    if fid in self.functions:
+                        return chain, (fid,)
+                    if (target_rel, leaf) in self.classes:
+                        init = self._resolve_method(
+                            target_rel, leaf, "__init__"
+                        )
+                        return chain, (init,) if init else ()
+                if bound[0] == "class" and len(parts) == 2:
+                    crel, cname = bound[1]  # type: ignore[misc]
+                    target = self._resolve_method(
+                        str(crel), str(cname), leaf
+                    )
+                    return chain, (target,) if target else ()
+                if bound[0] == "symbol" and len(parts) == 2:
+                    srel, sname = bound[1]  # type: ignore[misc]
+                    if (str(srel), str(sname)) in self.classes:
+                        target = self._resolve_method(
+                            str(srel), str(sname), leaf
+                        )
+                        return chain, (target,) if target else ()
+                if bound[0] == "module" and len(parts) == 3:
+                    # mod.Class.method — classmethod/static call.
+                    target_rel = str(bound[1])
+                    if (target_rel, parts[1]) in self.classes:
+                        target = self._resolve_method(
+                            target_rel, parts[1], leaf
+                        )
+                        return chain, (target,) if target else ()
+                break
+            return chain, ()
+        return chain, ()
+
+    # ----------------------------------------------------------- extraction
+
+    _scope_cache: Dict[str, _Scope]
+
+    def _extract_calls(self, module: ModuleFile) -> None:
+        module_scope = self._scope_cache[module.rel]
+        assert module.tree is not None
+        for node in ast.iter_child_nodes(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(
+                    module.rel, node, node.name, None, [module_scope]
+                )
+            elif isinstance(node, ast.ClassDef):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self._walk_function(
+                            module.rel,
+                            child,
+                            f"{node.name}.{child.name}",
+                            node.name,
+                            [module_scope],
+                        )
+
+    def _walk_function(
+        self,
+        rel: str,
+        fn: ast.AST,
+        qualname: str,
+        class_name: Optional[str],
+        outer_scopes: List[_Scope],
+    ) -> None:
+        fid = f"{rel}::{qualname}"
+        if fid not in self.functions:
+            self._register_function(rel, fn, qualname, class_name)
+        local = _Scope()
+        scopes = outer_scopes + [local]
+        sites = self.calls[fid]
+        nested: List[Tuple[ast.AST, str]] = []
+
+        # First pass over the body: local imports and nested defs bind
+        # names before any call in the same function uses them (good
+        # enough for this codebase's import-then-call idiom).
+        body: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested_qual = f"{qualname}.<locals>.{node.name}"
+                local.names[node.name] = ("func", f"{rel}::{nested_qual}")
+                nested.append((node, nested_qual))
+                continue
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                for name, binding in self._resolve_import_module(rel, node):
+                    local.names[name] = binding
+            stack.extend(ast.iter_child_nodes(node))
+
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                chain, targets = self._resolve_call(
+                    node, scopes, rel, class_name
+                )
+                if targets:
+                    self.resolved_edges += len(targets)
+                else:
+                    self.unresolved_calls += 1
+                sites.append(
+                    CallSite(line=node.lineno, chain=chain, targets=targets)
+                )
+            stack.extend(ast.iter_child_nodes(node))
+
+        for node, nested_qual in nested:
+            self._walk_function(rel, node, nested_qual, class_name, scopes)
+
+        # Lambdas are owned by the enclosing function for call-collection
+        # purposes: a lambda body runs when called, but in this codebase
+        # lambdas are thin wrappers (retry thunks) whose calls the caller
+        # effectively owns.
+        stack = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Lambda):
+                lam_stack = list(ast.iter_child_nodes(node))
+                while lam_stack:
+                    sub = lam_stack.pop()
+                    if isinstance(
+                        sub,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        continue
+                    if isinstance(sub, ast.Call):
+                        chain, targets = self._resolve_call(
+                            sub, scopes, rel, class_name
+                        )
+                        if targets:
+                            self.resolved_edges += len(targets)
+                        else:
+                            self.unresolved_calls += 1
+                        sites.append(
+                            CallSite(
+                                line=sub.lineno,
+                                chain=chain,
+                                targets=targets,
+                            )
+                        )
+                    lam_stack.extend(ast.iter_child_nodes(sub))
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -------------------------------------------------------------- queries
+
+    def sites_of(self, fid: str) -> List[CallSite]:
+        return self.calls.get(fid, [])
+
+    def functions_in(self, rel: str) -> Iterable[FunctionInfo]:
+        for info in self.functions.values():
+            if info.rel == rel:
+                yield info
+
+    def find_chain(
+        self,
+        start: str,
+        is_sink,
+        through=None,
+    ) -> Optional[List[str]]:
+        """Shortest resolved call path ``start -> ... -> f`` with
+        ``is_sink(f)`` true, as a list of fids.  ``through`` filters which
+        functions the path may traverse (sink excluded from the filter)."""
+        from collections import deque
+
+        if start not in self.functions:
+            return None
+        prev: Dict[str, Optional[str]] = {start: None}
+        queue = deque([start])
+        while queue:
+            fid = queue.popleft()
+            if is_sink(fid):
+                path = [fid]
+                while prev[path[-1]] is not None:
+                    path.append(prev[path[-1]])  # type: ignore[arg-type]
+                return list(reversed(path))
+            if through is not None and fid != start and not through(fid):
+                continue
+            for site in self.calls.get(fid, ()):
+                for target in site.targets:
+                    if target not in prev and target in self.functions:
+                        prev[target] = fid
+                        queue.append(target)
+        return None
+
+
+def build_graph(modules: Sequence[ModuleFile]) -> CallGraph:
+    return CallGraph(modules)

@@ -45,6 +45,20 @@ def add_lint_parser(sub) -> None:
         action="store_true",
         help="also run ruff + mypy (skipped when not installed)",
     )
+    p.add_argument(
+        "--changed",
+        action="store_true",
+        help=(
+            "git-aware fast path: only re-analyze (and report on) files "
+            "touched vs --base; the call graph is still built "
+            "package-wide so interprocedural rules see unchanged callees"
+        ),
+    )
+    p.add_argument(
+        "--base",
+        default="HEAD",
+        help="base ref for --changed (default: HEAD)",
+    )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_lint)
 
@@ -70,7 +84,35 @@ def cmd_lint(args: argparse.Namespace) -> int:
         print(f"not a directory: {root}")
         return 2
 
-    findings = core.lint_project(root, rules=rules)
+    import sys
+
+    def note(message: str) -> None:
+        # Status chatter must not corrupt --json stdout (CI consumers
+        # json.loads it); route it to stderr there.
+        print(message, file=sys.stderr if args.json else sys.stdout)
+
+    only = None
+    if args.changed:
+        only = core.changed_rel_paths(root, base=args.base)
+        if only is None:
+            note(
+                "lint --changed: git unavailable or base unresolvable; "
+                "falling back to a full lint"
+            )
+        elif not only:
+            note(
+                f"lint --changed: no .py files changed vs {args.base}; "
+                "nothing to analyze"
+            )
+            if args.json:
+                print(
+                    json.dumps(
+                        {"root": root, "findings": [], "external": []},
+                        indent=2,
+                    )
+                )
+            return 0
+    findings = core.lint_project(root, rules=rules, only=only)
     externals = run_external(root) if args.external else []
 
     if args.json:

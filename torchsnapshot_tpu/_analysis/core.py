@@ -97,20 +97,28 @@ class ModuleFile:
 
 class Rule:
     """Base rule.  Subclasses set ``name``/``description`` and override
-    ``check`` (per-module) and/or ``project_check`` (cross-file)."""
+    ``check`` (per-module), ``project_check`` (cross-file registry
+    checks), and/or ``graph_check`` (interprocedural rules fed the
+    shared call graph built over the whole scanned file set)."""
 
     name: str = ""
     description: str = ""
 
     def applies_to(self, rel: str) -> bool:
-        """Whether ``check`` runs on this root-relative path during a
-        project lint (fixture tests bypass this via ``lint_sources``)."""
+        """Whether findings for this root-relative path are reported
+        during a project lint (fixture tests bypass this via
+        ``lint_sources``)."""
         return True
 
     def check(self, module: ModuleFile) -> Iterable[Finding]:
         return ()
 
     def project_check(self, project: "Project") -> Iterable[Finding]:
+        return ()
+
+    def graph_check(
+        self, project: "Project", graph: "object"
+    ) -> Iterable[Finding]:
         return ()
 
 
@@ -156,20 +164,37 @@ def find_project_root(start: Optional[str] = None) -> str:
         probe = parent
 
 
+# mtime-keyed parsed-AST cache: the tier-1 suite lints the repo many
+# times per process (repo gate + CLI tests + the stale-suppression
+# scan), and the interprocedural rules parse every file to build the
+# call graph even under ``--changed``.  Keyed on (mtime_ns, size) so an
+# edited file reparses; bounded only by the repo's file count.
+_AST_CACHE: Dict[str, Tuple[Tuple[int, int], ModuleFile]] = {}
+
+
 def _load_module(path: str, rel: str) -> ModuleFile:
+    try:
+        st = os.stat(path)
+        stamp: Optional[Tuple[int, int]] = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        stamp = None
+    if stamp is not None:
+        cached = _AST_CACHE.get(path)
+        if cached is not None and cached[0] == stamp and cached[1].rel == rel:
+            return cached[1]
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
     try:
-        tree = ast.parse(source, filename=path)
-        return ModuleFile(path=path, rel=rel, source=source, tree=tree)
+        tree: Optional[ast.AST] = ast.parse(source, filename=path)
+        err = None
     except SyntaxError as e:
-        return ModuleFile(
-            path=path,
-            rel=rel,
-            source=source,
-            tree=None,
-            parse_error=f"{e.msg} (line {e.lineno})",
-        )
+        tree, err = None, f"{e.msg} (line {e.lineno})"
+    module = ModuleFile(
+        path=path, rel=rel, source=source, tree=tree, parse_error=err
+    )
+    if stamp is not None:
+        _AST_CACHE[path] = (stamp, module)
+    return module
 
 
 def iter_python_files(root: str) -> Iterable[Tuple[str, str]]:
@@ -204,11 +229,14 @@ def load_project(root: Optional[str] = None) -> Project:
 def all_rules() -> List[Rule]:
     """Every registered rule, instantiated fresh (rules hold no state
     across runs beyond construction-time registries)."""
-    from .rules_async import AsyncBlockingRule
-    from .rules_durability import DurabilityRule
+    from .rules_async import AsyncBlockingDeepRule, AsyncBlockingRule
+    from .rules_collective import CollectiveDivergenceRule
+    from .rules_durability import DurabilityFlowRule
     from .rules_events import EventTaxonomyRule, PhaseRegistryRule
     from .rules_exceptions import ExceptionTaxonomyRule
     from .rules_knobs import KnobDisciplineRule, KnobDocsRule
+    from .rules_leaks import ResourceLeakRule
+    from .rules_locks import LockDisciplineRule
     from .rules_native import NativeAbiRule
 
     return [
@@ -216,8 +244,12 @@ def all_rules() -> List[Rule]:
         KnobDocsRule(),
         EventTaxonomyRule(),
         PhaseRegistryRule(),
-        DurabilityRule(),
+        DurabilityFlowRule(),
         AsyncBlockingRule(),
+        AsyncBlockingDeepRule(),
+        CollectiveDivergenceRule(),
+        LockDisciplineRule(),
+        ResourceLeakRule(),
         ExceptionTaxonomyRule(),
         NativeAbiRule(),
     ]
@@ -243,13 +275,63 @@ def _suppression_findings(
             )
 
 
+# Shared call graphs keyed by the module set's identity (file path +
+# mtime stamp per module): the graph is package-wide even when only a
+# subset of files is re-linted (--changed), so reuse across lint calls
+# is what keeps the tier-1 gate under its wall.
+_GRAPH_CACHE: Dict[frozenset, object] = {}
+_GRAPH_CACHE_MAX = 4
+
+
+def _graph_for(project: Project) -> object:
+    from . import callgraph
+
+    key_parts = []
+    cacheable = True
+    for m in project.modules:
+        cached = _AST_CACHE.get(m.path)
+        if cached is not None and cached[1] is m:
+            key_parts.append((m.path, cached[0]))
+        else:
+            cacheable = False
+            break
+    if cacheable:
+        key = frozenset(key_parts)
+        graph = _GRAPH_CACHE.get(key)
+        if graph is None:
+            graph = callgraph.build_graph(project.modules)
+            if len(_GRAPH_CACHE) >= _GRAPH_CACHE_MAX:
+                _GRAPH_CACHE.clear()
+            _GRAPH_CACHE[key] = graph
+        return graph
+    return callgraph.build_graph(project.modules)
+
+
 def _run_rules(
     project: Project,
     rules: Sequence[Rule],
     modules: Sequence[ModuleFile],
     scoped: bool,
+    apply_suppressions: bool = True,
+    run_project_rules: bool = True,
+    restrict_project: Optional[Set[str]] = None,
 ) -> List[Finding]:
     known = {r.name for r in rules} | {r.name for r in all_rules()}
+    report_rels = {m.rel for m in modules}
+    module_by_rel = {m.rel: m for m in project.modules}
+    for m in modules:
+        module_by_rel.setdefault(m.rel, m)
+
+    def keep(rule: Rule, f: Finding) -> bool:
+        if f.path not in report_rels:
+            return False
+        if scoped and not rule.applies_to(f.path):
+            return False
+        if not apply_suppressions:
+            return True
+        module = module_by_rel.get(f.path)
+        return module is None or not module.suppressed(f.rule, f.line)
+
     findings: List[Finding] = []
     for module in modules:
         if module.parse_error is not None:
@@ -264,16 +346,41 @@ def _run_rules(
             continue
         findings.extend(_suppression_findings(module, known))
         for rule in rules:
+            if type(rule).check is Rule.check:
+                continue
             if scoped and not rule.applies_to(module.rel):
                 continue
             for f in rule.check(module):
-                if not module.suppressed(f.rule, f.line):
+                if not apply_suppressions or not module.suppressed(
+                    f.rule, f.line
+                ):
                     findings.append(f)
-    for rule in rules:
-        for f in rule.project_check(project):
-            module = project.module(f.path)
-            if module is None or not module.suppressed(f.rule, f.line):
-                findings.append(f)
+    graph_rules = [
+        r for r in rules if type(r).graph_check is not Rule.graph_check
+    ]
+    if graph_rules:
+        graph = _graph_for(project)
+        for rule in graph_rules:
+            for f in rule.graph_check(project, graph):
+                if keep(rule, f):
+                    findings.append(f)
+    if run_project_rules:
+        for rule in rules:
+            for f in rule.project_check(project):
+                if (
+                    restrict_project is not None
+                    and f.path not in restrict_project
+                ):
+                    # --changed contract: only report on touched files
+                    # (registry findings in untouched files are the full
+                    # gate's job).
+                    continue
+                module = project.module(f.path)
+                if not apply_suppressions or (
+                    module is None
+                    or not module.suppressed(f.rule, f.line)
+                ):
+                    findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -281,13 +388,114 @@ def _run_rules(
 def lint_project(
     root: Optional[str] = None,
     rules: Optional[Sequence[Rule]] = None,
+    only: Optional[Set[str]] = None,
 ) -> List[Finding]:
     """Lint the whole project: every rule (or ``rules``) over every
-    walked module, project-level cross-checks included."""
+    walked module, project-level cross-checks included.  ``only``
+    restricts per-file analysis and reported findings to the given
+    root-relative paths (``tpusnap lint --changed``) — the call graph is
+    still built package-wide, so interprocedural findings in a changed
+    file see unchanged callees."""
     project = load_project(root)
+    modules = project.modules
+    if only is not None:
+        modules = [m for m in modules if m.rel in only]
     return _run_rules(
-        project, list(rules or all_rules()), project.modules, scoped=True
+        project,
+        list(rules or all_rules()),
+        modules,
+        scoped=True,
+        restrict_project=only,
     )
+
+
+def changed_rel_paths(root: str, base: str = "HEAD") -> Optional[Set[str]]:
+    """Root-relative ``.py`` paths touched vs ``base`` (committed diff +
+    worktree + untracked), or None when git is unavailable/errors —
+    callers fall back to a full lint."""
+    import subprocess
+
+    def run(*args: str) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ["git", "-C", root, *args],
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [line.strip() for line in proc.stdout.splitlines()]
+
+    toplevel = run("rev-parse", "--show-toplevel")
+    committed = run("diff", "--name-only", base, "--")
+    worktree = run("diff", "--name-only", "--")
+    staged = run("diff", "--name-only", "--cached", "--")
+    untracked = run("ls-files", "--others", "--exclude-standard")
+    if committed is None or worktree is None or not toplevel:
+        return None
+    # git diff prints TOPLEVEL-relative paths while ls-files prints
+    # cwd-relative ones; when ``root`` is a subdirectory of the git
+    # checkout the two disagree and naive mixing silently matches no
+    # module (a changed file would pass the gate unanalyzed).
+    # Re-anchor everything on the toplevel, then relativize to root.
+    abs_root = os.path.abspath(root)
+    out: Set[str] = set()
+
+    def add(path: str, base_dir: str) -> None:
+        if not path.endswith(".py"):
+            return
+        abs_path = os.path.normpath(os.path.join(base_dir, path))
+        rel = os.path.relpath(abs_path, abs_root)
+        if not rel.startswith(".."):
+            out.add(rel.replace(os.sep, "/"))
+
+    for batch in (committed, worktree, staged or []):
+        for path in batch:
+            add(path, toplevel[0])
+    for path in untracked or []:
+        add(path, abs_root)
+    return out
+
+
+def unused_suppressions(
+    root: Optional[str] = None,
+) -> List[Tuple[str, int, str]]:
+    """Suppression comments that no longer suppress anything: ``(path,
+    line, rule)`` for every ``disable=<rule>`` with no matching raw
+    finding on its line (or the next line, for standalone comments).
+    A stale suppression is debt — it reads as "this is a known
+    exception" while guarding nothing."""
+    project = load_project(root)
+    rules = all_rules()
+    raw = _run_rules(
+        project,
+        rules,
+        project.modules,
+        scoped=True,
+        apply_suppressions=False,
+    )
+    known = {r.name for r in rules}
+    hits: Dict[Tuple[str, str], Set[int]] = {}
+    for f in raw:
+        hits.setdefault((f.path, f.rule), set()).add(f.line)
+    stale: List[Tuple[str, int, str]] = []
+    for module in project.modules:
+        for line, names in sorted(module.suppressions().items()):
+            standalone = (
+                line <= len(module.lines)
+                and module.lines[line - 1].strip().startswith("#")
+            )
+            for name in sorted(names):
+                if name not in known:
+                    continue  # typo'd names are already findings
+                lines = hits.get((module.rel, name), set())
+                if line in lines or (standalone and line + 1 in lines):
+                    continue
+                stale.append((module.rel, line, name))
+    return stale
 
 
 def lint_sources(
@@ -314,18 +522,20 @@ def lint_sources(
     project = Project(
         root=os.path.abspath(root) if root is not None else "", modules=modules
     )
-    per_file = [r for r in rules if type(r).check is not Rule.check]
-    findings = _run_rules(project, per_file, modules, scoped=False)
-    if root is not None:
-        # Project-level cross-checks only run against an EXPLICIT root:
-        # defaulting to os.curdir would make fixture tests silently
-        # cwd-dependent (knob-docs/native-abi would lint whatever tree
-        # pytest happened to be launched from).
-        for rule in rules:
-            if rule not in per_file:
-                findings.extend(rule.project_check(project))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    # Project-level cross-checks only run against an EXPLICIT root:
+    # defaulting to os.curdir would make fixture tests silently
+    # cwd-dependent (knob-docs/native-abi would lint whatever tree
+    # pytest happened to be launched from).  Per-file AND graph rules
+    # always run — the interprocedural rules build their call graph
+    # over exactly the given sources, which is how the golden fixtures
+    # prove cross-function evasions without a repo checkout.
+    return _run_rules(
+        project,
+        list(rules),
+        modules,
+        scoped=False,
+        run_project_rules=root is not None,
+    )
 
 
 # --------------------------------------------------------------- AST utils

@@ -1,0 +1,67 @@
+"""Forward-dataflow framework over the call graph.
+
+The rule family added with the interprocedural engine all reduces to one
+shape: compute a *local* fact set per function (this function blocks /
+fsyncs / acquires lock L / reaches a collective), then saturate over the
+call graph so each function's summary includes everything its resolved
+callees reach.  Facts are hashable values in frozensets, joins are set
+union, and propagation runs a monotone worklist to a fixpoint —
+recursion and cycles converge because the lattice is finite (facts only
+ever come from local seeds).
+
+``propagate`` is the whole framework; rules provide the seeds and an
+optional edge filter (the async rule, for instance, refuses to propagate
+*through* async functions so a finding is reported exactly once, at the
+async frontier that owns it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, FrozenSet, Hashable, Optional
+
+from .callgraph import CallGraph
+
+Facts = FrozenSet[Hashable]
+EMPTY: Facts = frozenset()
+
+
+def propagate(
+    graph: CallGraph,
+    local: Dict[str, Facts],
+    through: Optional[Callable[[str], bool]] = None,
+) -> Dict[str, Facts]:
+    """Transitive summaries: ``summary(f) = local(f) | U summary(g)`` for
+    every resolved callee ``g`` of ``f`` with ``through(g)`` true (default:
+    every project function).  Returns a complete map (missing functions
+    get their local facts, or the empty set)."""
+    summary: Dict[str, Facts] = {
+        fid: local.get(fid, EMPTY) for fid in graph.functions
+    }
+    # Reverse edges: when a callee's summary grows, its callers rejoin the
+    # worklist.
+    callers: Dict[str, set] = {fid: set() for fid in graph.functions}
+    for fid, sites in graph.calls.items():
+        for site in sites:
+            for target in site.targets:
+                if target in callers:
+                    callers[target].add(fid)
+
+    worklist = set(graph.functions)
+    while worklist:
+        fid = worklist.pop()
+        merged = local.get(fid, EMPTY)
+        for site in graph.calls.get(fid, ()):
+            for target in site.targets:
+                if target not in summary:
+                    continue
+                if through is not None and not through(target):
+                    continue
+                merged = merged | summary[target]
+        if merged != summary[fid]:
+            summary[fid] = merged
+            worklist.update(callers.get(fid, ()))
+    return summary
+
+
+def reaches(summary: Dict[str, Facts], fid: str) -> Facts:
+    return summary.get(fid, EMPTY)

@@ -15,9 +15,11 @@ run_in_executor-target idiom the scheduler and plugins use.
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Tuple
+from typing import Dict, FrozenSet, Hashable, Iterable, List, Optional, Tuple
 
-from .core import Finding, ModuleFile, Rule, dotted_name, in_package
+from . import dataflow
+from .callgraph import CallGraph
+from .core import Finding, ModuleFile, Project, Rule, dotted_name, in_package
 
 # Fully-matched dotted chains (after normalizing away self./cls. and a
 # leading underscore on the first segment, so `self._requests.get` is seen
@@ -104,3 +106,113 @@ class AsyncBlockingRule(Rule):
                     line=node.lineno,
                     message=f"in `async def {owner.name}`: {hint}",
                 )
+
+
+class AsyncBlockingDeepRule(Rule):
+    """Interprocedural complement of :class:`AsyncBlockingRule`.
+
+    The lexical rule only sees blocking calls whose *nearest* enclosing
+    function is async — so ``async def`` calling a sync helper that calls
+    ``time.sleep``/``requests``/``open`` evades it entirely.  This rule
+    propagates a may-block summary over the call graph through *sync*
+    project functions and reports at the async call site that pulls the
+    blocking chain onto the event loop, naming the full chain.
+
+    Executor targets stay exempt for free: passing a function to
+    ``run_in_executor`` is a value reference, not a call, so no call
+    edge exists and no summary flows.  Async callees are not propagated
+    through either — their own direct blocking calls are the lexical
+    rule's findings, and their deep chains are their own findings, so
+    each defect is reported exactly once at the frontier that owns it.
+    """
+
+    name = "async-blocking-deep"
+    description = (
+        "An `async def` calling a sync helper that (transitively) "
+        "blocks — time.sleep, requests.*, subprocess.*, builtin open — "
+        "stalls the scheduler loop through the call chain; route the "
+        "helper through run_in_executor."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    def _local_blocking(
+        self, graph: CallGraph
+    ) -> Dict[str, FrozenSet[Hashable]]:
+        local: Dict[str, FrozenSet[Hashable]] = {}
+        for fid, info in graph.functions.items():
+            if info.is_async:
+                continue
+            facts = set()
+            for site in graph.sites_of(fid):
+                if site.targets or site.chain is None:
+                    continue  # resolved project calls aren't primitives
+                if site.chain == "open":
+                    facts.add(("open()", fid, site.line))
+                    continue
+                chain = _normalize(site.chain)
+                if chain in _BLOCKED_EXACT or (
+                    chain.split(".", 1)[0] in _BLOCKED_ROOTS
+                ):
+                    facts.add((chain, fid, site.line))
+            if facts:
+                local[fid] = frozenset(facts)
+        return local
+
+    def graph_check(
+        self, project: Project, graph: CallGraph
+    ) -> Iterable[Finding]:
+        local = self._local_blocking(graph)
+        summary = dataflow.propagate(
+            graph, local, through=lambda f: not graph.functions[f].is_async
+        )
+        for fid, info in graph.functions.items():
+            if not info.is_async:
+                continue
+            seen: set = set()
+            for site in graph.sites_of(fid):
+                for target in site.targets:
+                    tinfo = graph.functions.get(target)
+                    if tinfo is None or tinfo.is_async:
+                        continue
+                    facts = dataflow.reaches(summary, target)
+                    if not facts:
+                        continue
+                    key = (site.line, target)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    chain_path = graph.find_chain(
+                        target,
+                        lambda f: f in local,
+                        through=lambda f: not graph.functions[
+                            f
+                        ].is_async,
+                    ) or [target]
+                    via = " -> ".join(
+                        graph.functions[f].qualname for f in chain_path
+                    )
+                    sink_fid = chain_path[-1]
+                    prim, _, sink_line = sorted(
+                        (str(f[0]), str(f[1]), int(f[2]))  # type: ignore[index]
+                        for f in local.get(sink_fid, facts)
+                    )[0]
+                    sink = graph.functions.get(sink_fid)
+                    where = (
+                        f" ({sink.rel}:{sink_line})"
+                        if sink is not None
+                        else ""
+                    )
+                    yield Finding(
+                        rule=self.name,
+                        path=info.rel,
+                        line=site.line,
+                        message=(
+                            f"`async def {info.qualname}` calls sync "
+                            f"helper chain {via} which blocks via "
+                            f"{prim}{where}; run the helper on an "
+                            "executor (run_in_executor) instead of the "
+                            "event loop"
+                        ),
+                    )

@@ -1,0 +1,335 @@
+"""Collective-divergence rule: cross-rank deadlock hazards.
+
+Every rank must make the same sequence of collective/barrier calls.  The
+two statically-checkable ways this breaks:
+
+1. **Rank-guarded collectives** — a call that (transitively) reaches a
+   collective primitive (``LinearBarrier.arrive``/``depart``,
+   ``PGWrapper`` object collectives, ``pg.barrier()``, or a blocking
+   dist-store GET) from inside a rank-conditional branch (``if rank ==
+   0:``-style, including guard-return tails).  The guarded ranks arrive;
+   the others never do; everyone else rides out
+   ``TPUSNAP_BARRIER_TIMEOUT_S``.
+2. **Divergent raise before a collective in a loop** — a conditional
+   ``raise`` lexically preceding a collective inside the same loop body:
+   the raising rank exits the loop while its peers block in the
+   collective for that iteration (the take/restore per-key barrier loops
+   are exactly this shape).
+
+The coordination layer itself (dist_store/pg_wrapper/tpustore/
+coordination) is exempt: leader-only waits are *how the protocol is
+implemented* there, not a divergence bug.  Interprocedural reach comes
+from the call graph + dataflow summaries; unresolved callees honestly
+contribute nothing (documented blind spot), but the primitive *names*
+are also matched on unresolved attribute chains, so ``barrier.arrive()``
+through an instance attribute is still seen at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Hashable, Iterable, List, Optional, Set, Tuple
+
+from . import dataflow
+from .callgraph import CallGraph, CallSite
+from .core import Finding, Project, Rule, dotted_name, in_package
+
+# Modules implementing the coordination protocol: asymmetric waits are
+# by-design there (the leader blocks on sentinels peers set).
+PROTOCOL_MODULES = frozenset(
+    {
+        "torchsnapshot_tpu/dist_store.py",
+        "torchsnapshot_tpu/pg_wrapper.py",
+        "torchsnapshot_tpu/tpustore.py",
+        "torchsnapshot_tpu/coordination.py",
+    }
+)
+
+_COLLECTIVE_LEAVES = frozenset(
+    {
+        "all_gather_object",
+        "broadcast_object_list",
+        "gather_object_root",
+        "all_reduce_object",
+        "scatter_object_list",
+        "barrier",
+    }
+)
+_BARRIER_LEAVES = frozenset({"arrive", "depart"})
+
+_TERMINAL = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+def primitive_of(site: CallSite) -> Optional[str]:
+    """Human-readable primitive description when ``site`` directly calls
+    a collective/barrier/blocking-store primitive, else None."""
+    chain = site.chain
+    if not chain:
+        return None
+    parts = chain.split(".")
+    leaf = parts[-1]
+    if leaf in _BARRIER_LEAVES and len(parts) >= 2:
+        return f"LinearBarrier.{leaf}"
+    if leaf in _COLLECTIVE_LEAVES:
+        return f"collective {leaf}()"
+    if (
+        leaf == "get"
+        and len(parts) >= 2
+        and "store" in parts[-2].lower()
+    ):
+        return "blocking store.get()"
+    return None
+
+
+def _chain_leaf(expr: ast.AST) -> Optional[str]:
+    chain = dotted_name(expr)
+    if chain is None:
+        return None
+    return chain.rsplit(".", 1)[-1]
+
+
+class CollectiveDivergenceRule(Rule):
+    name = "collective-divergence"
+    description = (
+        "Collectives/barriers/blocking store GETs reachable from a "
+        "rank-conditional branch, or conditional raises before an "
+        "in-loop collective, deadlock peers across ranks; every rank "
+        "must issue the same collective sequence."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel) and rel not in PROTOCOL_MODULES
+
+    # ------------------------------------------------------ rank detection
+
+    def _rank_value(self, expr: ast.AST, rank_names: Set[str]) -> bool:
+        """Whether ``expr`` denotes this process's rank (or a boolean
+        derived from it)."""
+        if isinstance(expr, ast.Call):
+            leaf = _chain_leaf(expr.func)
+            return leaf is not None and "rank" in leaf.lower()
+        if isinstance(expr, ast.Name):
+            return "rank" in expr.id.lower() or expr.id in rank_names
+        if isinstance(expr, ast.Attribute):
+            return "rank" in expr.attr.lower()
+        return False
+
+    def _is_rank_test(self, expr: ast.AST, rank_names: Set[str]) -> bool:
+        if isinstance(expr, ast.BoolOp):
+            return any(
+                self._is_rank_test(v, rank_names) for v in expr.values
+            )
+        if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+            return self._is_rank_test(expr.operand, rank_names)
+        if isinstance(expr, ast.Compare):
+            sides = [expr.left] + list(expr.comparators)
+            return any(self._rank_value(s, rank_names) for s in sides)
+        # `if rank:` / `if rank0:` / `if self._is_leader:` style truthiness.
+        return self._rank_value(expr, rank_names)
+
+    def _rank_bool_names(self, fn: ast.AST) -> Set[str]:
+        """Local names assigned from a rank comparison (``rank0 =
+        pg.get_rank() == 0``) — so ``if rank0:`` is still a rank guard
+        even when the name itself wouldn't match."""
+        names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Compare, ast.BoolOp, ast.UnaryOp)
+            ):
+                if self._is_rank_test(node.value, names):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            names.add(target.id)
+        return names
+
+    # ------------------------------------------------------- region walking
+
+    def _child_blocks(self, stmt: ast.stmt) -> Iterable[List[ast.stmt]]:
+        for attr in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, attr, None)
+            if isinstance(block, list) and block and isinstance(
+                block[0], ast.stmt
+            ):
+                yield block
+        for handler in getattr(stmt, "handlers", ()):
+            yield handler.body
+
+    def _guarded_statements(
+        self, fn: ast.AST, rank_names: Set[str]
+    ) -> List[ast.stmt]:
+        """Statements executed by a rank-dependent subset of ranks: bodies
+        of rank-conditional Ifs, and — for guard-return Ifs (``if rank !=
+        0: return``) — the remainder of the enclosing block."""
+        out: List[ast.stmt] = []
+
+        def collect(stmts: List[ast.stmt]) -> None:
+            for idx, stmt in enumerate(stmts):
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if isinstance(stmt, ast.If) and self._is_rank_test(
+                    stmt.test, rank_names
+                ):
+                    out.extend(stmt.body)
+                    out.extend(stmt.orelse)
+                    if stmt.body and isinstance(stmt.body[-1], _TERMINAL):
+                        out.extend(stmts[idx + 1 :])
+                for block in self._child_blocks(stmt):
+                    collect(block)
+
+        for block in self._child_blocks(fn):  # type: ignore[arg-type]
+            collect(block)
+        return out
+
+    def _lines_of(self, stmts: Iterable[ast.stmt]) -> Set[int]:
+        lines: Set[int] = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                lineno = getattr(node, "lineno", None)
+                end = getattr(node, "end_lineno", None)
+                if lineno is not None:
+                    lines.add(lineno)
+                    if end is not None:
+                        lines.update(range(lineno, end + 1))
+        return lines
+
+    # ------------------------------------------------------------ the rule
+
+    def graph_check(
+        self, project: Project, graph: CallGraph
+    ) -> Iterable[Finding]:
+        # Local facts: primitive descriptions per function, skipping the
+        # protocol layer (its waits ARE the implementation).
+        local: Dict[str, FrozenSet[Hashable]] = {}
+        for fid, info in graph.functions.items():
+            if info.rel in PROTOCOL_MODULES:
+                continue
+            prims = frozenset(
+                p
+                for site in graph.sites_of(fid)
+                if (p := primitive_of(site)) is not None
+            )
+            if prims:
+                local[fid] = prims
+        summary = dataflow.propagate(
+            graph,
+            local,
+            through=lambda f: graph.functions[f].rel
+            not in PROTOCOL_MODULES,
+        )
+
+        for fid, info in graph.functions.items():
+            rank_names = self._rank_bool_names(info.node)
+            guarded = self._guarded_statements(info.node, rank_names)
+            if guarded:
+                guarded_lines = self._lines_of(guarded)
+                seen: Set[Tuple[int, str]] = set()
+                for site in graph.sites_of(fid):
+                    if site.line not in guarded_lines:
+                        continue
+                    prim = primitive_of(site)
+                    if prim is not None:
+                        key = (site.line, prim)
+                        if key not in seen:
+                            seen.add(key)
+                            yield self._finding(
+                                info.rel,
+                                site.line,
+                                f"{prim} called under a rank-conditional "
+                                f"branch in {info.qualname}",
+                            )
+                        continue
+                    for target in site.targets:
+                        reached = dataflow.reaches(summary, target)
+                        if not reached:
+                            continue
+                        prim = sorted(str(r) for r in reached)[0]
+                        tname = graph.functions[target].qualname
+                        key = (site.line, tname)
+                        if key not in seen:
+                            seen.add(key)
+                            yield self._finding(
+                                info.rel,
+                                site.line,
+                                f"call to {tname}() under a "
+                                f"rank-conditional branch in "
+                                f"{info.qualname} reaches {prim}",
+                            )
+            yield from self._loop_divergent_raises(graph, fid, info)
+
+    def _loop_divergent_raises(
+        self, graph: CallGraph, fid: str, info
+    ) -> Iterable[Finding]:
+        for loop in ast.walk(info.node):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            body_lines = self._lines_of(loop.body)
+            prim_lines = sorted(
+                site.line
+                for site in graph.sites_of(fid)
+                if site.line in body_lines
+                and primitive_of(site) is not None
+            )
+            if not prim_lines:
+                continue
+            last_prim = prim_lines[-1]
+            reported: Set[int] = set()
+            stack: List[ast.AST] = list(loop.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                if isinstance(node, ast.If) and node.lineno < last_prim:
+                    # Both branches are conditional execution — an
+                    # `else: raise` diverges exactly like `if: raise`.
+                    if_stack: List[ast.AST] = list(node.body) + list(
+                        node.orelse
+                    )
+                    while if_stack:
+                        inner = if_stack.pop()
+                        if isinstance(
+                            inner,
+                            (
+                                ast.FunctionDef,
+                                ast.AsyncFunctionDef,
+                                ast.Lambda,
+                            ),
+                        ):
+                            continue
+                        if (
+                            isinstance(inner, ast.Raise)
+                            and inner.lineno < last_prim
+                            and inner.lineno not in reported
+                        ):
+                            reported.add(inner.lineno)
+                            yield self._finding(
+                                info.rel,
+                                inner.lineno,
+                                "conditional raise before a collective "
+                                f"in the same loop body of "
+                                f"{info.qualname}: a rank raising here "
+                                "exits the loop while peers block in "
+                                "the collective at line "
+                                f"{last_prim}; validate symmetrically "
+                                "before the loop",
+                            )
+                        if_stack.extend(ast.iter_child_nodes(inner))
+                stack.extend(ast.iter_child_nodes(node))
+
+    def _finding(self, rel: str, line: int, message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            path=rel,
+            line=line,
+            message=message
+            + " — every rank must reach the same collectives, or peers "
+            "deadlock until TPUSNAP_BARRIER_TIMEOUT_S",
+        )

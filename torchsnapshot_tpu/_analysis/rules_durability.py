@@ -1,77 +1,334 @@
-"""Durability discipline: the tmp+fsync+rename commit pattern (PR 3).
+"""Flow-sensitive durability: tmp+fsync+rename, followed across callees.
 
-A rename that publishes un-fsynced bytes can surface a zero-length or torn
-file after a host crash — the exact bug class the durable-commit work
-removed from the storage layer.  The check is lexical: an
-``os.rename``/``os.replace`` call is flagged unless an fsync happens
-earlier in the same function body.  Renames that genuinely don't need
-durability (telemetry sidecars, lock-file shuffling) carry a suppression
-naming why.
+Replaces PR 9's lexical ``durability-discipline`` rule (an
+``os.rename``/``os.replace`` without an fsync earlier in the *same
+function body*).  The lexical shape had two failure modes this rule
+closes and one noise source it removes:
+
+- **fsync-in-callee evasion** — ``write(); _commit(tmp)`` where the
+  helper renames, or ``_sync(tmp); os.replace(...)`` where the helper
+  fsyncs: the lexical rule flags the safe shape and misses the unsafe
+  one.  Here, fsync/write/rename facts are interprocedural summaries
+  propagated over the call graph; a *publish helper* (renames bytes it
+  did not write or sync) transfers the fsync obligation to its callers.
+- **pristine renames** — renaming a file whose bytes this flow never
+  wrote (lock steals, pure moves of already-durable files) needs no
+  fsync; the lexical rule demanded suppressions for them.  The flow
+  rule only flags a rename when a write happened earlier in the flow
+  with no intervening fsync.
+
+What still warrants a suppression: renames that *publish freshly
+written bytes non-durably on purpose* (telemetry spool/trace/heartbeat
+files, KV coordination values, self-verifying cache entries).  Those
+carry a ``disable=durability-flow`` suppression with a justification,
+and the stale-suppression test asserts each one still suppresses a live
+finding.
+
+Fact collection is line-ordered within a function (may-analysis over
+the body: an fsync in any earlier branch counts — the ``durable=``
+flag-guarded fsync in the fs plugin is the canonical false-positive
+this avoids); flow *into callees* is where the path sensitivity lives.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .core import Finding, ModuleFile, Rule, dotted_name, in_package
+from .callgraph import CallGraph
+from .core import Finding, Project, Rule, dotted_name, in_package
 
 _RENAME_FUNCS = {"os.rename", "os.replace"}
-# What counts as "an fsync happened": a direct os.fsync/os.fdatasync, or a
-# call into a helper whose name declares the durable contract (the fs
-# plugin's `durable` flag plumbing).
-_FSYNC_MARKERS = ("fsync", "fdatasync", "durable")
+_FSYNC_LEAVES = {"fsync", "fdatasync"}
+_WRITE_LEAVES = {
+    "write",
+    "writelines",
+    "write_file",
+    "write_file_parts",
+    "write_text",
+    "write_bytes",
+}
+_TMP_CREATORS = {
+    "tempfile.mkstemp",
+    "tempfile.NamedTemporaryFile",
+    "tempfile.TemporaryFile",
+}
+_WRITE_MODE_CHARS = set("wax+")
 
 
-class DurabilityRule(Rule):
-    name = "durability-discipline"
+def _call_chain(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def _is_write_open(node: ast.Call, chain: Optional[str]) -> bool:
+    """open()/os.fdopen() with a writing mode, os.open() with creating/
+    writing flags, or a tempfile creator."""
+    if chain in _TMP_CREATORS:
+        return True
+    if chain in ("open", "os.fdopen"):
+        mode: Optional[str] = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+            if isinstance(node.args[1].value, str):
+                mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    mode = kw.value.value
+        return mode is not None and bool(set(mode) & _WRITE_MODE_CHARS)
+    if chain == "os.open":
+        flags_src = ast.dump(node.args[1]) if len(node.args) >= 2 else ""
+        return any(
+            flag in flags_src
+            for flag in ("O_WRONLY", "O_RDWR", "O_CREAT", "O_APPEND")
+        )
+    return False
+
+
+class _FnFacts:
+    """Line-ordered durability events of one function."""
+
+    __slots__ = (
+        "fsyncs",
+        "writes",
+        "renames",
+        "calls",
+        "written_names",
+        "args_by_line",
+    )
+
+    def __init__(self) -> None:
+        self.fsyncs: List[int] = []
+        self.writes: List[int] = []
+        self.renames: List[Tuple[int, str]] = []  # (line, "os.replace")
+        self.calls: List[Tuple[int, str]] = []  # (line, target fid)
+        # Local names this function wrote bytes through/to (tmp paths,
+        # fds) — the publish-helper obligation only transfers when one
+        # of THESE names is passed to the helper, so renaming an
+        # unrelated pre-existing file (lock steals) in a callee can't
+        # implicate the caller's writes.
+        self.written_names: Set[str] = set()
+        self.args_by_line: Dict[int, Set[str]] = {}
+
+
+class DurabilityFlowRule(Rule):
+    name = "durability-flow"
     description = (
-        "os.rename/os.replace publishing a file must be preceded by an "
-        "fsync in the same function body (tmp+fsync+rename): renaming "
-        "un-synced bytes can publish a torn file after a crash."
+        "A rename publishing bytes written earlier in the flow "
+        "(this function or its callees) without an intervening fsync "
+        "can surface a torn file after a crash — tmp+fsync+rename, "
+        "followed interprocedurally."
     )
 
     def applies_to(self, rel: str) -> bool:
         return in_package(rel)
 
-    def _fsync_lines(self, fn: ast.AST) -> List[int]:
-        lines = []
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            chain = dotted_name(node.func) or ""
-            leaf = chain.rsplit(".", 1)[-1]
-            if any(marker in leaf for marker in _FSYNC_MARKERS):
-                lines.append(node.lineno)
-        return lines
+    # ------------------------------------------------------------- collect
 
-    def check(self, module: ModuleFile) -> Iterable[Finding]:
-        assert module.tree is not None
-        for fn in ast.walk(module.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            renames = [
-                node
-                for node in ast.walk(fn)
-                if isinstance(node, ast.Call)
-                and dotted_name(node.func) in _RENAME_FUNCS
-            ]
-            if not renames:
-                continue
-            fsyncs = self._fsync_lines(fn)
-            for node in renames:
-                if any(line < node.lineno for line in fsyncs):
+    def _collect(self, graph: CallGraph) -> Dict[str, _FnFacts]:
+        facts: Dict[str, _FnFacts] = {}
+        for fid, info in graph.functions.items():
+            f = _FnFacts()
+            stack: List[ast.AST] = list(ast.iter_child_nodes(info.node))
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
                     continue
-                func_name = dotted_name(node.func)
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    # fd, tmp = tempfile.mkstemp(...): both names carry
+                    # the written bytes.
+                    if _call_chain(node.value) in _TMP_CREATORS:
+                        for target in node.targets:
+                            elts = (
+                                target.elts
+                                if isinstance(target, ast.Tuple)
+                                else [target]
+                            )
+                            for elt in elts:
+                                if isinstance(elt, ast.Name):
+                                    f.written_names.add(elt.id)
+                if isinstance(node, ast.Call):
+                    chain = _call_chain(node)
+                    leaf = (
+                        chain.rsplit(".", 1)[-1] if chain else ""
+                    )
+                    arg_names = {
+                        a.id
+                        for a in node.args
+                        if isinstance(a, ast.Name)
+                    }
+                    f.args_by_line.setdefault(node.lineno, set()).update(
+                        arg_names
+                    )
+                    if chain in _RENAME_FUNCS:
+                        f.renames.append((node.lineno, chain))
+                    elif leaf in _FSYNC_LEAVES or "durable" in leaf:
+                        f.fsyncs.append(node.lineno)
+                    elif any(
+                        kw.arg == "durable"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in node.keywords
+                    ):
+                        # WriteIO(..., durable=True) and friends: the
+                        # durable contract delegates the fsync downstream.
+                        f.fsyncs.append(node.lineno)
+                    elif _is_write_open(node, chain):
+                        f.writes.append(node.lineno)
+                        f.written_names.update(arg_names)
+                    elif leaf in _WRITE_LEAVES:
+                        f.writes.append(node.lineno)
+                        # fh.write(...): the receiver name carries bytes.
+                        if isinstance(node.func, ast.Attribute):
+                            recv = node.func.value
+                            if isinstance(recv, ast.Name):
+                                f.written_names.add(recv.id)
+                stack.extend(ast.iter_child_nodes(node))
+            for site in graph.sites_of(fid):
+                for target in site.targets:
+                    f.calls.append((site.line, target))
+            facts[fid] = f
+        return facts
+
+    # ------------------------------------------------------------ summaries
+
+    def _summaries(
+        self, facts: Dict[str, _FnFacts]
+    ) -> Tuple[Set[str], Set[str], Set[str]]:
+        """(does_fsync, does_write, publishes) fixpoint.
+
+        ``publishes``: the function renames (directly or via another
+        publisher) bytes it neither wrote nor fsynced itself — the
+        fsync obligation escapes to its callers."""
+        does_fsync: Set[str] = set()
+        does_write: Set[str] = set()
+        publishes: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fid, f in facts.items():
+                fsync = bool(f.fsyncs) or any(
+                    t in does_fsync for _, t in f.calls
+                )
+                write = bool(f.writes) or any(
+                    t in does_write for _, t in f.calls
+                )
+                if fsync and fid not in does_fsync:
+                    does_fsync.add(fid)
+                    changed = True
+                if write and fid not in does_write:
+                    does_write.add(fid)
+                    changed = True
+                pub = self._has_escaping_rename(fid, f, publishes)
+                if pub and fid not in publishes:
+                    publishes.add(fid)
+                    changed = True
+        return does_fsync, does_write, publishes
+
+    def _fsync_before(
+        self, f: _FnFacts, line: int, does_fsync: Set[str]
+    ) -> bool:
+        if any(x < line for x in f.fsyncs):
+            return True
+        return any(
+            cl < line and t in does_fsync for cl, t in f.calls
+        )
+
+    def _write_before(
+        self, f: _FnFacts, line: int, does_write: Set[str]
+    ) -> bool:
+        if any(x < line for x in f.writes):
+            return True
+        return any(
+            cl < line and t in does_write for cl, t in f.calls
+        )
+
+    def _publisher_call_lines(
+        self, f: _FnFacts, publishes: Set[str]
+    ) -> List[int]:
+        """Call lines that hand one of this function's written/owned
+        names to a publish helper.  With no written names yet, a plain
+        forwarder (parameter straight into a publisher) still counts —
+        that is how the publish obligation travels up a chain."""
+        out = []
+        for line, target in f.calls:
+            if target not in publishes:
+                continue
+            args = f.args_by_line.get(line, set())
+            if f.written_names and not (args & f.written_names):
+                continue
+            out.append(line)
+        return out
+
+    def _has_escaping_rename(
+        self, fid: str, f: _FnFacts, publishes: Set[str]
+    ) -> bool:
+        rename_lines = [
+            line for line, _ in f.renames
+        ] + self._publisher_call_lines(f, publishes)
+        for line in rename_lines:
+            if any(x < line for x in f.fsyncs):
+                continue
+            if any(x < line for x in f.writes):
+                continue
+            return True
+        return False
+
+    # ------------------------------------------------------------ the rule
+
+    def graph_check(
+        self, project: Project, graph: CallGraph
+    ) -> Iterable[Finding]:
+        facts = self._collect(graph)
+        does_fsync, does_write, publishes = self._summaries(facts)
+
+        for fid, f in facts.items():
+            info = graph.functions[fid]
+            # Direct renames: flagged when the flow wrote bytes earlier
+            # with no fsync in between (interprocedural on both sides).
+            for line, chain in f.renames:
+                if self._fsync_before(f, line, does_fsync):
+                    continue
+                if not self._write_before(f, line, does_write):
+                    continue  # pristine rename: nothing torn to publish
                 yield Finding(
                     rule=self.name,
-                    path=module.rel,
-                    line=node.lineno,
+                    path=info.rel,
+                    line=line,
                     message=(
-                        f"{func_name} in {fn.name}() without a preceding "
-                        "fsync in the same function: a crash can publish a "
-                        "torn file — follow tmp+fsync+rename, or suppress "
-                        "with a comment naming why durability is not "
-                        "required here"
+                        f"{chain} in {info.qualname}() publishes bytes "
+                        "written earlier in this flow without an fsync "
+                        "in between: a crash can publish a torn file — "
+                        "tmp+fsync+rename, or suppress with a comment "
+                        "naming why durability is not required"
+                    ),
+                )
+            # Calls into publish helpers: the rename obligation escaped
+            # to this caller (only when one of the caller's written
+            # names is what the helper is handed).
+            publisher_lines = set(
+                self._publisher_call_lines(f, publishes)
+            )
+            for line, target in f.calls:
+                if target not in publishes or line not in publisher_lines:
+                    continue
+                if self._fsync_before(f, line, does_fsync):
+                    continue
+                if not self._write_before(f, line, does_write):
+                    continue
+                tname = graph.functions[target].qualname
+                yield Finding(
+                    rule=self.name,
+                    path=info.rel,
+                    line=line,
+                    message=(
+                        f"{info.qualname}() writes bytes and then "
+                        f"publishes them through {tname}() (which "
+                        "renames without syncing) with no fsync in "
+                        "between: a crash can publish a torn file — "
+                        "fsync before the publish call"
                     ),
                 )

@@ -1,0 +1,230 @@
+"""Resource-leak rule: fds/flocks must survive the exception path.
+
+Scoped to the modules that juggle raw descriptors — the storage plugins,
+the host chunk cache, the CAS, the journal, the dist store, and the TCP
+store client.  A leaked fd in the serving tier is not cosmetic: the
+cache's advisory flocks release on fd close, so a leaked locked fd in a
+long-lived serve worker wedges that key's single-flight for the process
+lifetime, and fd exhaustion under fleet concurrency turns into spurious
+EMFILE read failures.
+
+A raw open (``os.open``, builtin ``open`` outside ``with``,
+``socket.socket``, the fd half of ``tempfile.mkstemp``) must be closed
+on *every* path:
+
+- ``with`` / ``os.fdopen`` (ownership moves into the file object) — ok
+- close in a ``finally`` or in an ``except`` handler — ok
+- returned / yielded / stored on ``self`` (ownership transfer;
+  honesty: the receiver's hygiene is their own function's problem) — ok
+- closed only on the straight-line path while raise-capable calls sit
+  between open and close — finding
+- never closed at all — finding
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, ModuleFile, Rule, dotted_name
+
+_SCOPED = (
+    "torchsnapshot_tpu/storage_plugins/",
+    "torchsnapshot_tpu/cache.py",
+    "torchsnapshot_tpu/cas.py",
+    "torchsnapshot_tpu/journal.py",
+    "torchsnapshot_tpu/dist_store.py",
+    "torchsnapshot_tpu/tpustore.py",
+    "torchsnapshot_tpu/incremental.py",
+)
+
+_OPENERS = {"os.open", "open", "socket.socket", "socket.create_connection"}
+
+
+class ResourceLeakRule(Rule):
+    name = "resource-leak"
+    description = (
+        "fds/sockets (and the flocks they hold) opened outside "
+        "`with`/`os.fdopen` must be closed in a finally/except or have "
+        "their ownership transferred; a straight-line close leaks on "
+        "every exception path."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return any(
+            rel == scope or rel.startswith(scope) for scope in _SCOPED
+        )
+
+    # -------------------------------------------------------------- helpers
+
+    def _protected_lines(self, fn: ast.AST) -> Set[int]:
+        """Lines inside finally blocks and except handlers — a close
+        there covers the exception path."""
+        lines: Set[int] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            for block in [node.finalbody] + [
+                h.body for h in node.handlers
+            ]:
+                for stmt in block:
+                    end = getattr(stmt, "end_lineno", stmt.lineno)
+                    lines.update(
+                        range(stmt.lineno, (end or stmt.lineno) + 1)
+                    )
+        return lines
+
+    def _opens(
+        self, fn: ast.AST
+    ) -> List[Tuple[str, int]]:
+        """(name, line) for raw-open assignments owned by ``fn``."""
+        out: List[Tuple[str, int]] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                chain = dotted_name(node.value.func)
+                if chain in _OPENERS:
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            out.append((target.id, node.lineno))
+                elif chain == "tempfile.mkstemp":
+                    for target in node.targets:
+                        if (
+                            isinstance(target, ast.Tuple)
+                            and target.elts
+                            and isinstance(target.elts[0], ast.Name)
+                        ):
+                            out.append(
+                                (target.elts[0].id, node.lineno)
+                            )
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def _name_used(self, node: ast.AST, name: str) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id == name
+            for sub in ast.walk(node)
+        )
+
+    def _close_lines(self, fn: ast.AST, name: str) -> List[int]:
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_name(node.func)
+            if chain == f"{name}.close" or (
+                chain in ("os.close", "contextlib.closing")
+                and any(
+                    isinstance(a, ast.Name) and a.id == name
+                    for a in node.args
+                )
+            ):
+                out.append(node.lineno)
+        return out
+
+    def _is_bare_name(self, expr: Optional[ast.AST], name: str) -> bool:
+        """``expr`` IS the name (possibly inside a tuple/list literal) —
+        `return fd` transfers ownership; `return os.fstat(fd).st_size`
+        does not."""
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id == name
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self._is_bare_name(e, name) for e in expr.elts)
+        return False
+
+    def _ownership_transferred(self, fn: ast.AST, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Return, ast.Yield)):
+                if self._is_bare_name(node.value, name):
+                    return True
+            if isinstance(node, ast.Call):
+                chain = dotted_name(node.func) or ""
+                leaf = chain.rsplit(".", 1)[-1]
+                if leaf in ("fdopen", "makefile", "detach", "append", "put"):
+                    if any(
+                        self._name_used(a, name) for a in node.args
+                    ):
+                        return True
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(
+                        target, (ast.Attribute, ast.Subscript)
+                    ) and self._is_bare_name(node.value, name):
+                        return True
+        return False
+
+    def _risky_between(
+        self, fn: ast.AST, name: str, open_line: int, close_line: int
+    ) -> bool:
+        """Any raise-capable call strictly between open and close (the
+        close itself and pure name/attribute loads don't count)."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (open_line < node.lineno < close_line):
+                continue
+            chain = dotted_name(node.func) or ""
+            if chain == f"{name}.close" or chain == "os.close":
+                continue
+            return True
+        return False
+
+    # ------------------------------------------------------------ the rule
+
+    def check(self, module: ModuleFile) -> Iterable[Finding]:
+        assert module.tree is not None
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            opens = self._opens(fn)
+            if not opens:
+                continue
+            protected = self._protected_lines(fn)
+            for name, open_line in opens:
+                if self._ownership_transferred(fn, name):
+                    continue
+                closes = self._close_lines(fn, name)
+                if not closes:
+                    yield Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=open_line,
+                        message=(
+                            f"{name} opened in {fn.name}() is never "
+                            "closed in this function and its ownership "
+                            "is not transferred: the fd (and any flock "
+                            "it holds) leaks — use `with`, os.fdopen, "
+                            "or close in a finally"
+                        ),
+                    )
+                    continue
+                if any(line in protected for line in closes):
+                    continue
+                first_close = min(
+                    line for line in closes if line > open_line
+                ) if any(line > open_line for line in closes) else None
+                if first_close is None:
+                    continue
+                if self._risky_between(fn, name, open_line, first_close):
+                    yield Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=open_line,
+                        message=(
+                            f"{name} opened in {fn.name}() is closed "
+                            f"only on the straight-line path (line "
+                            f"{first_close}) with raise-capable calls "
+                            "in between: an exception leaks the fd "
+                            "(and releases no flock) — close it in a "
+                            "finally"
+                        ),
+                    )

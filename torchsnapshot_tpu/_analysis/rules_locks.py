@@ -1,0 +1,389 @@
+"""Lock discipline: acquisition order and holding sync locks across awaits.
+
+Three interprocedural checks over locks the analyzer can *identify* —
+``threading.Lock``/``RLock``/``Condition`` and
+``asyncio.Lock``/``Semaphore`` instances bound to module globals or
+``self.<attr>`` in ``__init__`` (function-local locks are skipped: they
+cannot participate in cross-function deadlocks):
+
+1. **hold-across-await** — an ``async def`` awaiting inside a *sync*
+   ``with <lock>:`` block parks the event loop's other tasks behind a
+   lock only a running task can release; a second task hitting the same
+   lock deadlocks the loop outright.
+2. **lock-order inversion** — pairwise acquisition order is collected
+   per function (nested ``with`` spans plus, interprocedurally, calls
+   made while a lock is held against the callee's transitive
+   acquisition summary); observing both (A→B) and (B→A) anywhere in the
+   scanned set is a deadlock waiting for the right interleaving.
+3. **relock of a non-reentrant lock** — a call made while holding a
+   plain ``threading.Lock`` whose callee (transitively) acquires the
+   same lock self-deadlocks on first execution.
+
+Advisory ``flock``s are deliberately out of scope for ordering (their
+identity is a runtime path) — fd hygiene for them is the resource-leak
+rule's job, and cache.py's cross-process single-flight legitimately
+holds one across awaits (an fd-held flock does not block the loop).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Hashable, Iterable, List, Optional, Set, Tuple
+
+from . import dataflow
+from .callgraph import CallGraph, FunctionInfo
+from .core import Finding, Project, Rule, dotted_name, in_package
+
+_SYNC_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+_ASYNC_LOCK_CTORS = {
+    "asyncio.Lock": "Lock",
+    "asyncio.Semaphore": "Semaphore",
+    "asyncio.BoundedSemaphore": "Semaphore",
+}
+
+
+class _Lock:
+    __slots__ = ("lid", "kind", "ctor")
+
+    def __init__(self, lid: str, kind: str, ctor: str) -> None:
+        self.lid = lid  # "rel::Class.attr" or "rel::NAME"
+        self.kind = kind  # "sync" | "async"
+        self.ctor = ctor  # "Lock" | "RLock" | "Condition" | "Semaphore"
+
+
+class _Span:
+    """One lock acquisition: a with-item and the lines it covers."""
+
+    __slots__ = (
+        "lock",
+        "is_async",
+        "line",
+        "item_idx",
+        "with_id",
+        "body_start",
+        "body_end",
+    )
+
+    def __init__(
+        self,
+        lock: _Lock,
+        is_async: bool,
+        line: int,
+        item_idx: int,
+        with_id: int,
+        body_start: int,
+        body_end: int,
+    ) -> None:
+        self.lock = lock
+        self.is_async = is_async
+        self.line = line
+        self.item_idx = item_idx
+        self.with_id = with_id
+        self.body_start = body_start
+        self.body_end = body_end
+
+    def holds(self, other: "_Span") -> bool:
+        """Whether ``other`` is acquired while this span is held: a
+        later item of the same ``with``, or anything inside the body."""
+        if self.with_id == other.with_id:
+            return other.item_idx > self.item_idx
+        return (
+            other.line > self.line
+            and self.body_start <= other.line <= self.body_end
+        )
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = (
+        "Awaiting while holding a sync lock, inconsistent pairwise lock "
+        "acquisition order across call chains, and re-acquiring a "
+        "non-reentrant lock through a callee are all deadlocks the "
+        "right interleaving makes real."
+    )
+
+    def applies_to(self, rel: str) -> bool:
+        return in_package(rel)
+
+    # -------------------------------------------------------- lock registry
+
+    def _ctor_of(self, value: ast.AST) -> Optional[Tuple[str, str]]:
+        if not isinstance(value, ast.Call):
+            return None
+        chain = dotted_name(value.func)
+        if chain is None:
+            return None
+        if chain in _SYNC_LOCK_CTORS:
+            return "sync", _SYNC_LOCK_CTORS[chain]
+        if chain in _ASYNC_LOCK_CTORS:
+            return "async", _ASYNC_LOCK_CTORS[chain]
+        return None
+
+    def _registry(self, graph: CallGraph) -> Dict[Tuple[str, Optional[str], str], _Lock]:
+        """(rel, class-or-None, attr/name) -> lock, from module-level
+        ``NAME = threading.Lock()`` and ``self.X = threading.Lock()``
+        assignments anywhere in a class's methods."""
+        registry: Dict[Tuple[str, Optional[str], str], _Lock] = {}
+        for info in graph.functions.values():
+            if info.class_name is None:
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                ctor = self._ctor_of(node.value)
+                if ctor is None:
+                    continue
+                for target in node.targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        key = (info.rel, info.class_name, target.attr)
+                        registry[key] = _Lock(
+                            f"{info.rel}::{info.class_name}.{target.attr}",
+                            ctor[0],
+                            ctor[1],
+                        )
+        return registry
+
+    def _module_locks(
+        self, project: Project, registry: Dict[Tuple[str, Optional[str], str], _Lock]
+    ) -> None:
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            for node in ast.iter_child_nodes(module.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                ctor = self._ctor_of(node.value)
+                if ctor is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        registry[(module.rel, None, target.id)] = _Lock(
+                            f"{module.rel}::{target.id}",
+                            ctor[0],
+                            ctor[1],
+                        )
+
+    def _resolve_lock(
+        self,
+        expr: ast.AST,
+        info: FunctionInfo,
+        graph: CallGraph,
+        registry: Dict[Tuple[str, Optional[str], str], _Lock],
+    ) -> Optional[_Lock]:
+        if isinstance(expr, ast.Name):
+            return registry.get((info.rel, None, expr.id))
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in ("self", "cls")
+            and info.class_name is not None
+        ):
+            hit = registry.get((info.rel, info.class_name, expr.attr))
+            if hit is not None:
+                return hit
+            # Inherited lock attr: search project-resolvable base classes.
+            for cinfo in graph.mro(info.rel, info.class_name):
+                hit = registry.get((cinfo.rel, cinfo.name, expr.attr))
+                if hit is not None:
+                    return hit
+        return None
+
+    # ----------------------------------------------------------- extraction
+
+    def _with_spans(
+        self,
+        info: FunctionInfo,
+        graph: CallGraph,
+        registry: Dict[Tuple[str, Optional[str], str], _Lock],
+    ) -> List["_Span"]:
+        """Every with-statement acquisition of a known lock in ``info``
+        (nested defs excluded).  Multiple items of one ``with A, B:``
+        are distinct spans sharing a with_id, ordered by item index —
+        the comma form acquires in order exactly like nesting does."""
+        spans: List[_Span] = []
+        stack: List[ast.AST] = list(ast.iter_child_nodes(info.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for idx, item in enumerate(node.items):
+                    lock = self._resolve_lock(
+                        item.context_expr, info, graph, registry
+                    )
+                    if lock is not None:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        spans.append(
+                            _Span(
+                                lock=lock,
+                                is_async=isinstance(node, ast.AsyncWith),
+                                line=node.lineno,
+                                item_idx=idx,
+                                with_id=id(node),
+                                body_start=(
+                                    node.body[0].lineno
+                                    if node.body
+                                    else node.lineno
+                                ),
+                                body_end=end or node.lineno,
+                            )
+                        )
+            stack.extend(ast.iter_child_nodes(node))
+        return spans
+
+    def _await_lines(self, info: FunctionInfo) -> Set[int]:
+        lines: Set[int] = set()
+        stack: List[ast.AST] = list(ast.iter_child_nodes(info.node))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Await):
+                lines.add(node.lineno)
+            stack.extend(ast.iter_child_nodes(node))
+        return lines
+
+    # ------------------------------------------------------------ the rule
+
+    def graph_check(
+        self, project: Project, graph: CallGraph
+    ) -> Iterable[Finding]:
+        registry = self._registry(graph)
+        self._module_locks(project, registry)
+        if not registry:
+            return
+
+        spans_by_fid = {
+            fid: self._with_spans(info, graph, registry)
+            for fid, info in graph.functions.items()
+        }
+
+        # Transitive acquisition summaries (which locks a call may take).
+        local: Dict[str, FrozenSet[Hashable]] = {}
+        for fid, spans in spans_by_fid.items():
+            if spans:
+                local[fid] = frozenset(s.lock.lid for s in spans)
+        acquires = dataflow.propagate(graph, local)
+        lock_by_id = {lock.lid: lock for lock in registry.values()}
+
+        # (A, B) -> first (rel, line, detail) where A was held while B
+        # was acquired (directly or via a callee).
+        order: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        for fid, info in graph.functions.items():
+            spans = spans_by_fid[fid]
+            # ---- hold-across-await -------------------------------------
+            if info.is_async:
+                awaits = self._await_lines(info)
+                for span in spans:
+                    if span.lock.kind != "sync" or span.is_async:
+                        continue
+                    hit = sorted(
+                        a
+                        for a in awaits
+                        if span.body_start <= a <= span.body_end
+                    )
+                    if hit:
+                        yield Finding(
+                            rule=self.name,
+                            path=info.rel,
+                            line=span.line,
+                            message=(
+                                f"`async def {info.qualname}` awaits "
+                                f"(line {hit[0]}) while holding sync "
+                                f"lock {span.lock.lid.split('::')[-1]}"
+                                ": the held lock blocks every other "
+                                "task on this loop (and the lock's "
+                                "other users) across the suspension — "
+                                "use an asyncio primitive or release "
+                                "before awaiting"
+                            ),
+                        )
+            # ---- ordered pairs ----------------------------------------
+            for outer in spans:
+                for inner in spans:
+                    if inner is outer or not outer.holds(inner):
+                        continue
+                    if inner.lock.lid != outer.lock.lid:
+                        order.setdefault(
+                            (outer.lock.lid, inner.lock.lid),
+                            (info.rel, inner.line, "acquired directly"),
+                        )
+                for site in graph.sites_of(fid):
+                    if not (
+                        outer.body_start <= site.line <= outer.body_end
+                    ):
+                        continue
+                    for target in site.targets:
+                        tinfo = graph.functions.get(target)
+                        if tinfo is None:
+                            continue
+                        for lid in dataflow.reaches(acquires, target):
+                            lid = str(lid)
+                            if lid == outer.lock.lid:
+                                if (
+                                    lock_by_id[
+                                        outer.lock.lid
+                                    ].ctor
+                                    == "Lock"
+                                ):
+                                    yield Finding(
+                                        rule=self.name,
+                                        path=info.rel,
+                                        line=site.line,
+                                        message=(
+                                            f"{info.qualname} calls "
+                                            f"{tinfo.qualname}() while "
+                                            "holding non-reentrant "
+                                            "lock "
+                                            f"{outer.lock.lid.split('::')[-1]}"
+                                            ", which the callee "
+                                            "(transitively) acquires "
+                                            "again — self-deadlock"
+                                        ),
+                                    )
+                                continue
+                            order.setdefault(
+                                (outer.lock.lid, lid),
+                                (
+                                    info.rel,
+                                    site.line,
+                                    f"via call to {tinfo.qualname}()",
+                                ),
+                            )
+
+        reported: Set[FrozenSet[str]] = set()
+        for (a, b), (rel, line, detail) in sorted(order.items()):
+            if (b, a) not in order:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            other_rel, other_line, other_detail = order[(b, a)]
+            a_name = a.split("::")[-1]
+            b_name = b.split("::")[-1]
+            yield Finding(
+                rule=self.name,
+                path=rel,
+                line=line,
+                message=(
+                    f"lock-order inversion: {a_name} -> {b_name} here "
+                    f"({detail}), but {b_name} -> {a_name} at "
+                    f"{other_rel}:{other_line} ({other_detail}) — two "
+                    "threads taking opposite orders deadlock; pick one "
+                    "global order"
+                ),
+            )

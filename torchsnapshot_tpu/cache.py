@@ -372,7 +372,7 @@ class CacheStore:
             # Cache entries are self-verifying (digest checked on read), so
             # a torn rename after a crash is detected and re-fetched — no
             # fsync needed on this hot path.
-            # tpusnap-lint: disable=durability-discipline
+            # tpusnap-lint: disable=durability-flow
             os.replace(tmp, data_path)
             meta = {
                 "key": key,
@@ -383,7 +383,7 @@ class CacheStore:
             with open(mtmp, "w", encoding="utf-8") as f:
                 f.write(json.dumps(meta))
             # Same self-verifying argument as the data file above.
-            # tpusnap-lint: disable=durability-discipline
+            # tpusnap-lint: disable=durability-flow
             os.replace(mtmp, meta_path)
         except OSError:
             logger.warning("cache populate failed for %s", key, exc_info=True)

@@ -304,7 +304,7 @@ def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
     with open(tmp, "w", encoding="utf-8") as f:
         json.dump(doc, f)
     try:
-        os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline
+        os.replace(tmp, path)  # tpusnap-lint: disable=durability-flow
     except OSError:
         try:
             os.unlink(tmp)

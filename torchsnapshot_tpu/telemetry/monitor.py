@@ -441,7 +441,7 @@ class OpMonitor:
             # Best-effort liveness beacon rewritten every tick; an fsync
             # per tick would cost real I/O to protect a file whose loss
             # means one missed probe interval.
-            os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline
+            os.replace(tmp, path)  # tpusnap-lint: disable=durability-flow
         except OSError:
             logger.debug("failed to write heartbeat %s", path, exc_info=True)
 

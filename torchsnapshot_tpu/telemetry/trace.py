@@ -186,7 +186,7 @@ class _TraceOp:
             # Best-effort diagnostics: a trace lost to a crash is the
             # least of that crash's problems; rename-atomicity alone keeps
             # concurrent readers off half-written JSON.
-            os.replace(tmp, path)  # tpusnap-lint: disable=durability-discipline
+            os.replace(tmp, path)  # tpusnap-lint: disable=durability-flow
             return path
         except OSError:
             logger.warning("failed to write trace file %s", path, exc_info=True)
