@@ -41,6 +41,7 @@ class FakeGCSServer:
         self.sessions: Dict[str, dict] = {}
         self.fail_put_chunks = 0  # fail the next N chunk PUTs
         self.fail_at_chunks = set()  # fail specific 1-based chunk PUT indices
+        self.fail_gets = 0  # fail the next N alt=media downloads with 503
         self.chunk_puts = 0
         self.copies = 0  # completed server-side copies (copyTo/rewriteTo)
         self.downloads = 0  # alt=media download requests served
@@ -249,6 +250,9 @@ class FakeGCSServer:
                 bucket = m.group(1)
                 name = urllib.parse.unquote(m.group(2))
                 with outer._lock:
+                    if outer.fail_gets > 0:
+                        outer.fail_gets -= 1
+                        return self._reply(503)
                     data = outer.objects.get(f"{bucket}/{name}")
                 if data is None:
                     return self._reply(404)

@@ -45,6 +45,9 @@ def test_parse_rules():
     assert parse_fault_spec("none") == []
     assert parse_fault_spec("") == []
     assert parse_fault_spec("any:*:terminal")[0].first == 1
+    crash = parse_fault_spec("write:3:crash@cas/*")[0]
+    assert crash.kind == "crash" and crash.first == 3
+    assert crash.path_glob == "cas/*"
 
 
 @pytest.mark.parametrize(
@@ -58,6 +61,7 @@ def test_parse_rules():
         "write:1:torn:1.5",  # fraction out of range
         "write:1:latency:-1",  # negative latency
         "write:1:transient:0:extra",  # too many fields
+        "write:1:crash:1",  # crash takes no param
     ],
 )
 def test_parse_rejects(bad):
@@ -105,6 +109,46 @@ def test_torn_write_persists_prefix():
     assert bytes(read_io.buf) == b"01234"  # short write really on storage
 
 
+def test_crash_kind_exits_the_process():
+    """``crash`` really is process death, not an exception: the faulted
+    call never returns and no teardown runs (fork a child to prove it)."""
+    import multiprocessing as mp
+    import os as _os
+
+    def victim(root):
+        plugin = FaultyStoragePlugin(
+            MemoryStoragePlugin(root), parse_fault_spec("write:2:crash")
+        )
+        plugin.sync_write(WriteIO(path="a", buf=b"1"))
+        plugin.sync_write(WriteIO(path="b", buf=b"2"))  # crash fires here
+        _os._exit(7)  # never reached
+
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=victim, args=("crashmem",))
+    p.start()
+    p.join(timeout=30)
+    assert p.exitcode == 1
+
+
+def test_write_counters_meter_backend_bytes():
+    """The write-side mirror of the origin read meter: bytes handed to the
+    wrapped backend, per path — a dedup/adoption hit (no write call) costs
+    zero, a torn write counts its persisted prefix only."""
+    import torchsnapshot_tpu.faults as faults_mod
+
+    faults_mod.reset_write_counters()
+    plugin = _mem("write:2:torn:0.5")
+    plugin.sync_write(WriteIO(path="a", buf=b"0123456789"))
+    with pytest.raises(InjectedTransientError):
+        plugin.sync_write(WriteIO(path="t", buf=b"0123456789"))
+    counters = faults_mod.write_counters()
+    assert counters["a"] == 10
+    assert counters["t"] == 5  # the persisted torn prefix
+    assert faults_mod.total_write_bytes() == 15
+    faults_mod.reset_write_counters()
+    assert faults_mod.total_write_bytes() == 0
+
+
 def test_latency_passes_through():
     plugin = _mem("read:1:latency:0.05")
     plugin.sync_write(WriteIO(path="a", buf=b"payload"))
@@ -139,6 +183,55 @@ def test_transient_write_fault_retried_take_commits(tmp_path, monkeypatch):
     dst = _state(0)
     snap.restore(dst)
     assert dst["m"]["step"] == 7
+
+
+def test_transient_read_fault_retried_restore_succeeds(tmp_path, monkeypatch):
+    """The read pipeline's bounded transient retry (the write path's
+    mirror, same TPUSNAP_IO_RETRIES budget): a restore through an injected
+    transient read fault succeeds, emits scheduler.read_retry, and counts
+    tpusnap_pipeline_retries_total{stage="read"}."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    snap = Snapshot.take(str(tmp_path / "snap"), _state(9))
+    metrics.reset()
+    events = []
+    from torchsnapshot_tpu.event_handlers import (
+        register_event_handler,
+        unregister_event_handler,
+    )
+
+    def capture(e):
+        if e.name == "scheduler.read_retry":
+            events.append(e)
+
+    register_event_handler(capture)
+    try:
+        with knobs.override_metrics(True), knobs.override_faults(
+            "read:1:transient@0/*"  # payload reads only, not the metadata GET
+        ), knobs.override_batching_disabled(True):
+            dst = _state(0)
+            Snapshot(str(tmp_path / "snap")).restore(dst)
+    finally:
+        unregister_event_handler(capture)
+    assert dst["m"]["step"] == 9
+    np.testing.assert_array_equal(dst["m"]["w"], np.full((256,), 9.0))
+    assert (
+        metrics.counter("tpusnap_pipeline_retries_total").get(stage="read")
+        >= 1
+    )
+    assert events and events[0].metadata["attempt"] == 1
+
+
+def test_read_retry_budget_zero_propagates(tmp_path, monkeypatch):
+    """TPUSNAP_IO_RETRIES=0 disables the read retry layer: the injected
+    transient fault aborts the restore — the pre-PR-14 behavior, proving
+    the new layer is what absorbs it."""
+    monkeypatch.setenv(knobs.RETRY_BASE_S_ENV_VAR, "0.001")
+    Snapshot.take(str(tmp_path / "snap"), _state(3))
+    with knobs.override_io_retries(0), knobs.override_faults(
+        "read:1:transient@0/*"
+    ), knobs.override_batching_disabled(True):
+        with pytest.raises(InjectedTransientError):
+            Snapshot(str(tmp_path / "snap")).restore(_state(0))
 
 
 def test_exhausted_retries_abort_cleanup_no_metadata(tmp_path, monkeypatch):

@@ -76,7 +76,11 @@ def test_terminal_fold_is_idempotent(tmp_path):
 
 
 def test_stale_entries_age_out(tmp_path):
-    """Entries older than the stale bound are skipped AND swept."""
+    """Stale-entry triage: a FINISHED op's stale entry is completion
+    debris (skipped + swept); an IN-FLIGHT op's stale entry is the last
+    sign of a worker that likely died mid-op — surfaced as a
+    ``suspected-dead`` row with its last-seen age, excluded from the live
+    set, and swept only past the longer horizon."""
     spool = tmp_path / "live"
     spool.mkdir()
     fresh = {
@@ -92,16 +96,94 @@ def test_stale_entries_age_out(tmp_path):
         "metrics": [],
         "cache": {},
     }
-    stale = dict(fresh, pid=2, publish_time=time.time() - 9999)
+    # Dead mid-op: stale but within the suspect window (60s > 30s bound).
+    suspect = dict(fresh, pid=2, publish_time=time.time() - 60)
+    # Finished then aged: completion debris, swept.
+    done_stale = dict(
+        fresh,
+        pid=3,
+        publish_time=time.time() - 60,
+        op={"done": True, "requests": {}, "bytes": {}},
+    )
+    # Dead long ago: past the sweep horizon (9999 > 30 * 10), reclaimed.
+    ancient = dict(fresh, pid=4, publish_time=time.time() - 9999)
     (spool / "h-1-take-rank0.fleet.json").write_text(json.dumps(fresh))
-    stale_path = spool / "h-2-take-rank0.fleet.json"
-    stale_path.write_text(json.dumps(stale))
+    (spool / "h-2-take-rank0.fleet.json").write_text(json.dumps(suspect))
+    done_path = spool / "h-3-take-rank0.fleet.json"
+    done_path.write_text(json.dumps(done_stale))
+    ancient_path = spool / "h-4-take-rank0.fleet.json"
+    ancient_path.write_text(json.dumps(ancient))
     (spool / "garbage.fleet.json").write_text("{torn")
     entries = fleet.collect(str(spool), stale_s=30.0)
-    assert [e["pid"] for e in entries] == [1]
-    assert not stale_path.exists()  # swept
+    assert sorted(e["pid"] for e in entries) == [1, 2]
+    assert not done_path.exists()  # completion debris swept
+    assert not ancient_path.exists()  # past the suspect horizon: swept
     # Unreadable entries are skipped, never fatal, and never swept.
     assert (spool / "garbage.fleet.json").exists()
+
+    view = fleet.aggregate(entries)
+    assert view["n_suspected_dead"] == 1
+    assert view["suspected_dead"][0]["worker"] == "h:2"
+    assert view["suspected_dead"][0]["last_seen_s"] >= 59
+    rows = {w["worker"]: w for w in view["workers"]}
+    assert rows["h:2"]["state"] == "suspected-dead"
+    # Suspected-dead workers never pollute the live set / stragglers.
+    assert view["n_live"] == 1
+    assert all(s["worker"] != "h:2" for s in view["stragglers"])
+    # The rendered table carries the death callout.
+    rendered = fleet.render(view, str(spool))
+    assert "SUSPECTED DEAD: h:2" in rendered
+    assert "suspected-dead" in rendered
+
+
+def test_peer_stale_event_emitted_once(tmp_path):
+    """One fleet.peer_stale event per death, not one per collect pass;
+    the tpusnap_fleet_stale_peers gauge tracks the current count."""
+    from torchsnapshot_tpu.event_handlers import (
+        register_event_handler,
+        unregister_event_handler,
+    )
+    from torchsnapshot_tpu.telemetry import metrics as tmetrics
+
+    spool = tmp_path / "live"
+    spool.mkdir()
+    suspect = {
+        "schema": 1,
+        "host": "h",
+        "pid": 9,
+        "rank": 1,
+        "kind": "async_take",
+        "op_id": OP,
+        "publish_time": time.time() - 60,
+        "op": {"done": False, "requests": {}, "bytes": {}},
+        "proc": {},
+        "metrics": [],
+        "cache": {},
+    }
+    (spool / "h-9-async_take-rank1.fleet.json").write_text(
+        json.dumps(suspect)
+    )
+    events = []
+
+    def capture(e):
+        if e.name == "fleet.peer_stale":
+            events.append(e)
+
+    register_event_handler(capture)
+    tmetrics.reset()
+    try:
+        with knobs.override_metrics(True):
+            fleet.collect(str(spool), stale_s=30.0)
+            fleet.collect(str(spool), stale_s=30.0)  # second pass: no dup
+    finally:
+        unregister_event_handler(capture)
+    assert len(events) == 1, [e.metadata for e in events]
+    assert events[0].metadata["worker"] == "h:9"
+    assert events[0].metadata["kind"] == "async_take"
+    assert events[0].metadata["last_seen_s"] >= 59
+    assert (
+        tmetrics.gauge("tpusnap_fleet_stale_peers").get() == 1.0
+    )
 
 
 def test_aggregate_counts_process_totals_once(tmp_path):

@@ -1,0 +1,569 @@
+"""Process-kill chaos: a rank dying mid-take must abort peers fast and
+leave a resumable, GC-able state.
+
+The storage-fault chaos harness (test_chaos.py) injects failing RPCs; this
+one injects *process death* — the dominant real-fleet failure (preemption
+SIGKILL, OOM kill, vanished host) — via the ``crash`` fault kind
+(``op:when:crash`` → ``os._exit(1)`` at the faulted call, same seeded
+deterministic machinery as transient/torn).  Survivor invariants:
+
+- **fast symmetric abort** — peers blocked in barriers/collectives raise
+  ``StorePeerError`` in ~``TPUSNAP_LEASE_GRACE_S`` seconds (the dead
+  rank's liveness lease expires), NOT after ``TPUSNAP_BARRIER_TIMEOUT_S``;
+- **GC-able debris** — no commit marker, every CAS chunk classifiable;
+- **resumable retry** — the dead attempt's durable chunks are adopted by
+  the retried take (CAS read-verify-and-adopt), so the retry writes only
+  the missing bytes (metered by the fault wrapper's write counters);
+- **restore_latest lands good** — bit-identical bytes after the retry.
+"""
+
+import multiprocessing as mp
+import os
+import pickle
+import tempfile
+import time
+import traceback
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import knobs
+from torchsnapshot_tpu.snapshot import SNAPSHOT_METADATA_FNAME
+
+CHUNK_ELEMS = 16384  # 64 KiB float32 per array
+N_ARRAYS = {0: 8, 1: 6}  # rank 0 owns more bytes, so a rank-1 kill can
+# never force the retry to rewrite >= 50% of the snapshot
+
+
+def _rank_state(rank):
+    from torchsnapshot_tpu import StateDict
+
+    rng = np.random.RandomState(1000 + rank)
+    return {
+        "m": StateDict(
+            {
+                f"r{rank}_w{i}": rng.rand(CHUNK_ELEMS).astype(np.float32)
+                for i in range(N_ARRAYS[rank])
+            }
+        )
+    }
+
+
+def _logical_total_bytes() -> int:
+    return sum(n * CHUNK_ELEMS * 4 for n in N_ARRAYS.values())
+
+
+def _child_entry(body, rank, world, store_path, env, conn):
+    # Launcher-side exports for this forked child (the bootstrap contract
+    # make_test_pg reads back through knobs) — the one pattern knob
+    # discipline permits outside knobs.py, under explicit suppression.
+    os.environ.pop(knobs.STORE_ADDR_ENV_VAR, None)  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.STORE_PATH_ENV_VAR] = store_path  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.RANK_ENV_VAR] = str(rank)  # tpusnap-lint: disable=knob-discipline
+    os.environ[knobs.WORLD_SIZE_ENV_VAR] = str(world)  # tpusnap-lint: disable=knob-discipline
+    os.environ.update(env)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        conn.send(("ok", body(rank)))
+    except BaseException as e:  # noqa: BLE001
+        conn.send(("err", f"{type(e).__name__}: {e}\n{traceback.format_exc()}"))
+
+
+def _launch(nproc, body, env_common=None, env_per_rank=None, timeout=120):
+    """Run ``body(rank)`` in ``nproc`` forked processes over a fresh
+    FileStore.  Returns ``[(exitcode, payload_or_None), ...]`` by rank —
+    a crashed child (no payload) reports its raw exit code."""
+    ctx = mp.get_context("fork")
+    results = []
+    with tempfile.TemporaryDirectory() as store_path:
+        procs, conns = [], []
+        for rank in range(nproc):
+            env = dict(env_common or {})
+            env.update((env_per_rank or {}).get(rank, {}))
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=_child_entry,
+                args=(body, rank, nproc, store_path, env, child_conn),
+            )
+            p.start()
+            # Close the parent's copy of the write end NOW: otherwise a
+            # crashed child's pipe never reads EOF (and later-forked
+            # children inherit earlier ranks' write ends, muddying it
+            # further).
+            child_conn.close()
+            procs.append(p)
+            conns.append(parent_conn)
+        for rank, (p, conn) in enumerate(zip(procs, conns)):
+            p.join(timeout=timeout)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+                results.append(("timeout", None))
+                continue
+            try:
+                payload_ready = conn.poll()
+            except OSError:
+                payload_ready = False
+            if payload_ready:
+                try:
+                    status, payload = conn.recv()
+                except EOFError:
+                    # Died (os._exit) without sending: raw exit code.
+                    status, payload = p.exitcode, None
+                results.append((status, payload))
+            else:
+                results.append((p.exitcode, None))
+    return results
+
+
+def _take_body_factory(root, async_=False, restore_after=False):
+    """A take (optionally async) of this rank's state; returns timing and
+    fault-wrapper write-meter readings for the parent to assert on."""
+
+    def body(rank):
+        from torchsnapshot_tpu import Snapshot, faults
+        from torchsnapshot_tpu.test_utils import make_test_pg
+
+        pg = make_test_pg()
+        path = os.path.join(root, "step_1")
+        app = _rank_state(rank)
+        faults.reset_write_counters()
+        begin = time.monotonic()
+        outcome = {"rank": rank}
+        try:
+            if async_:
+                Snapshot.async_take(path, app, pg=pg).wait()
+            else:
+                Snapshot.take(path, app, pg=pg)
+            outcome["committed"] = True
+        except Exception as e:  # noqa: BLE001
+            outcome["committed"] = False
+            outcome["error"] = type(e).__name__
+            outcome["error_str"] = str(e)[:200]
+        outcome["wall_s"] = time.monotonic() - begin
+        outcome["write_bytes"] = faults.total_write_bytes()
+        if restore_after and outcome["committed"]:
+            dst = {
+                k: type(v)({kk: np.zeros_like(vv) for kk, vv in v.items()})
+                for k, v in _rank_state(rank).items()
+            }
+            Snapshot(path, pg=pg).restore(dst)
+            src = _rank_state(rank)
+            outcome["restore_ok"] = all(
+                dst["m"][k].tobytes() == src["m"][k].tobytes()
+                for k in src["m"].keys()
+            )
+        pickle.dumps(outcome)  # fail loudly here, not in the Pipe
+        return outcome
+
+    return body
+
+
+_FAST_ENV = {
+    "TPUSNAP_CAS": "1",
+    "TPUSNAP_SIDECAR": "0",
+    "TPUSNAP_DISABLE_BATCHER": "1",
+    "TPUSNAP_BARRIER_TIMEOUT_S": "120",
+    "TPUSNAP_LEASE_INTERVAL_S": "0.25",
+    "TPUSNAP_LEASE_GRACE_S": "2.0",
+    "TPUSNAP_RETRY_BASE_S": "0.001",
+}
+
+
+def _native_or_skip():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("CAS digests require the native library")
+
+
+def test_sigkill_mid_take_fast(tmp_path):
+    """Tier-1 kill-chaos variant: rank 1 dies (``crash`` fault = SIGKILL
+    semantics) at its 5th chunk write mid 2-rank CAS take.
+
+    Regression-style timing assertion (like the PR 13 deadlock tests): the
+    survivor must raise a symmetric ``StorePeerError`` well before the
+    barrier timeout — wall < timeout/4 — because the dead rank's liveness
+    lease expires.  Pre-lease behavior: the survivor parked the full
+    ``TPUSNAP_BARRIER_TIMEOUT_S`` (120 s here) in its collective wait.
+    Then the retried take adopts the dead attempt's durable chunks and
+    writes < 50% of the snapshot's bytes, and restore lands bit-identical.
+    """
+    _native_or_skip()
+    root = str(tmp_path / "ckpts")
+    os.makedirs(root)
+
+    # --- attempt 1: rank 1 is killed at its 5th chunk write -------------
+    results = _launch(
+        2,
+        _take_body_factory(root),
+        env_common=_FAST_ENV,
+        env_per_rank={1: {"TPUSNAP_FAULTS": "write:5:crash"}},
+    )
+    status0, survivor = results[0]
+    assert status0 == "ok", results
+    assert results[1] == (1, None), results  # victim died via os._exit(1)
+    assert survivor["committed"] is False, survivor
+    assert survivor["error"] == "StorePeerError", survivor
+    assert "presumed dead" in survivor["error_str"], survivor
+    # THE acceptance bound: fast abort, not a barrier-timeout ride-out.
+    timeout_s = float(_FAST_ENV["TPUSNAP_BARRIER_TIMEOUT_S"])
+    assert survivor["wall_s"] < timeout_s / 4, survivor
+
+    # --- debris: no commit marker; every CAS chunk classifiable ---------
+    from torchsnapshot_tpu.manager import SnapshotManager
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    import torchsnapshot_tpu.cas as cas_mod
+
+    assert not os.path.exists(
+        os.path.join(root, "step_1", SNAPSHOT_METADATA_FNAME)
+    )
+    mgr = SnapshotManager(root)
+    assert mgr.orphan_steps() in ([], [1])
+    referenced, orphan = mgr.chunk_classification()
+    assert referenced == []  # nothing committed
+    storage = url_to_storage_plugin(root)
+    try:
+        present = cas_mod.list_chunk_relpaths(storage)
+    finally:
+        storage.sync_close()
+    assert sorted(orphan) == present
+    assert present, "the dead attempt should have left durable chunks"
+
+    # --- retry: adopt durable chunks, write only the missing bytes ------
+    results = _launch(
+        2,
+        _take_body_factory(root, restore_after=True),
+        env_common=dict(_FAST_ENV, TPUSNAP_FAULTS="none"),  # pure meter
+    )
+    for status, payload in results:
+        assert status == "ok", results
+        assert payload["committed"] is True, payload
+        assert payload["restore_ok"] is True, payload
+    retry_written = sum(p["write_bytes"] for _, p in results)
+    logical = _logical_total_bytes()
+    assert retry_written < 0.5 * logical, (
+        f"retry rewrote {retry_written}/{logical} bytes — the dead "
+        "attempt's durable chunks were not adopted"
+    )
+
+    # --- aftermath: GC clears debris, restore_latest lands good ---------
+    assert mgr.all_steps() == [1]
+    mgr.gc(apply=True, force=True)
+    assert mgr.orphan_steps() == []
+    assert mgr.orphan_chunks() == []
+    dst = {
+        k: type(v)({kk: np.zeros_like(vv) for kk, vv in v.items()})
+        for k, v in _rank_state(0).items()
+    }
+    assert mgr.restore_latest(dst) == 1
+    src = _rank_state(0)
+    for k in src["m"].keys():
+        assert dst["m"][k].tobytes() == src["m"][k].tobytes()
+
+
+# -------------------------------------------------------------------- soak
+
+
+_SOAK_ENV = dict(
+    _FAST_ENV,
+    TPUSNAP_BARRIER_TIMEOUT_S="60",
+    TPUSNAP_LEASE_INTERVAL_S="0.25",
+    TPUSNAP_LEASE_GRACE_S="1.5",
+)
+
+# Kill points spanning the take lifecycle: (victim rank, fault spec,
+# async_).  Stage/write kills hit the chunk stream; the commit-barrier
+# kills hit rank 0 at the metadata write (peers parked in the post-commit
+# barrier) and rank 1 at its async manifest sidecar (rank 0 parked in the
+# commit barrier's arrive).
+def _kill_menu(seed: int):
+    import random
+
+    rng = random.Random(seed)
+    menu = [
+        (1, "write:1:crash", False),  # stage: first chunk write
+        (1, f"write:{rng.randrange(2, 6)}:crash", False),  # mid-write
+        (0, f"write:1:crash@{SNAPSHOT_METADATA_FNAME}", False),  # commit
+        (1, "write:1:crash@.manifest_rank*", True),  # commit-barrier, async
+        (0, f"write:{rng.randrange(2, 8)}:crash", rng.random() < 0.5),
+    ]
+    rng.shuffle(menu)
+    return menu
+
+
+@pytest.mark.slow
+def test_sigkill_chaos_soak(tmp_path):
+    """Multi-seed process-death soak: >= 3 seeds x kill points spanning
+    stage/write/commit-barrier.  After every kill: fast symmetric abort on
+    the survivor, marker iff success, debris GC-able, every CAS chunk
+    classifiable; the clean retry commits and restore_latest lands good."""
+    _native_or_skip()
+    from torchsnapshot_tpu.manager import SnapshotManager
+    from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+    import torchsnapshot_tpu.cas as cas_mod
+
+    for seed in range(3):
+        root = str(tmp_path / f"ckpts_{seed}")
+        os.makedirs(root)
+        mgr = SnapshotManager(root)
+        for victim, spec, async_ in _kill_menu(seed):
+            # Fresh step dir per scenario so debris never aliases.
+            results = _launch(
+                2,
+                _take_body_factory(root, async_=async_),
+                env_common=_SOAK_ENV,
+                env_per_rank={victim: {"TPUSNAP_FAULTS": spec}},
+            )
+            survivor_rank = 1 - victim
+            status_s, survivor = results[survivor_rank]
+            assert status_s == "ok", (seed, spec, results)
+            assert results[victim] == (1, None), (seed, spec, results)
+            assert survivor["committed"] is False, (seed, spec, survivor)
+            # Fast symmetric abort: StorePeerError (lease expiry, or a
+            # peer's report_error fan-out) well under the barrier timeout.
+            assert survivor["error"] in ("StorePeerError",), (
+                seed,
+                spec,
+                survivor,
+            )
+            assert survivor["wall_s"] < 60 / 2, (seed, spec, survivor)
+            # Marker iff success — the take failed, so no marker.
+            assert not os.path.exists(
+                os.path.join(root, "step_1", SNAPSHOT_METADATA_FNAME)
+            ), (seed, spec)
+            # Debris: at most this step's own orphan dir; every chunk
+            # classifiable.
+            assert mgr.orphan_steps() in ([], [1]), (seed, spec)
+            referenced, orphan = mgr.chunk_classification()
+            storage = url_to_storage_plugin(root)
+            try:
+                present = cas_mod.list_chunk_relpaths(storage)
+            finally:
+                storage.sync_close()
+            assert sorted(referenced + orphan) == present, (seed, spec)
+
+            # Clean retry: commits, adopts, restores bit-identical.
+            results = _launch(
+                2,
+                _take_body_factory(root, restore_after=True),
+                env_common=dict(_SOAK_ENV, TPUSNAP_FAULTS="none"),
+            )
+            for status, payload in results:
+                assert status == "ok", (seed, spec, results)
+                assert payload["committed"] is True, (seed, spec, payload)
+                assert payload["restore_ok"] is True, (seed, spec, payload)
+            # Reset for the next scenario: gc the debris and drop the step.
+            mgr.gc(apply=True, force=True)
+            referenced, orphan = mgr.chunk_classification()
+            assert orphan == [], (seed, spec)
+            dst = {
+                k: type(v)({kk: np.zeros_like(vv) for kk, vv in v.items()})
+                for k, v in _rank_state(0).items()
+            }
+            assert mgr.restore_latest(dst) == 1, (seed, spec)
+            src = _rank_state(0)
+            for kk in src["m"].keys():
+                assert dst["m"][kk].tobytes() == src["m"][kk].tobytes(), (
+                    seed,
+                    spec,
+                )
+            # Remove the committed step so the next scenario's attempt 1
+            # starts from an empty root (kill points stay calibrated).
+            import shutil
+
+            shutil.rmtree(os.path.join(root, "step_1"))
+            shutil.rmtree(os.path.join(root, "cas"), ignore_errors=True)
+
+
+# ------------------------------------------------- lease unit-level checks
+
+
+def test_dead_peer_lease_aborts_barrier_fast(tmp_path):
+    """A peer whose op lease goes stale mid-wait (a fresh stamp that
+    simply stops refreshing — the kill -9 signature) surfaces as a fast
+    StorePeerError on the waiter AND (via report_error) on every other
+    barrier participant — the symmetric abort, unit-level."""
+    from torchsnapshot_tpu.dist_store import (
+        OP_LEASE_PREFIX,
+        FileStore,
+        LinearBarrier,
+        StorePeerError,
+    )
+
+    store = FileStore(str(tmp_path))
+    # The victim's LAST refresh: fresh now, never refreshed again.  (A
+    # long-expired stamp planted from nowhere would be filtered as a
+    # previous incarnation's debris — the epoch floor.)
+    store.set(f"{OP_LEASE_PREFIX}/1", repr(time.time()).encode())
+    b0 = LinearBarrier(prefix="t", store=store, rank=0, world_size=2)
+    with knobs.override_lease_interval_s(0.1), knobs.override_lease_grace_s(
+        0.5
+    ):
+        begin = time.monotonic()
+        with pytest.raises(StorePeerError, match="presumed dead"):
+            b0.arrive(timeout_s=60)
+        assert time.monotonic() - begin < 10.0
+        # report_error fan-out: a late peer checking the barrier sees the
+        # SAME error instead of hanging.
+        b1 = LinearBarrier(prefix="t", store=store, rank=1, world_size=2)
+        with pytest.raises(StorePeerError, match="presumed dead"):
+            b1.depart(timeout_s=5)
+
+
+def test_missing_lease_still_times_out(tmp_path):
+    """No lease = no information: a peer that never established a lease
+    (died before its first refresh, or never entered an op) must surface
+    as the plain TimeoutError, never as a false presumed-dead."""
+    from torchsnapshot_tpu.dist_store import FileStore
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    pg = PGWrapper(
+        store=FileStore(str(tmp_path)), rank=0, world_size=2, timeout_s=1.0
+    )
+    with knobs.override_lease_interval_s(0.1), knobs.override_lease_grace_s(
+        0.2
+    ):
+        with pytest.raises(TimeoutError):
+            pg.barrier()
+
+
+def test_fresh_lease_keeps_barrier_waiting(tmp_path):
+    """A live peer (fresh lease) must NOT be presumed dead: the waiter
+    rides to its timeout as before."""
+    from torchsnapshot_tpu.dist_store import OP_LEASE_PREFIX, FileStore
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    store = FileStore(str(tmp_path))
+    store.set(f"{OP_LEASE_PREFIX}/1", repr(time.time()).encode())
+    pg = PGWrapper(store=store, rank=0, world_size=2, timeout_s=1.5)
+    with knobs.override_lease_grace_s(10.0):
+        begin = time.monotonic()
+        with pytest.raises(TimeoutError):
+            pg.barrier()
+        assert time.monotonic() - begin >= 1.4
+
+
+def test_previous_incarnation_debris_does_not_abort(tmp_path):
+    """A rank killed in an EARLIER attempt leaves a decaying lease stamp
+    on the job-scoped store.  A restarted job's waiter (which holds its
+    own fresh lease) must discount stamps older than its own op start:
+    the restarting peer gets the normal grace window to establish its
+    lease instead of being declared dead on its predecessor's corpse.
+    The pre-fix behavior was an instant false StorePeerError."""
+    from torchsnapshot_tpu import dist_store as ds
+
+    store = ds.FileStore(str(tmp_path))
+    # Debris: the dead previous incarnation's stamp, long expired.
+    store.set(f"{ds.OP_LEASE_PREFIX}/1", repr(time.time() - 300.0).encode())
+    with knobs.override_lease_grace_s(0.5), knobs.override_lease_interval_s(
+        0.1
+    ):
+        lease = ds.acquire_op_lease(store, rank=0)  # our NEW op's epoch
+        try:
+            from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+            pg = PGWrapper(store=store, rank=0, world_size=2, timeout_s=1.5)
+            begin = time.monotonic()
+            with pytest.raises(TimeoutError):  # NOT StorePeerError
+                pg.barrier()
+            assert time.monotonic() - begin >= 1.4  # rode to the timeout
+        finally:
+            ds.release_op_lease(lease)
+
+
+def test_release_tombstone_yields_to_successor_lease(tmp_path):
+    """Back-to-back ops: the old lease's clean-exit tombstone must never
+    overwrite a successor lease's fresh stamp (a kill inside that window
+    would read as a clean exit and peers would ride out the timeout)."""
+    from torchsnapshot_tpu import dist_store as ds
+
+    store = ds.FileStore(str(tmp_path))
+    with knobs.override_lease_interval_s(0.05), knobs.override_lease_grace_s(
+        5.0
+    ):
+        old = ds.acquire_op_lease(store, rank=2)
+        ds.release_op_lease(old)  # no successor: tombstone lands
+        assert store.try_get("oplease/2") == b"done"
+
+        old = ds.acquire_op_lease(store, rank=2)
+        # Successor acquired BEFORE the old lease finishes releasing:
+        # simulate the interleave by evicting the old lease from the
+        # registry so the next acquire builds a fresh one — the old
+        # release must then skip both the registry pop (identity guard)
+        # and the tombstone.
+        ds._OP_LEASES.pop(id(store), None)
+        new = ds.acquire_op_lease(store, rank=2)
+        assert new is not old
+        ds.release_op_lease(old)
+        raw = store.try_get("oplease/2")
+        assert raw != b"done"  # successor's stamp survived
+        assert float(raw) > 0
+        ds.release_op_lease(new)
+        assert store.try_get("oplease/2") == b"done"
+
+
+def test_op_lease_lifecycle(tmp_path):
+    """acquire/release refcounting: one refresh thread per store, stamps
+    refresh while held, tombstone on the last release."""
+    from torchsnapshot_tpu import dist_store as ds
+
+    store = ds.FileStore(str(tmp_path))
+    with knobs.override_lease_interval_s(0.05), knobs.override_lease_grace_s(
+        5.0
+    ):
+        lease = ds.acquire_op_lease(store, rank=3)
+        assert lease is not None
+        again = ds.acquire_op_lease(store, rank=3)
+        assert again is lease  # shared, refcounted
+        stamp1 = float(store.try_get("oplease/3"))
+        time.sleep(0.15)
+        stamp2 = float(store.try_get("oplease/3"))
+        assert stamp2 > stamp1  # refreshing
+        ds.release_op_lease(again)
+        time.sleep(0.15)
+        assert float(store.try_get("oplease/3")) > stamp2  # still held
+        ds.release_op_lease(lease)
+        assert store.try_get("oplease/3") == b"done"  # clean-exit tombstone
+
+    # Grace 0 disables the whole mechanism: no lease, no thread.
+    with knobs.override_lease_grace_s(0):
+        assert ds.acquire_op_lease(store, rank=0) is None
+
+
+def test_lease_grace_clamped_above_interval():
+    """A grace below the refresh interval would declare every healthy
+    peer dead between its own refreshes — the knob clamps to 2x the
+    interval instead."""
+    with knobs.override_lease_interval_s(2.0), knobs.override_lease_grace_s(
+        1.0
+    ):
+        assert knobs.get_lease_grace_s() == 4.0
+    with knobs.override_lease_interval_s(0.1), knobs.override_lease_grace_s(
+        1.0
+    ):
+        assert knobs.get_lease_grace_s() == 1.0
+    with knobs.override_lease_grace_s(0):
+        assert knobs.get_lease_grace_s() == 0.0
+
+
+def test_process_epoch_floor_for_leaseless_waiters(tmp_path):
+    """A waiter holding NO lease (pre-take manager collectives) still
+    discounts stamps predating this process — a restarted job's very
+    first collective must not abort on the previous incarnation's
+    debris."""
+    from torchsnapshot_tpu import dist_store as ds
+    from torchsnapshot_tpu.pg_wrapper import PGWrapper
+
+    store = ds.FileStore(str(tmp_path))
+    # Debris from "before this process": older than the module epoch.
+    store.set(
+        f"{ds.OP_LEASE_PREFIX}/1",
+        repr(ds._PROCESS_EPOCH - 600.0).encode(),
+    )
+    pg = PGWrapper(store=store, rank=0, world_size=2, timeout_s=1.0)
+    with knobs.override_lease_interval_s(0.05), knobs.override_lease_grace_s(
+        0.2
+    ):
+        with pytest.raises(TimeoutError):  # NOT StorePeerError
+            pg.barrier()

@@ -1,0 +1,195 @@
+"""SIGTERM emergency flush: deadline mode commits the in-flight snapshot
+inside the preemption grace window.
+
+Covers preemption.py — deadline-state mechanics (compression dropped,
+sidecars shed, io concurrency boosted in place on a mid-drain pipeline),
+the installed SIGTERM handler, the ``preemption.flush`` event bracket, and
+the end-to-end acceptance: an ``async_take`` interrupted by SIGTERM
+commits a bit-identical-restorable snapshot within the
+``TPUSNAP_SAVE_DEADLINE_S`` budget, where the same workload at normal
+settings would miss it.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, knobs, preemption
+from torchsnapshot_tpu.event_handlers import (
+    register_event_handler,
+    unregister_event_handler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_deadline_mode():
+    yield
+    preemption.deactivate()
+
+
+def test_deadline_mode_drops_compression_and_sheds_sidecar():
+    """Deadline mode frames payloads raw regardless of the configured
+    codec (the self-describing frame keeps readers correct) and disables
+    sidecar writes; deactivate restores both."""
+    from torchsnapshot_tpu import compression
+    from torchsnapshot_tpu.telemetry import sidecar as tsidecar
+
+    data = bytes(range(256)) * 64  # compressible
+    with knobs.override_compression("zlib"):
+        frame, codec = compression.encode(data, "zlib")
+        assert codec == "zlib"
+        assert tsidecar.enabled()
+        preemption.activate(budget_s=60.0, reason="test")
+        frame, codec = compression.encode(data, "zlib")
+        assert codec == "raw"
+        # The raw frame still round-trips.
+        assert bytes(compression.decode(frame)) == data
+        assert not tsidecar.enabled()
+        preemption.deactivate()
+        assert tsidecar.enabled()
+
+
+def test_effective_io_cap_boost():
+    assert preemption.effective_io_cap(16) == 16
+    preemption.activate(budget_s=60.0, reason="test")
+    assert preemption.effective_io_cap(16) == 64
+    assert preemption.effective_io_cap(1) == 4
+    assert preemption.effective_io_cap(32) == preemption.IO_BOOST_MAX
+    preemption.deactivate()
+    assert preemption.effective_io_cap(16) == 16
+
+
+def test_install_handler_uninstall_roundtrip():
+    """The handler installs over (and restores) the previous disposition;
+    activation is idempotent."""
+    prev = signal.getsignal(signal.SIGTERM)
+    handler = Snapshot.install_preemption_handler()
+    try:
+        assert signal.getsignal(signal.SIGTERM) is not prev
+        assert preemption.activate(budget_s=60.0, reason="test")
+        assert not preemption.activate(budget_s=60.0)  # already active
+    finally:
+        handler.uninstall()
+        preemption.deactivate()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def _state(n_arrays=8, elems=4096):
+    rng = np.random.RandomState(7)
+    return {
+        "m": StateDict(
+            {
+                f"w{i}": rng.rand(elems).astype(np.float32)
+                for i in range(n_arrays)
+            }
+        )
+    }
+
+
+_LATENCY_S = 0.3
+_N_ARRAYS = 8
+_BUDGET_S = 2.0
+
+
+def _timed_async_take(path):
+    """async_take with every write paying an injected latency behind ONE
+    io slot; returns (pending, drain_wall_fn) where the fn waits and
+    times the post-return drain+commit."""
+    pending = Snapshot.async_take(path, _state(_N_ARRAYS))
+
+    def drain():
+        begin = time.monotonic()
+        pending.wait()
+        return time.monotonic() - begin
+
+    return pending, drain
+
+
+def test_sigterm_emergency_flush_commits_within_deadline(tmp_path):
+    """The acceptance scenario: 8 writes x 0.3 s injected latency behind
+    ONE io slot serialize to ~2.4 s + commit at normal settings — past the
+    2.0 s deadline budget.  SIGTERM mid-async_take activates deadline
+    mode, the in-flight pipeline's io semaphore widens in place (4x), and
+    the flush lands the commit inside the budget; the committed snapshot
+    restores bit-identical.  ``preemption.flush`` begin/end events bracket
+    it."""
+    events = []
+
+    def _capture(event):
+        if event.name.startswith("preemption.flush"):
+            events.append(event)
+
+    register_event_handler(_capture)
+    handler = Snapshot.install_preemption_handler()
+    try:
+        with knobs.override_max_per_rank_io_concurrency(
+            1
+        ), knobs.override_batching_disabled(True), knobs.override_faults(
+            f"write:1+:latency:{_LATENCY_S}@0/*"
+        ), knobs.override_sidecar(False), knobs.override_save_deadline_s(
+            _BUDGET_S
+        ):
+            # --- control: normal settings miss the deadline -------------
+            _, drain = _timed_async_take(str(tmp_path / "control"))
+            control_wall = drain()
+            assert control_wall > _BUDGET_S, (
+                f"control drained in {control_wall:.2f}s — the workload "
+                "must be slow enough at normal settings to miss the "
+                f"{_BUDGET_S}s budget for this test to mean anything"
+            )
+
+            # --- flush: SIGTERM mid-take beats the budget ---------------
+            pending, drain = _timed_async_take(str(tmp_path / "flush"))
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert preemption.deadline_active()
+            flush_wall = drain()
+            assert flush_wall < _BUDGET_S, (
+                f"emergency flush took {flush_wall:.2f}s — budget "
+                f"{_BUDGET_S}s, control {control_wall:.2f}s"
+            )
+            assert flush_wall < control_wall
+
+            # Bit-identical restore of the flushed snapshot.
+            src = _state(_N_ARRAYS)
+            dst = {
+                "m": StateDict(
+                    {k: np.zeros_like(v) for k, v in src["m"].items()}
+                )
+            }
+            with knobs.override_faults(None):
+                Snapshot(str(tmp_path / "flush")).restore(dst)
+            for k, v in src["m"].items():
+                assert dst["m"][k].tobytes() == v.tobytes()
+
+        # Event bracket: begin at activation, end once the in-flight save
+        # reached a terminal state, is_success because it beat the budget.
+        # Filter on the SIGTERM activation's reason — the global event
+        # stream can carry brackets from other activations in the process.
+        def _sig(evs):
+            return [
+                e
+                for e in evs
+                if str(e.metadata.get("reason", "")).startswith("signal")
+            ]
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(
+                e.name == "preemption.flush.end" for e in _sig(events)
+            ):
+                break
+            time.sleep(0.05)
+        names = [e.name for e in _sig(events)]
+        assert "preemption.flush.start" in names, names
+        assert "preemption.flush.end" in names, names
+        end = next(
+            e for e in _sig(events) if e.name == "preemption.flush.end"
+        )
+        assert end.metadata["is_success"] is True, end.metadata
+        assert end.metadata["duration_s"] <= _BUDGET_S, end.metadata
+    finally:
+        handler.uninstall()
+        unregister_event_handler(_capture)

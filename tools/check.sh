@@ -52,6 +52,16 @@ step "tier-1 pytest (-m 'not slow')"
 timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider || fail=1
 
+# Kill-chaos smoke: a rank SIGKILLed mid 2-rank take must abort the
+# survivor fast (StorePeerError via lease expiry, wall << barrier
+# timeout) and the retry must adopt the dead attempt's durable chunks.
+# Also part of tier-1 above; its own gate line so a process-death
+# regression is visible by name.
+step "kill-chaos smoke (2-rank SIGKILL mid-take, fast variant)"
+timeout -k 10 300 python -m pytest \
+  tests/test_kill_chaos.py::test_sigkill_mid_take_fast -q \
+  -p no:cacheprovider || fail=1
+
 # Serve smoke: 2 concurrent restore processes through one shared host
 # chunk cache (the fleet-serving read tier) — origin traffic must be
 # ~one snapshot.  Also part of tier-1 above; called out here so a serving

@@ -481,6 +481,12 @@ class CASWriterPlugin(StoragePlugin):
         self.bytes_saved = 0  # logical bytes deduplicated (not written)
         self.chunks_written = 0
         self.bytes_written = 0  # physical chunk bytes written
+        # Resume accounting: dedup hits against chunks NOT in the index —
+        # read-verified orphans of a dead/aborted earlier attempt (or a
+        # concurrent writer) adopted instead of rewritten.  The retried
+        # take's "bytes the crash did not cost us" number.
+        self.adopted_chunks = 0
+        self.adopted_bytes = 0
         self._closed = False
 
     def _get_executor(self):
@@ -551,7 +557,14 @@ class CASWriterPlugin(StoragePlugin):
             self._record_hit(write_io.path, algo, hexdigest, nbytes)
             return
         if await self._probe_existing(relpath, digest, executor):
+            # Resumable take: the chunk exists but no committed manifest
+            # blessed it — a dead attempt's durable debris, content-verified
+            # by the probe and adopted.  The retry pays one read, not one
+            # write.
             self._index.add(key)
+            with self._lock:
+                self.adopted_chunks += 1
+                self.adopted_bytes += nbytes
             self._record_hit(write_io.path, algo, hexdigest, nbytes)
             return
         try:
@@ -639,6 +652,8 @@ class CASWriterPlugin(StoragePlugin):
                 "chunks_written": self.chunks_written,
                 "physical_bytes_written": physical,
                 "logical_bytes": physical + saved,
+                "adopted_chunks": self.adopted_chunks,
+                "adopted_bytes": self.adopted_bytes,
             }
 
     # ------------------------------------------------------------ plugin API

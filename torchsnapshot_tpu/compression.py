@@ -397,9 +397,15 @@ def encode(buf, codec_name: str, level: Optional[int] = None) -> Tuple[Any, str]
     """
     from . import phase_stats
 
+    from . import preemption
+
     mv = memoryview(buf).cast("B")
     usize = mv.nbytes
-    codec = get_codec(codec_name)
+    # Emergency-flush deadline mode (preemption.py): frame raw regardless
+    # of the configured codec — the grace window buys durability, not
+    # ratio, and the self-describing frame header means readers never
+    # consult the plan-time codec choice.
+    codec = None if preemption.deadline_active() else get_codec(codec_name)
     payload = mv  # raw fallback: the input itself, copied once into the frame
     inner = RAW
     if codec is not None and codec.codec_id != 0:

@@ -26,9 +26,10 @@ from __future__ import annotations
 import abc
 import os
 import tempfile
+import threading
 import time
 import uuid
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from . import knobs, phase_stats
 
@@ -42,6 +43,287 @@ def resolve_wait_timeout_s(timeout_s: Optional[float]) -> float:
     resolution point so every store implementation and the barrier agree
     on what an unspecified wait bound is."""
     return knobs.get_barrier_timeout_s() if timeout_s is None else timeout_s
+
+
+# ------------------------------------------------------------ liveness leases
+#
+# The dominant failure on real fleets is a *dying process* — preemption,
+# OOM-kill, a vanished host — not a flaky storage RPC.  Before these leases,
+# a SIGKILLed rank parked every peer in its barrier/collective waits until
+# TPUSNAP_BARRIER_TIMEOUT_S expired (1800 s by default).  Now every rank of
+# an in-flight operation refreshes a store-side lease (``oplease/<rank>`` =
+# wall-clock stamp) on a small daemon thread; a waiter whose blocking GET
+# slices past the grace window re-reads the peers' leases and converts an
+# expired one into a fast, symmetric ``StorePeerError`` — the same error
+# class a peer's explicit ``report_error`` produces, so the abort rides the
+# existing teardown paths.  Stamps are wall-clock because they are compared
+# ACROSS processes (clock skew is noise next to a 10 s grace); absence of a
+# lease is treated as *no information* (the peer may simply not have
+# started its op yet), so a rank that dies before its first refresh still
+# surfaces as a plain timeout — documented in docs/robustness.md under
+# "what is NOT survivable".
+
+OP_LEASE_PREFIX = "oplease"
+# A lease holder that finished cleanly overwrites its stamp with this
+# tombstone (key deletion is prefix-based in FileStore and rank 1 vs 10
+# share a prefix) — waiters treat it as "exited cleanly", never as dead.
+_LEASE_DONE = b"done"
+# Fallback debris floor for waiters that hold no lease of their own (a
+# manager's pre-take collectives, direct barrier users): peer stamps from
+# before THIS process existed belong to a previous incarnation of the job
+# and are no information.  A process restarted after a crash therefore
+# never aborts on its predecessor's corpse, while deaths during this
+# process's lifetime stay detectable everywhere.
+_PROCESS_EPOCH = time.time()
+
+
+class OpLease:
+    """Store-side liveness lease for this process while >= 1 multi-rank
+    operation is in flight.  One refresh thread per (store, process),
+    refcounted across concurrent ops (an async_take draining in the
+    background while the next take starts shares the lease)."""
+
+    def __init__(self, store: "KVStore", rank: int, interval_s: float) -> None:
+        self._store = store
+        self._rank = rank
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self.refcount = 1
+        # Wall-clock op-start epoch: waiters ignore PEER stamps older than
+        # (this - grace) as a previous incarnation's debris — see
+        # PeerLivenessChecker.
+        self.acquired_at = time.time()
+        self._write_stamp()
+        self._thread = threading.Thread(
+            target=self._run, name="tpusnap-op-lease", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def store(self) -> "KVStore":
+        return self._store
+
+    def key(self) -> str:
+        return f"{OP_LEASE_PREFIX}/{self._rank}"
+
+    def _write_stamp(self) -> None:
+        try:
+            self._store.set(self.key(), repr(time.time()).encode())
+        except Exception:
+            pass  # a liveness beacon must never fail the op it describes
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            self._write_stamp()
+
+    def stop(self) -> None:
+        """Stop refreshing.  The clean-exit tombstone is written by
+        :func:`release_op_lease` — and only when no successor lease has
+        taken over the key, so a back-to-back op's fresh stamp is never
+        overwritten with ``done`` (a kill in that window would otherwise
+        read as a clean exit and peers would ride out the full timeout)."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def write_tombstone(self) -> None:
+        try:
+            self._store.set(self.key(), _LEASE_DONE)
+        except Exception:
+            pass
+
+
+_OP_LEASE_LOCK = threading.Lock()
+_OP_LEASES: Dict[int, OpLease] = {}  # id(store) -> lease (store held via lease)
+
+
+def acquire_op_lease(store: Optional["KVStore"], rank: int) -> Optional[OpLease]:
+    """Start (or share) the liveness lease for an operation over ``store``.
+    Returns None — and costs nothing — when liveness detection is disabled
+    (``TPUSNAP_LEASE_GRACE_S=0``) or there is no store (world size 1)."""
+    if store is None or knobs.get_lease_grace_s() <= 0:
+        return None
+    with _OP_LEASE_LOCK:
+        lease = _OP_LEASES.get(id(store))
+        if lease is not None and lease.store is store:
+            lease.refcount += 1
+            return lease
+        lease = OpLease(store, rank, knobs.get_lease_interval_s())
+        _OP_LEASES[id(store)] = lease
+        return lease
+
+
+def release_op_lease(lease: Optional[OpLease]) -> None:
+    """Idempotence is the caller's job (each acquire pairs with exactly one
+    release); the last release stops the refresh thread and tombstones the
+    lease so peers read a clean exit, not a decaying stamp."""
+    if lease is None:
+        return
+    with _OP_LEASE_LOCK:
+        lease.refcount -= 1
+        if lease.refcount > 0:
+            return
+        # Identity-guarded: a successor lease may already be registered
+        # under this store — evicting IT would orphan its refcounting.
+        if _OP_LEASES.get(id(lease.store)) is lease:
+            _OP_LEASES.pop(id(lease.store), None)
+    lease.stop()
+    with _OP_LEASE_LOCK:
+        if id(lease.store) in _OP_LEASES:
+            return  # a successor lease owns the key now — its stamps rule
+        lease.write_tombstone()
+
+
+def own_lease_start(store: Optional["KVStore"]) -> Optional[float]:
+    """Wall-clock instant this process's live lease over ``store`` was
+    acquired, or None — the epoch waiters use to discount a previous
+    incarnation's lease debris."""
+    if store is None:
+        return None
+    with _OP_LEASE_LOCK:
+        lease = _OP_LEASES.get(id(store))
+        return lease.acquired_at if lease is not None else None
+
+
+class PeerLivenessChecker:
+    """Reads peers' leases during a blocking wait.  Only a rank with a
+    PRESENT, non-tombstone lease whose stamp aged past the grace is
+    presumed dead — a missing lease is no information (the peer may not
+    have entered the op yet), so plain-timeout semantics are preserved for
+    store uses outside the snapshot protocol.
+
+    ``not_before`` (the waiter's own op-start epoch when it holds a lease,
+    else this process's import epoch): peer stamps older than
+    ``not_before - grace`` are a *previous incarnation's* debris — a rank
+    killed in an earlier attempt over this job-scoped store whose decaying
+    stamp nobody tombstoned.  Discounting them keeps a restarted job from
+    aborting on its predecessor's corpse; the restarted peer gets the
+    usual grace window to write its first fresh stamp, after which normal
+    detection resumes.  A live peer of THIS op always passes the filter:
+    its stamps are at most one refresh interval old, far newer than any
+    plausible ``not_before - grace``.
+
+    Probe cost: reads are cached per rank — a tombstone is terminal, and a
+    fresh stamp cannot possibly expire before ``stamp + grace``, so each
+    waiter re-reads each peer at most ~once per grace window (not once per
+    wait slice).  Steady-state barrier skew at world size N still costs
+    O(N²/grace) reads fleet-wide; the barrier path needs only ONE detector
+    in practice (its report_error fan-out wakes everyone), so the residual
+    load is the pg-collective waits' — revisit with a designated-prober
+    scheme if thousand-rank FileStore jobs show probe pressure."""
+
+    def __init__(
+        self,
+        store: "KVStore",
+        rank: int,
+        world_size: int,
+        grace_s: float,
+        not_before: Optional[float] = None,
+    ) -> None:
+        self._store = store
+        self._rank = rank
+        self._world_size = world_size
+        self._grace_s = grace_s
+        self._stamp_floor = (
+            not_before - grace_s if not_before is not None else None
+        )
+        # rank -> monotonic instant before which re-reading its lease is
+        # pointless (fresh stamp can't have expired yet); None = terminal
+        # tombstone, never re-read.
+        self._next_probe: Dict[int, Optional[float]] = {}
+
+    def dead_peer(self) -> Optional[Tuple[int, float]]:
+        """``(rank, lease_age_s)`` of the first peer whose lease expired,
+        or None.  Store errors read as "no information" — a flaky probe
+        must never fail a healthy barrier."""
+        now = time.time()
+        mono = time.monotonic()
+        for r in range(self._world_size):
+            if r == self._rank:
+                continue
+            cached = self._next_probe.get(r, 0.0)
+            if cached is None or (cached and mono < cached):
+                continue
+            try:
+                raw = self._store.try_get(f"{OP_LEASE_PREFIX}/{r}")
+            except Exception:
+                return None
+            if raw == _LEASE_DONE:
+                self._next_probe[r] = None  # clean exit: terminal
+                continue
+            if raw is None:
+                continue  # no lease yet: keep probing (cheap negative)
+            try:
+                stamp = float(raw)
+            except ValueError:
+                continue
+            if self._stamp_floor is not None and stamp < self._stamp_floor:
+                continue  # a previous incarnation's debris: no information
+            age = now - stamp
+            if age > self._grace_s:
+                return r, age
+            # Fresh: can't possibly expire before the remaining grace runs
+            # out — skip re-reads until then.
+            self._next_probe[r] = mono + (self._grace_s - age)
+        return None
+
+
+def wait_with_liveness(
+    store: "KVStore",
+    key: str,
+    timeout_s: Optional[float],
+    rank: int,
+    world_size: int,
+    lease_store: Optional["KVStore"] = None,
+    on_dead: Optional[Callable[[int, float, str], None]] = None,
+) -> bytes:
+    """Blocking GET bounded by the barrier timeout, sliced so a peer's
+    lease expiry surfaces in ~grace seconds instead of the full timeout.
+
+    ``lease_store``: where the ``oplease/<rank>`` keys live when ``store``
+    is a namespaced view (LinearBarrier's PrefixStore).  ``on_dead`` runs
+    before the :class:`StorePeerError` raise — the barrier points it at
+    ``report_error`` so every other waiter wakes symmetrically."""
+    grace = knobs.get_lease_grace_s()
+    resolved = resolve_wait_timeout_s(timeout_s)
+    if grace <= 0 or world_size <= 1:
+        return store.get(key, timeout_s=resolved)
+    deadline = time.monotonic() + resolved
+    slice_s = max(0.05, min(grace / 4.0, 5.0))
+    checker: Optional[PeerLivenessChecker] = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"Timed out waiting for store key: {key}")
+        try:
+            return store.get(key, timeout_s=min(slice_s, remaining))
+        except TimeoutError:
+            if checker is None:  # lazily: fast waits never pay for one
+                base = lease_store if lease_store is not None else store
+                # Our own op-start epoch discounts lease debris from a
+                # previous incarnation of this job over the same store;
+                # waiters outside any op (pre-take manager collectives)
+                # fall back to the process epoch — debris predating this
+                # process is equally not ours to act on.
+                not_before = own_lease_start(base)
+                if not_before is None:
+                    not_before = _PROCESS_EPOCH
+                checker = PeerLivenessChecker(
+                    base, rank, world_size, grace, not_before=not_before
+                )
+            dead = checker.dead_peer()
+            if dead is None:
+                continue
+            peer, age = dead
+            msg = (
+                f"rank {peer} presumed dead: liveness lease unrefreshed for "
+                f"{age:.1f}s (grace {grace:.1f}s) while waiting on {key}"
+            )
+            if on_dead is not None:
+                try:
+                    on_dead(peer, age, msg)
+                except Exception:
+                    pass  # best-effort fan-out; the raise below still fires
+            raise StorePeerError(msg) from None
 
 
 class KVStore(abc.ABC):
@@ -355,6 +637,10 @@ class LinearBarrier:
     ) -> None:
         self.prefix = f"linear_barrier/{prefix}"
         self._store = PrefixStore(self.prefix, store)
+        # The un-namespaced store: liveness leases (oplease/<rank>) live at
+        # the store root so every barrier/collective over one store reads
+        # the same per-process lease.
+        self._base_store = store
         self._rank = rank
         self._world_size = world_size
         self._leader_rank = leader_rank
@@ -368,9 +654,21 @@ class LinearBarrier:
         # Timed as `barrier_wait` (classified as a wait group in
         # analyze.PHASE_GROUPS): commit-barrier skew used to be invisible
         # wall — the straggler's peers burned it here with no phase record.
+        # Liveness-aware: a peer whose op lease expired mid-wait is
+        # presumed dead, reported through report_error (so EVERY waiter
+        # wakes with the same symmetric StorePeerError), and surfaced here
+        # in ~grace seconds instead of the full barrier timeout.
         begin = time.monotonic()
         try:
-            self._store.get(key, timeout_s=resolve_wait_timeout_s(timeout_s))
+            wait_with_liveness(
+                self._store,
+                key,
+                timeout_s,
+                rank=self._rank,
+                world_size=self._world_size,
+                lease_store=self._base_store,
+                on_dead=lambda peer, age, msg: self.report_error(msg),
+            )
         except TimeoutError:
             self._check_error()
             raise TimeoutError(f"LinearBarrier timed out waiting on {key}")

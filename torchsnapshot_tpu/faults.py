@@ -23,6 +23,10 @@ the inner plugin sees it).  Spec grammar::
            | torn[:fraction]      writes only: persist a prefix of the
                                   payload (default half), then raise
                                   transient — a short/torn write
+           | crash                os._exit(1) at the faulted call: process
+                                  death (no teardown, no finally blocks) —
+                                  the kill-chaos harness's seeded SIGKILL
+                                  analogue
     glob  := fnmatch pattern on the storage-relative path
 
 Each rule keeps its own call counter **per plugin instance** — and the
@@ -58,7 +62,7 @@ logger = logging.getLogger(__name__)
 _OPS = frozenset(
     {"write", "read", "delete", "delete_dir", "list", "exists", "any"}
 )
-_KINDS = frozenset({"transient", "terminal", "latency", "torn"})
+_KINDS = frozenset({"transient", "terminal", "latency", "torn", "crash"})
 
 _DEFAULT_LATENCY_S = 0.05
 _DEFAULT_TORN_FRACTION = 0.5
@@ -103,6 +107,51 @@ def total_read_bytes() -> int:
 def _record_read(path: str, nbytes: int) -> None:
     with _READ_COUNTER_LOCK:
         _READ_BYTES_BY_PATH[path] = _READ_BYTES_BY_PATH.get(path, 0) + nbytes
+
+
+# The write-side mirror: bytes the wrapped backend was actually asked to
+# persist, per path.  ``TPUSNAP_FAULTS=none`` turns the wrapper into a pure
+# write meter — the resumable-take tests assert "a retried take adopts the
+# dead attempt's durable chunks" against these counters (adopted chunks are
+# pure manifest references and never reach a write call).
+
+_WRITE_BYTES_BY_PATH: dict = {}
+
+
+def reset_write_counters() -> None:
+    with _READ_COUNTER_LOCK:
+        _WRITE_BYTES_BY_PATH.clear()
+
+
+def write_counters() -> dict:
+    """``{path: bytes handed to the wrapped backend's write}`` since the
+    last reset.  Torn writes count the persisted prefix only."""
+    with _READ_COUNTER_LOCK:
+        return dict(_WRITE_BYTES_BY_PATH)
+
+
+def total_write_bytes() -> int:
+    with _READ_COUNTER_LOCK:
+        return sum(_WRITE_BYTES_BY_PATH.values())
+
+
+def _record_write(path: str, nbytes: int) -> None:
+    with _READ_COUNTER_LOCK:
+        _WRITE_BYTES_BY_PATH[path] = (
+            _WRITE_BYTES_BY_PATH.get(path, 0) + nbytes
+        )
+
+
+def _nbytes_of(buf) -> int:
+    """Size without materializing: joining a ScatterBuffer just to meter
+    it would memcpy the whole slab."""
+    nbytes = getattr(buf, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    try:
+        return memoryview(buf).nbytes
+    except (TypeError, ValueError):
+        return len(buf) if isinstance(buf, (bytes, bytearray)) else 0
 
 
 class InjectedTransientError(StorageTransientError):
@@ -161,6 +210,8 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             raise ValueError(
                 f"fault rule {raw!r}: 'torn' applies to writes only"
             )
+        if kind == "crash" and param_str is not None:
+            raise ValueError(f"fault rule {raw!r}: 'crash' takes no param")
         if when == "*":
             first, open_ended = 1, True
         elif when.endswith("+"):
@@ -251,6 +302,17 @@ class FaultyStoragePlugin(StoragePlugin):
     ) -> None:
         if rule is None:
             return
+        if rule.kind == "crash":
+            # Process death, not an exception: no teardown, no finally
+            # blocks, no commit-marker cleanup — the debris is exactly
+            # what a SIGKILL leaves.  Log first (best-effort) so a chaos
+            # run's transcript shows where the schedule struck.
+            logger.warning(
+                "fault injected: CRASH at %s %s (os._exit)", op, path
+            )
+            import os
+
+            os._exit(1)
         if rule.kind == "latency":
             await asyncio.sleep(
                 rule.param if rule.param is not None else _DEFAULT_LATENCY_S
@@ -284,12 +346,14 @@ class FaultyStoragePlugin(StoragePlugin):
                     durable=getattr(write_io, "durable", False),
                 )
             )
+            _record_write(write_io.path, prefix.nbytes)
             raise InjectedTransientError(
                 f"injected torn write ({write_io.path}: "
                 f"{prefix.nbytes}/{view.nbytes} bytes persisted)"
             )
         await self._raise_or_delay(rule, "write", write_io.path)
         await self._inner.write(write_io)
+        _record_write(write_io.path, _nbytes_of(write_io.buf))
 
     async def read(self, read_io: ReadIO) -> None:
         await self._raise_or_delay(

@@ -73,6 +73,9 @@ FLEET_TELEMETRY_STALE_S_ENV_VAR = _ENV_PREFIX + "FLEET_TELEMETRY_STALE_S"
 CACHE_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "CACHE_MAX_BYTES"
 PARTIAL_READS_ENV_VAR = _ENV_PREFIX + "PARTIAL_READS"
 PARTIAL_READ_MIN_SAVED_ENV_VAR = _ENV_PREFIX + "PARTIAL_READ_MIN_SAVED_BYTES"
+LEASE_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "LEASE_INTERVAL_S"
+LEASE_GRACE_S_ENV_VAR = _ENV_PREFIX + "LEASE_GRACE_S"
+SAVE_DEADLINE_S_ENV_VAR = _ENV_PREFIX + "SAVE_DEADLINE_S"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -911,6 +914,73 @@ def override_fleet_telemetry_stale_s(
     value: float,
 ) -> Generator[None, None, None]:
     with _override_env(FLEET_TELEMETRY_STALE_S_ENV_VAR, str(value)):
+        yield
+
+
+# Liveness-lease defaults (dist_store.py): a participant of a multi-rank
+# operation refreshes its store-side lease every interval; a peer blocked
+# in a barrier/collective wait that observes the lease unrefreshed past the
+# grace presumes the holder dead and aborts fast (StorePeerError) instead
+# of riding out TPUSNAP_BARRIER_TIMEOUT_S.  The grace errs high enough
+# that a GC pause or a descheduled refresh thread can't fail a healthy
+# save, and stays far below the barrier timeout so a kill -9 surfaces in
+# seconds.
+_DEFAULT_LEASE_INTERVAL_S = 2.0
+_DEFAULT_LEASE_GRACE_S = 10.0
+# Emergency-flush budget (preemption.py): on SIGTERM mid-async_take the
+# scheduler enters deadline mode and must drive the pending snapshot to a
+# committed state inside this many seconds — sized for the typical cloud
+# preemption grace window (GCE gives 30 s).
+_DEFAULT_SAVE_DEADLINE_S = 30.0
+
+
+def get_lease_interval_s() -> float:
+    """Seconds between a multi-rank operation's store-side liveness-lease
+    refreshes (dist_store.OpLease).  Clamped to >= 0.05."""
+    val = os.environ.get(LEASE_INTERVAL_S_ENV_VAR)
+    return (
+        max(0.05, float(val)) if val is not None else _DEFAULT_LEASE_INTERVAL_S
+    )
+
+
+def get_lease_grace_s() -> float:
+    """Age past which a peer's unrefreshed lease means "presumed dead":
+    waiters blocked in barriers/collectives convert the wait into a fast
+    symmetric ``StorePeerError`` instead of timing out.  0 disables
+    liveness detection entirely (no lease thread, plain blocking waits).
+    Clamped to >= 2x the refresh interval — a grace below the interval
+    would declare every healthy peer dead between its own refreshes."""
+    val = os.environ.get(LEASE_GRACE_S_ENV_VAR)
+    grace = float(val) if val is not None else _DEFAULT_LEASE_GRACE_S
+    if grace <= 0:
+        return 0.0
+    return max(grace, 2.0 * get_lease_interval_s())
+
+
+def get_save_deadline_s() -> float:
+    """Emergency-flush budget: seconds the preemption handler gives an
+    in-flight snapshot to reach a committed state after SIGTERM (deadline
+    mode drops compression, raises io concurrency, sheds non-essential
+    telemetry)."""
+    val = os.environ.get(SAVE_DEADLINE_S_ENV_VAR)
+    return max(0.0, float(val)) if val is not None else _DEFAULT_SAVE_DEADLINE_S
+
+
+@contextmanager
+def override_lease_interval_s(value: float) -> Generator[None, None, None]:
+    with _override_env(LEASE_INTERVAL_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_lease_grace_s(value: float) -> Generator[None, None, None]:
+    with _override_env(LEASE_GRACE_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_save_deadline_s(value: float) -> Generator[None, None, None]:
+    with _override_env(SAVE_DEADLINE_S_ENV_VAR, str(value)):
         yield
 
 

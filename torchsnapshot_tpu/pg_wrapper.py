@@ -18,7 +18,7 @@ from __future__ import annotations
 import pickle
 from typing import Any, List, Optional
 
-from .dist_store import KVStore
+from .dist_store import KVStore, wait_with_liveness
 
 
 class PGWrapper:
@@ -95,6 +95,22 @@ class PGWrapper:
         self._staged_keys.append(key)
         return key
 
+    def _get(self, key: str) -> bytes:
+        """Blocking store GET with peer-liveness detection: a collective
+        wait on a peer whose op lease (dist_store.OpLease) expired raises
+        :class:`~torchsnapshot_tpu.dist_store.StorePeerError` in ~grace
+        seconds instead of parking for the full timeout.  Every blocked
+        rank reads the same expired lease, so the abort is symmetric
+        without an error-broadcast channel; ranks mid-compute hit it at
+        their next collective."""
+        return wait_with_liveness(
+            self._store,
+            key,
+            self._timeout_s,
+            rank=self._rank,
+            world_size=self._world_size,
+        )
+
     def retire_prefix(
         self,
         prefix: str,
@@ -127,7 +143,7 @@ class PGWrapper:
         key = self._next_key("barrier")
         if self._store.add(f"{key}/arrived", 1) >= self._world_size:
             self._store.set(f"{key}/go", b"1")
-        self._store.get(f"{key}/go", timeout_s=self._timeout_s)
+        self._get(f"{key}/go")
         if self._rank == 0:
             kept = []
             for stale, guard_key, guard_target in self._retired_keys:
@@ -152,7 +168,7 @@ class PGWrapper:
         self._store.set(f"{key}/{self._rank}", pickle.dumps(obj))
         out: List[Any] = []
         for r in range(self._world_size):
-            data = self._store.get(f"{key}/{r}", timeout_s=self._timeout_s)
+            data = self._get(f"{key}/{r}")
             out.append(pickle.loads(data))
         return out
 
@@ -171,7 +187,7 @@ class PGWrapper:
                 if r == root:
                     out.append(obj)
                     continue
-                data = self._store.get(f"{key}/{r}", timeout_s=self._timeout_s)
+                data = self._get(f"{key}/{r}")
                 out.append(pickle.loads(data))
             return out
         self._store.set(f"{key}/{self._rank}", pickle.dumps(obj))
@@ -205,7 +221,7 @@ class PGWrapper:
             received = obj_list
         else:
             received = pickle.loads(
-                self._store.get(f"{key}/v", timeout_s=self._timeout_s)
+                self._get(f"{key}/v")
             )
         obj_list[:] = received
 
@@ -232,7 +248,7 @@ class PGWrapper:
             output_list[0] = input_list[src]
         else:
             output_list[0] = pickle.loads(
-                self._store.get(f"{key}/{self._rank}", timeout_s=self._timeout_s)
+                self._get(f"{key}/{self._rank}")
             )
 
     @property

@@ -1,0 +1,277 @@
+"""Preemption deadline mode: SIGTERM-driven emergency snapshot flush.
+
+On real TPU fleets preemption is a SIGTERM with a grace window (tens of
+seconds) followed by SIGKILL.  A trainer that ignores the signal loses the
+in-flight ``async_take``; a trainer that exits immediately loses it too.
+This module gives the window a job: ``install_handler()`` (surfaced as
+``Snapshot.install_preemption_handler()``) registers a SIGTERM handler
+that switches the process into **deadline mode** for the
+``TPUSNAP_SAVE_DEADLINE_S`` budget:
+
+- **compression is dropped** — ``compression.encode`` frames new payloads
+  raw (the frame header records what actually happened, so readers never
+  notice); the grace window buys durability, not ratio;
+- **io concurrency is raised** — every registered write pipeline's
+  semaphore gains extra permits (released onto its own event loop, so an
+  already-draining pipeline widens immediately) and pipelines created
+  after activation start wide, within the unchanged memory budget;
+- **non-essential telemetry is shed** — per-op sidecar writes and
+  periodic fleet-telemetry publishes are skipped until the flush is over.
+
+``preemption.flush.start`` / ``preemption.flush.end`` events bracket the
+flush; the end event carries whether every in-flight take reached a
+terminal state inside the budget.  The handler itself only flips state and
+spawns a watcher thread — no blocking work runs in signal context — and by
+default *replaces* SIG_DFL termination, so the process survives the
+SIGTERM long enough to commit (the supervisor's SIGKILL still bounds it).
+
+Deadline mode is process-global and sticky until :func:`deactivate` (a
+preempted process is going down; there is no "back to normal").  Tests
+must pair :func:`activate`/``install_handler`` with :func:`deactivate`.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from . import knobs
+from .event import Event
+from .event_handlers import log_event
+
+logger = logging.getLogger(__name__)
+
+# Deadline-mode io-concurrency boost: the write semaphore widens to
+# base * factor, capped.  4x is the measured sweet spot for small-payload
+# drains behind injected latency; the memory budget still gates staging,
+# so the extra slots can never admit more bytes than normal mode could.
+IO_BOOST_FACTOR = 4
+IO_BOOST_MAX = 64
+
+# Reentrant on purpose: the SIGTERM handler runs activate() on the MAIN
+# thread between bytecodes, and the main thread may be inside
+# register_write_semaphore (a sync take drives its pipeline inline) holding
+# this very lock — a plain Lock would deadlock the handler against the
+# frame it interrupted and burn the whole grace window.
+_STATE_LOCK = threading.RLock()
+_DEADLINE: Optional[float] = None  # monotonic instant the budget expires
+_ACTIVATED_AT: Optional[float] = None
+_BUDGET_S: Optional[float] = None
+# (loop, semaphore, base_cap, boosted_flag_list) registered by write
+# pipelines; pruned when their loop closes.
+_BOOST_TARGETS: List[Tuple[Any, Any, int, List[bool]]] = []
+
+
+def deadline_active() -> bool:
+    """Whether the process is in emergency-flush deadline mode.  Lock-free
+    read (module-global assignment is atomic); checked on hot-ish paths
+    like ``compression.encode``."""
+    return _DEADLINE is not None
+
+
+def deadline_remaining_s() -> Optional[float]:
+    """Seconds left in the flush budget, or None outside deadline mode.
+    Clamped at 0 — the mode stays active past its own deadline (the
+    process is going down either way)."""
+    deadline = _DEADLINE
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
+
+
+def effective_io_cap(base: int) -> int:
+    """The io-concurrency cap a pipeline should start with: ``base``
+    normally, the boosted width in deadline mode."""
+    if not deadline_active():
+        return base
+    return max(base, min(base * IO_BOOST_FACTOR, IO_BOOST_MAX))
+
+
+def register_write_semaphore(loop: Any, semaphore: Any, base_cap: int) -> None:
+    """Called by the write pipeline after creating its io semaphore, so an
+    activation mid-drain can widen it in place (extra ``release()`` calls
+    scheduled onto the pipeline's own loop — the only thread that may
+    touch an asyncio primitive)."""
+    boosted = [False]
+    with _STATE_LOCK:
+        _BOOST_TARGETS[:] = [
+            t for t in _BOOST_TARGETS if not t[0].is_closed()
+        ]
+        _BOOST_TARGETS.append((loop, semaphore, base_cap, boosted))
+        active = _DEADLINE is not None
+    if active:
+        _boost_one(loop, semaphore, base_cap, boosted)
+
+
+def _boost_one(loop: Any, semaphore: Any, base_cap: int, boosted: List[bool]) -> None:
+    # Check-and-set under the lock: a registration racing an activation
+    # must not widen the same semaphore twice.
+    with _STATE_LOCK:
+        if boosted[0]:
+            return
+        boosted[0] = True
+    extra = effective_io_cap(base_cap) - base_cap
+    if extra <= 0:
+        return
+
+    def _release() -> None:
+        for _ in range(extra):
+            semaphore.release()
+
+    try:
+        loop.call_soon_threadsafe(_release)
+    except RuntimeError:
+        pass  # loop already closed: nothing left to widen
+
+
+def activate(budget_s: Optional[float] = None, reason: str = "signal") -> bool:
+    """Enter deadline mode; returns False when already active.  Safe to
+    call from a signal handler: flips state, widens registered pipelines
+    (thread-safe loop callbacks), and defers event emission plus the
+    flush watcher to a spawned thread."""
+    global _DEADLINE, _ACTIVATED_AT, _BUDGET_S
+    if budget_s is None:
+        budget_s = knobs.get_save_deadline_s()
+    with _STATE_LOCK:
+        if _DEADLINE is not None:
+            return False
+        _ACTIVATED_AT = time.monotonic()
+        _BUDGET_S = budget_s
+        _DEADLINE = _ACTIVATED_AT + budget_s
+        targets = [t for t in _BOOST_TARGETS if not t[0].is_closed()]
+    for loop, semaphore, base_cap, boosted in targets:
+        _boost_one(loop, semaphore, base_cap, boosted)
+    threading.Thread(
+        target=_flush_watch,
+        args=(_ACTIVATED_AT, budget_s, reason),
+        name="tpusnap-preemption-flush",
+        daemon=True,
+    ).start()
+    return True
+
+
+def deactivate() -> None:
+    """Leave deadline mode (tests; production processes die instead)."""
+    global _DEADLINE, _ACTIVATED_AT, _BUDGET_S
+    with _STATE_LOCK:
+        _DEADLINE = None
+        _ACTIVATED_AT = None
+        _BUDGET_S = None
+        _BOOST_TARGETS.clear()
+
+
+def _inflight_saves() -> List[Any]:
+    from .telemetry import monitor as tmonitor
+
+    return [
+        m
+        for m in tmonitor.active_ops()
+        if m.kind in ("take", "async_take")
+    ]
+
+
+def _flush_watch(begin: float, budget_s: float, reason: str) -> None:
+    """Emits the flush bracket events and watches the in-flight saves race
+    the deadline.  "Success" = every take/async_take in flight at
+    activation reached a terminal state inside the budget — commit vs
+    failure is the op's own event's business.  The set is pinned at
+    activation: saves started afterwards belong to whatever the trainer
+    does with its remaining grace, not to this flush's verdict."""
+    pending = _inflight_saves()
+    log_event(
+        Event(
+            name="preemption.flush.start",
+            metadata={
+                "action": "preemption.flush",
+                "reason": reason,
+                "budget_s": budget_s,
+                "inflight_saves": len(pending),
+            },
+        )
+    )
+    logger.warning(
+        "preemption: entering save-deadline mode (%s): %.1fs budget, "
+        "%d save(s) in flight — compression off, io concurrency boosted, "
+        "non-essential telemetry shed",
+        reason,
+        budget_s,
+        len(pending),
+    )
+    deadline = begin + budget_s
+    while time.monotonic() < deadline:
+        if all(m.done for m in pending):
+            break
+        time.sleep(0.05)
+    leftover = [m for m in pending if not m.done]
+    duration = time.monotonic() - begin
+    log_event(
+        Event(
+            name="preemption.flush.end",
+            metadata={
+                "action": "preemption.flush",
+                "reason": reason,
+                "budget_s": budget_s,
+                "duration_s": round(duration, 4),
+                "is_success": not leftover,
+                "inflight_saves": len(leftover),
+            },
+        )
+    )
+    if leftover:
+        logger.error(
+            "preemption: %d save(s) still in flight after the %.1fs "
+            "deadline budget — the snapshot may be lost to the kill",
+            len(leftover),
+            budget_s,
+        )
+    else:
+        logger.warning(
+            "preemption: all in-flight saves reached a terminal state in "
+            "%.2fs (budget %.1fs)",
+            duration,
+            budget_s,
+        )
+
+
+class PreemptionHandler:
+    """Handle for an installed preemption signal handler."""
+
+    def __init__(self, signum: int, previous: Any) -> None:
+        self.signum = signum
+        self._previous = previous
+        self._installed = True
+
+    def uninstall(self) -> None:
+        """Restore the previous handler (idempotent)."""
+        if not self._installed:
+            return
+        self._installed = False
+        signal.signal(self.signum, self._previous)
+
+
+def install_handler(
+    signum: Optional[int] = None, chain: bool = True
+) -> PreemptionHandler:
+    """Register the emergency-flush handler (main thread only — a CPython
+    signal.signal constraint).  ``chain=True`` forwards the signal to a
+    pre-existing *callable* handler after activating deadline mode; the
+    default SIG_DFL termination is deliberately NOT chained — surviving
+    the SIGTERM is the whole point of the grace window."""
+    if signum is None:
+        signum = signal.SIGTERM
+    previous = signal.getsignal(signum)
+
+    def _handler(num: int, frame: Any) -> None:
+        activate(reason=f"signal {num}")
+        if (
+            chain
+            and callable(previous)
+            and previous not in (signal.SIG_IGN, signal.SIG_DFL)
+        ):
+            previous(num, frame)
+
+    signal.signal(signum, _handler)
+    return PreemptionHandler(signum, previous)

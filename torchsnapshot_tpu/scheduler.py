@@ -44,7 +44,7 @@ from typing import Awaitable, Callable, List, Optional
 
 import psutil
 
-from . import knobs, phase_stats, retry as retry_policy
+from . import knobs, phase_stats, preemption, retry as retry_policy
 from .event import Event
 from .event_handlers import log_event
 from .telemetry import metrics as tmetrics
@@ -378,8 +378,15 @@ async def execute_write_reqs(
     io_tasks: set = set()
     io_pipelines: dict = {}
     all_io_tasks: List[asyncio.Task] = []
-    io_cap = knobs.get_max_per_rank_io_concurrency()
+    # Deadline mode (preemption.py) starts new pipelines at the boosted io
+    # width; otherwise the semaphore is registered so an activation landing
+    # MID-drain widens it in place — extra permits are released onto this
+    # pipeline's own loop, no loop-turn polling needed.
+    base_io_cap = knobs.get_max_per_rank_io_concurrency()
+    io_cap = preemption.effective_io_cap(base_io_cap)
     io_semaphore = asyncio.Semaphore(io_cap)
+    if io_cap == base_io_cap:
+        preemption.register_write_semaphore(loop, io_semaphore, base_io_cap)
     staged_bytes = 0
     max_write_retries = knobs.get_io_retries()
     reporter = _ProgressReporter(
@@ -767,13 +774,54 @@ async def execute_read_reqs(
         ],
     }
 
+    max_read_retries = knobs.get_io_retries()
+
     async def _read(pipeline: _ReadPipeline) -> _ReadPipeline:
-        slot_wait_begin = time.monotonic()
-        async with io_semaphore:
-            slot_wait_s = time.monotonic() - slot_wait_begin
-            if slot_wait_s > 0.001:
-                phase_stats.add("io_slot_wait", slot_wait_s)
-            return await pipeline.read_buffer()
+        # Bounded retry of TRANSIENT read failures — the write path's
+        # mirror (same TPUSNAP_IO_RETRIES budget, same retry.py
+        # classifier/backoff): a 503 burst or flaky-NFS blip mid-restore
+        # no longer aborts the whole read pipeline.  read_buffer builds a
+        # fresh ReadIO per attempt, so a requeue is a pure re-send; the
+        # backoff sleeps OUTSIDE the io semaphore so a parked retry never
+        # blocks a healthy read's slot.
+        attempt = 0
+        while True:
+            try:
+                slot_wait_begin = time.monotonic()
+                async with io_semaphore:
+                    slot_wait_s = time.monotonic() - slot_wait_begin
+                    if slot_wait_s > 0.001:
+                        phase_stats.add("io_slot_wait", slot_wait_s)
+                    return await pipeline.read_buffer()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if attempt >= max_read_retries or not (
+                    retry_policy.is_transient(e)
+                ):
+                    raise
+                attempt += 1
+                tmetrics.record_pipeline_retry("read")
+                log_event(
+                    Event(
+                        name="scheduler.read_retry",
+                        metadata={
+                            "path": pipeline.read_req.path,
+                            "attempt": attempt,
+                            "error": repr(e),
+                        },
+                    )
+                )
+                logger.warning(
+                    "[rank %d] transient read failure for %s "
+                    "(attempt %d/%d): %r; retrying",
+                    rank,
+                    pipeline.read_req.path,
+                    attempt,
+                    max_read_retries,
+                    e,
+                )
+                await asyncio.sleep(retry_policy.backoff_s(attempt))
 
     def dispatch_io() -> None:
         while ready_for_io:

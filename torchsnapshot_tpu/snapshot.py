@@ -41,7 +41,12 @@ from .telemetry import monitor as tmonitor
 from .telemetry import sidecar as tsidecar
 from .telemetry import trace as ttrace
 from .batcher import batch_read_requests, batch_write_requests
-from .dist_store import LinearBarrier, StorePeerError
+from .dist_store import (
+    LinearBarrier,
+    StorePeerError,
+    acquire_op_lease,
+    release_op_lease,
+)
 from .event import Event
 from .event_handlers import log_event
 from .flatten import flatten, inflate
@@ -128,6 +133,10 @@ class Snapshot:
         event_metadata = {"unique_id": unique_id, "rank": pg.get_rank(), "action": "take"}
         log_event(Event(name="take.start", metadata=dict(event_metadata)))
         begin = time.monotonic()
+        # Liveness lease: while this rank is anywhere inside the take, its
+        # store-side lease stays fresh; peers blocked in barriers detect a
+        # kill -9 of this process in ~grace seconds (dist_store.OpLease).
+        lease = acquire_op_lease(pg.store, pg.get_rank())
         try:
             cls._validate_app_state(app_state)
             path, replicated_patterns = cls._coalesce_path_and_replicated(
@@ -238,6 +247,8 @@ class Snapshot:
             ttrace.end_op(trace_op, success=False)
             tmonitor.op_finished(health, success=False)
             raise
+        finally:
+            release_op_lease(lease)
 
     @classmethod
     def async_take(
@@ -283,6 +294,11 @@ class Snapshot:
         }
         log_event(Event(name="async_take.start", metadata=dict(event_metadata)))
         begin = time.monotonic()
+        # Lease held from here through the background commit thread — the
+        # PendingSnapshot releases it when the completion thread finishes
+        # (success or abort), so a kill of this process at ANY point of the
+        # async lifecycle lets peers abort fast.
+        lease = acquire_op_lease(pg.store, pg.get_rank())
         try:
             cls._validate_app_state(app_state)
             path, replicated_patterns = cls._coalesce_path_and_replicated(
@@ -317,6 +333,7 @@ class Snapshot:
             # even when planning/staging raises before the background thread
             # exists — otherwise the metrics bridge (and any operator
             # alerting on the event stream) leaks an open operation.
+            release_op_lease(lease)
             event_metadata["duration_s"] = time.monotonic() - begin
             event_metadata["is_success"] = False
             log_event(Event(name="async_take.end", metadata=event_metadata))
@@ -336,6 +353,7 @@ class Snapshot:
             phases_before=phases_before,
             monitor=health,
             manifest_transform=manifest_transform,
+            lease=lease,
         )
 
     @classmethod
@@ -541,6 +559,10 @@ class Snapshot:
         }
         log_event(Event(name="restore.start", metadata=dict(event_metadata)))
         begin = time.monotonic()
+        # Restore is collective (per-key barriers): the same liveness lease
+        # that protects takes lets surviving ranks abort fast when a peer
+        # dies mid-restore.
+        lease = acquire_op_lease(pg.store, rank)
         try:
             storage = url_to_storage_plugin(self.path, self._storage_options)
             try:
@@ -644,6 +666,8 @@ class Snapshot:
             ttrace.end_op(trace_op, success=False)
             tmonitor.op_finished(health, success=False)
             raise
+        finally:
+            release_op_lease(lease)
 
     def _load_stateful(
         self,
@@ -988,6 +1012,22 @@ class Snapshot:
             )
 
     @staticmethod
+    def install_preemption_handler(
+        signum: Optional[int] = None, chain: bool = True
+    ) -> Any:
+        """Register the SIGTERM emergency-flush handler (preemption.py):
+        on preemption the process enters deadline mode for the
+        ``TPUSNAP_SAVE_DEADLINE_S`` budget — compression dropped, io
+        concurrency raised, non-essential telemetry shed — and drives any
+        in-flight ``async_take`` to a committed, restorable state inside
+        the grace window, bracketed by ``preemption.flush`` start/end
+        events.  Main thread only (a CPython constraint); returns a
+        handler with ``.uninstall()``."""
+        from . import preemption
+
+        return preemption.install_handler(signum=signum, chain=chain)
+
+    @staticmethod
     def _validate_app_state(app_state: AppState) -> None:
         for key, value in app_state.items():
             if not (
@@ -1249,11 +1289,13 @@ class PendingSnapshot:
         phases_before: Optional[Dict[str, Dict[str, float]]] = None,
         monitor: Optional[tmonitor.OpMonitor] = None,
         manifest_transform: Optional[Any] = None,
+        lease: Optional[Any] = None,
     ) -> None:
         self.path = path
         self.pg = pg
         self._storage_options = storage_options
         self._manifest_transform = manifest_transform
+        self._lease = lease
         self._finalizer = finalizer
         self.stall_s = stall_s
         self._metadata: Optional[SnapshotMetadata] = None
@@ -1414,6 +1456,11 @@ class PendingSnapshot:
             ttrace.end_op(self._trace_op, success=False)
             tmonitor.op_finished(self._monitor, success=False)
         finally:
+            # The op is terminal either way: stop refreshing the liveness
+            # lease (peers must not read a committed-and-gone process as
+            # alive forever, nor a dead one as merely slow).
+            release_op_lease(self._lease)
+            self._lease = None
             with self._callbacks_lock:
                 self._done_event.set()
                 callbacks = list(self._done_callbacks)

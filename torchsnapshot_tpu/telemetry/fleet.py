@@ -330,18 +330,66 @@ def resolve_spool(path: Optional[str]) -> Optional[str]:
     return None
 
 
+# A suspected-dead entry (stale while its op was still in flight) stays
+# visible for this many stale intervals before the sweep reclaims it —
+# long enough for an operator (or a scrape) to see the death, bounded so
+# the spool can't grow forever.
+_SUSPECT_SWEEP_FACTOR = 10.0
+
+# (host, pid, kind, rank, op_id, publish_time) keys already reported as
+# suspected-dead, so a `top` refresh loop emits one fleet.peer_stale event
+# per death, not one per second.
+_PEER_STALE_SEEN: set = set()
+
+
+def _note_peer_stale(doc: Dict[str, Any], age: float) -> None:
+    key = (
+        doc.get("host"),
+        doc.get("pid"),
+        doc.get("kind"),
+        doc.get("rank"),
+        doc.get("op_id"),
+        doc.get("publish_time"),
+    )
+    if key in _PEER_STALE_SEEN:
+        return
+    _PEER_STALE_SEEN.add(key)
+    from ..event import Event
+    from ..event_handlers import log_event
+
+    log_event(
+        Event(
+            name="fleet.peer_stale",
+            metadata={
+                "worker": f"{doc.get('host', '?')}:{doc.get('pid', '?')}",
+                "rank": doc.get("rank", 0),
+                "kind": doc.get("kind", "?"),
+                "op_id": str(doc.get("op_id", ""))[:8],
+                "last_seen_s": round(age, 3),
+            },
+        )
+    )
+
+
 def collect(
     spool: str, stale_s: Optional[float] = None, sweep: bool = True
 ) -> List[Dict[str, Any]]:
-    """Every live entry in the spool, oldest-published first.  Entries
-    whose publish timestamp is older than ``stale_s`` (default: the
-    ``TPUSNAP_FLEET_TELEMETRY_STALE_S`` knob) are skipped — and, with
-    ``sweep``, unlinked so a long-lived spool stays bounded.  Unreadable
-    or torn entries are skipped, never fatal."""
+    """Every entry in the spool, oldest-published first.  Entries whose
+    publish timestamp is older than ``stale_s`` (default: the
+    ``TPUSNAP_FLEET_TELEMETRY_STALE_S`` knob) split by what they were
+    describing: a *finished* op's stale entry is completion debris —
+    skipped and (with ``sweep``) unlinked — while an *in-flight* op's
+    stale entry is the last sign of a worker that likely died mid-op, so
+    it is surfaced with ``_stale: True`` (rendered by ``top`` as a
+    ``suspected-dead`` row with its last-seen age, one ``fleet.peer_stale``
+    event per death, and the ``tpusnap_fleet_stale_peers`` gauge) until
+    the longer sweep horizon reclaims it.  Unreadable or torn entries are
+    skipped, never fatal."""
     if stale_s is None:
         stale_s = knobs.get_fleet_telemetry_stale_s()
     now = time.time()
     entries: List[Dict[str, Any]] = []
+    n_suspected = 0
     try:
         names = sorted(os.listdir(spool))
     except OSError:
@@ -357,15 +405,21 @@ def collect(
             continue
         age = now - float(doc.get("publish_time") or 0.0)
         if age > stale_s:
-            if sweep:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-            continue
+            op_done = bool((doc.get("op") or {}).get("done"))
+            if op_done or age > stale_s * _SUSPECT_SWEEP_FACTOR:
+                if sweep:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                continue
+            doc["_stale"] = True
+            n_suspected += 1
+            _note_peer_stale(doc, age)
         doc["_age_s"] = round(age, 3)
         doc["_file"] = name
         entries.append(doc)
+    tmetrics.record_fleet_stale_peers(n_suspected)
     entries.sort(key=lambda d: d.get("publish_time", 0.0))
     return entries
 
@@ -379,7 +433,12 @@ def _worker_row(doc: Dict[str, Any]) -> Dict[str, Any]:
     total = int(reqs.get("total") or 0)
     staged = int(reqs.get("staged") or 0)
     written = int(reqs.get("written") or 0)
-    if done:
+    if doc.get("_stale") and not done:
+        # The worker published mid-op, then went silent past the stale
+        # bound: most likely SIGKILLed/OOM-killed mid-take.  Its last
+        # beacon IS the fleet's visibility into the death.
+        state = "suspected-dead"
+    elif done:
         state = "done" if op.get("success", True) else "failed"
     elif total == 0:
         state = "planning"
@@ -417,7 +476,15 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
     publishing several op kinds must not count its cumulative counters
     twice); op-level bytes sum across all entries."""
     workers = [_worker_row(d) for d in entries]
-    live = [w for w in workers if not w["done"]]
+    suspected = [w for w in workers if w["state"] == "suspected-dead"]
+    # Suspected-dead workers are excluded from the live set: their stale
+    # ETAs/GB/s describe a process that no longer exists and would poison
+    # the straggler ranking and aggregate bandwidth.
+    live = [
+        w
+        for w in workers
+        if not w["done"] and w["state"] != "suspected-dead"
+    ]
     per_proc: Dict[str, Dict[str, Any]] = {}
     for w in workers:
         # Newest entry per process wins (entries arrive oldest-first).
@@ -475,6 +542,16 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "n_entries": len(workers),
         "n_processes": len(per_proc),
         "n_live": len(live),
+        "n_suspected_dead": len(suspected),
+        "suspected_dead": [
+            {
+                "worker": w["worker"],
+                "rank": w["rank"],
+                "kind": w["kind"],
+                "last_seen_s": w["age_s"],
+            }
+            for w in suspected
+        ],
         "workers": workers,
         "aggregate_gbps": round(sum(w["gbps"] for w in live), 3),
         "op_totals": op_totals,
@@ -515,6 +592,12 @@ def render(view: Dict[str, Any], spool: str) -> str:
         f"({_fmt_bytes(cache['origin_bytes'])} from origin); "
         f"telemetry overhead {view['proc_totals']['overhead_s']:.3f}s"
     )
+    for dead in view.get("suspected_dead") or ():
+        lines.append(
+            f"SUSPECTED DEAD: {dead['worker']} rank {dead['rank']} "
+            f"({dead['kind']}) — last seen {dead['last_seen_s']:.0f}s ago "
+            "mid-op"
+        )
     straggler = view.get("straggler")
     if straggler is not None:
         eta = straggler["eta_s"]
@@ -636,4 +719,14 @@ def render_prometheus(entries: List[Dict[str, Any]]) -> str:
     )
     lines.append("# TYPE tpusnap_fleet_origin_bytes gauge")
     lines.append(f"tpusnap_fleet_origin_bytes {view['cache']['origin_bytes']}")
+    if "tpusnap_fleet_stale_peers" not in fams:
+        # (skip when a merged worker registry already carries the family —
+        # a duplicate TYPE line is invalid exposition)
+        lines.append(
+            "# HELP tpusnap_fleet_stale_peers Spool entries for in-flight "
+            "ops whose publisher went silent past the stale bound "
+            "(suspected-dead workers)"
+        )
+        lines.append("# TYPE tpusnap_fleet_stale_peers gauge")
+        lines.append(f"tpusnap_fleet_stale_peers {view['n_suspected_dead']}")
     return "\n".join(lines) + "\n"

@@ -463,6 +463,19 @@ def record_cache_wait(seconds: float) -> None:
     ).inc(max(0.0, float(seconds)))
 
 
+def record_fleet_stale_peers(count: int) -> None:
+    """Gauge of spool entries whose op looks dead (published mid-op, then
+    silent past the stale bound) as of the collector's latest pass —
+    `tpusnap top`'s suspected-dead rows, scrapeable."""
+    if not enabled():
+        return
+    gauge(
+        "tpusnap_fleet_stale_peers",
+        "Fleet-telemetry entries for in-flight ops whose publisher went "
+        "silent past the stale bound (suspected-dead workers)",
+    ).set(float(max(0, count)))
+
+
 def record_telemetry_overhead(seconds: float) -> None:
     """Self-metering for the fleet telemetry plane (fleet.py): the wall
     each spool publish costs the op that performed it.  The observability
@@ -590,6 +603,8 @@ BRIDGED_EVENTS = frozenset(
 DIRECT_METRIC_EVENTS = frozenset(
     {
         "scheduler.write_retry",  # record_pipeline_retry("write")
+        "scheduler.read_retry",  # record_pipeline_retry("read")
+        "fleet.peer_stale",  # record_fleet_stale_peers
         "restore_latest.fallback",  # record_restore_fallback
         "gc.orphan_removed",  # record_gc("orphan_removed")
         "gc.chunk_removed",  # record_gc("chunk_removed")

@@ -246,13 +246,23 @@ class OpMonitor:
             if self._heartbeat_path:
                 self._write_heartbeat()
             if self._fleet:
+                from .. import preemption
+
                 now = time.monotonic()
+                # Deadline mode sheds the periodic cadence but NOT
+                # liveness: beacons drop to half the fleet stale bound, so
+                # a worker mid-emergency-flush never ages into `top`'s
+                # suspected-dead row while it is doing exactly the right
+                # thing (a full shed outlasting the stale bound would).
+                interval = knobs.get_fleet_telemetry_interval_s()
+                if preemption.deadline_active():
+                    interval = max(
+                        interval, knobs.get_fleet_telemetry_stale_s() / 2.0
+                    )
                 if now >= self._fleet_next and fleet.within_overhead_budget(
                     self, now - self._begin
                 ):
-                    self._fleet_next = (
-                        now + knobs.get_fleet_telemetry_interval_s()
-                    )
+                    self._fleet_next = now + interval
                     fleet.publish(self)
             if self._stall_timeout_s <= 0:
                 continue
@@ -499,6 +509,14 @@ def op_finished(mon: Optional[OpMonitor], success: bool = True) -> None:
         except ValueError:
             return  # already finished
     mon.finish(success)
+
+
+def active_ops() -> List[OpMonitor]:
+    """Snapshot of every operation currently being monitored (the
+    preemption flush watcher polls this to decide when the in-flight
+    saves have all reached a terminal state)."""
+    with _LOCK:
+        return list(_ACTIVE)
 
 
 def current() -> Optional[OpMonitor]:

@@ -32,6 +32,13 @@ SCHEMA_VERSION = "1.0"
 
 
 def enabled() -> bool:
+    from .. import preemption
+
+    # Deadline mode (preemption.py): the sidecar is the definition of
+    # non-essential — one more storage write between the flush and its
+    # commit.  Shed it until the process is past the emergency.
+    if preemption.deadline_active():
+        return False
     return knobs.sidecar_enabled()
 
 
