@@ -517,6 +517,12 @@ def test_native_abi_repo_contract():
         "tpusnap_write_parts_hash_batch",
         "tpusnap_direct_io_configure",
         "tpusnap_direct_io_mode",
+        # Round 15: content-defined chunk boundaries + advanced zstd
+        # parameters — both fenced ABI surfaces (boundaries name CAS
+        # chunks; dropping either side must fail tier-1, not silently
+        # degrade forever).
+        "tpusnap_cdc_boundaries",
+        "tpusnap_zstd_encode2",
     } <= exported
     m = re.search(r"int\s+tpusnap_abi_version\s*\(\s*\)\s*\{\s*return\s+(\d+)", cc)
     assert m and int(m.group(1)) == NATIVE_ABI_VERSION

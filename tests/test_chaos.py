@@ -46,13 +46,21 @@ _MENU = [
 ]
 
 
-def _run_chaos(root: str, seed: int, n_steps: int, cas_mode: bool = False) -> None:
+def _run_chaos(
+    root: str,
+    seed: int,
+    n_steps: int,
+    cas_mode: bool = False,
+    cdc_mode: bool = False,
+) -> None:
     rng = random.Random(seed)
     mgr = SnapshotManager(root)
     committed = []
     with knobs.override_retry_base_s(0.001), knobs.override_sidecar(
         False
-    ), knobs.override_cas(cas_mode):
+    ), knobs.override_cas(cas_mode), knobs.override_cdc(
+        cdc_mode
+    ), knobs.override_cdc_params(64, 128, 256):
         for step in range(1, n_steps + 1):
             spec, must_commit = _MENU[rng.randrange(len(_MENU))]
             use_async = rng.random() < 0.25
@@ -143,6 +151,25 @@ def test_chaos_cas_fast(tmp_path):
         pytest.skip("CAS digests require the native library")
     root = str(tmp_path / "ckpts")
     _run_chaos(root, seed=20260804, n_steps=10, cas_mode=True)
+    _cas_retention_tail(root)
+
+
+def test_chaos_cdc_fast(tmp_path):
+    """Content-defined sub-chunking chaos variant: the same seeded fault
+    menu with TPUSNAP_CDC on and chunk sizes small enough that every
+    payload splits into many sub-chunks.  The classification invariant
+    inside _run_chaos now covers casx:// references part-by-part: every
+    sub-slab chunk a faulted take leaves is referenced by a committed
+    manifest or a sweepable orphan — never unclassifiable."""
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("CAS digests require the native library")
+    root = str(tmp_path / "ckpts")
+    _run_chaos(root, seed=20260805, n_steps=10, cas_mode=True, cdc_mode=True)
+
+
+def _cas_retention_tail(root):
     # Retention on a CAS root: pruning base steps reclaims only unshared
     # chunks and later steps that deduped against them still restore.
     mgr = SnapshotManager(root, max_to_keep=2)

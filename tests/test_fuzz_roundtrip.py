@@ -68,12 +68,30 @@ def _random_state(rng: random.Random, depth: int = 0):
     ],
     ids=["raw", "zstd", "zlib"],
 )
+@pytest.mark.parametrize(
+    "cdc_env",
+    # Content-defined sub-chunking changes the storage layout (casx://
+    # multi-chunk references, manifest 0.6.0) without touching restore
+    # semantics: every fuzzed shape must round-trip identically with it
+    # on.  Tiny CDC params so even fuzz-sized leaves split; CAS rides
+    # along (CDC requires it).
+    [False, True],
+    ids=["plain", "cdc"],
+)
 @pytest.mark.parametrize("seed", range(5))
-def test_fuzz_roundtrip(tmp_path, seed, compression_env, native_env, monkeypatch):
+def test_fuzz_roundtrip(
+    tmp_path, seed, compression_env, native_env, cdc_env, monkeypatch
+):
     if compression_env is not None:
         monkeypatch.setenv("TPUSNAP_COMPRESSION", compression_env)
         monkeypatch.setenv("TPUSNAP_COMPRESSION_MIN_BYTES", "0")
     monkeypatch.setenv("TPUSNAP_NATIVE", native_env)
+    if cdc_env:
+        monkeypatch.setenv("TPUSNAP_CAS", "1")
+        monkeypatch.setenv("TPUSNAP_CDC", "1")
+        monkeypatch.setenv("TPUSNAP_CDC_MIN_BYTES", "64")
+        monkeypatch.setenv("TPUSNAP_CDC_AVG_BYTES", "128")
+        monkeypatch.setenv("TPUSNAP_CDC_MAX_BYTES", "256")
     rng = random.Random(seed)
     state = {f"top{i}": _random_state(rng) for i in range(4)}
     app_state = {"s": StateDict(state)}

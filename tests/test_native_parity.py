@@ -366,6 +366,43 @@ def test_zstd_cross_decode_matrix():
     assert n == len(payload) and bytes(out) == payload
 
 
+def test_zstd_ldm_window_log_cross_decode():
+    """The long-distance-matching / window-log knobs (ROADMAP 4c) produce
+    STANDARD zstd frames: an LDM-encoded frame decodes through the plain
+    native decoder (and the wheel where present) to the same bytes, and on
+    repeat-heavy payloads LDM+window never loses to the plain encode."""
+    from torchsnapshot_tpu import compression, knobs
+
+    native = _native_with_zstd()
+    if not native.has_zstd_params:
+        pytest.skip("native zstd advanced API unavailable")
+    # A repeat at 2 MB distance: inside a 27-bit window, far outside a
+    # level-1 small window — exactly what LDM exists to find.
+    block = np.random.RandomState(5).bytes(2 << 20)
+    payload = block + b"\x00" * 4096 + block
+
+    with knobs.override_zstd_ldm(True), knobs.override_zstd_window_log(24):
+        ldm_frame, inner = compression.encode(payload, "zstd")
+    assert inner == "zstd"
+    plain_frame, _ = compression.encode(payload, "zstd")
+    # Both decode identically through the plain decoder.
+    assert bytes(compression.decode(ldm_frame, len(payload))) == payload
+    assert bytes(compression.decode(plain_frame, len(payload))) == payload
+    # The repeat is invisible to the small window, found by LDM.
+    assert len(ldm_frame) < len(plain_frame)
+    try:
+        import zstandard
+    except ImportError:
+        return  # wheel leg of the matrix skips
+    body = bytes(memoryview(ldm_frame)[compression.HEADER_BYTES :])
+    assert (
+        zstandard.ZstdDecompressor().decompress(
+            body, max_output_size=len(payload)
+        )
+        == payload
+    )
+
+
 def test_zstd_resolves_native_first_and_degrades(monkeypatch):
     """The codec registry resolves zstd through the native backend (no
     wheel or dev headers required); with the native plane knobbed off and

@@ -164,6 +164,36 @@ def test_tsan_concurrent_striped_hash_shared_buffer():
     _assert_clean(proc)
 
 
+def test_tsan_concurrent_cdc_over_shared_staged_buffer():
+    """Several threads running content-defined boundary scans over ONE
+    shared staged buffer (the CAS writer chunking concurrent payloads
+    that alias the same memory): the striped candidate scan fans out over
+    the shared pool, so per-stripe candidate vectors + TaskSet bookkeeping
+    interleave across calls.  Boundaries must also be identical across
+    threads — a race in the scan would show up as divergent cuts even if
+    TSAN missed it."""
+    _preflight("tsan")
+    proc = _run_driver(
+        "tsan",
+        """
+        buf = os.urandom(24 << 20)  # 3 pool stripes per scan
+        results = []
+        lock = threading.Lock()
+        def leg():
+            ends = io.cdc_boundaries(buf, 65536, 262144, 1 << 20)
+            with lock:
+                results.append(tuple(ends))
+        threads = [threading.Thread(target=leg) for _ in range(6)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert len(set(results)) == 1, [len(r) for r in results]
+        assert results[0][-1] == len(buf)
+        print('DRIVER_OK')
+        """,
+    )
+    _assert_clean(proc)
+
+
 def test_tsan_concurrent_ranged_reads_with_verify():
     """Parallel multi-range reads with fused per-range hashing from
     multiple threads against one file."""

@@ -158,6 +158,26 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Churn-within-slab journal efficiency (churned bytes / appended
+        # bytes, 1.0 = perfect append ∝ churn): the content-defined
+        # sub-chunking acceptance number.  Its own gated series so a
+        # regression back toward whole-slab re-writes (efficiency ~0.1)
+        # fails the trajectory gate like any throughput loss —
+        # detect_regression maps value → 1/value cost, which works for
+        # any higher-is-better metric.
+        slab = (aux.get("journal_probe") or {}).get("slab_mode") or {}
+        churn_eff = slab.get("churn_efficiency")
+        if isinstance(churn_eff, (int, float)):
+            records.append(
+                {
+                    "series": f"{bank}:journal_slab_churn_efficiency:{backend}",
+                    "round": rnd,
+                    "value": float(churn_eff),
+                    "unit": "churn/append",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
     return records
 
 

@@ -126,7 +126,7 @@ def _cas_line(md) -> str:
     logical = 0
     seen = set()
     for _, entry in iter_payload_entries(md.manifest):
-        if not cas.is_cas_location(entry.location):
+        if not cas.is_chunk_location(entry.location):
             continue
         byte_range = getattr(entry, "byte_range", None)
         key = (entry.location, tuple(byte_range) if byte_range else None)
@@ -135,6 +135,14 @@ def _cas_line(md) -> str:
         seen.add(key)
         nbytes = getattr(entry, "compressed_nbytes", None) or _entry_size(entry)
         logical += nbytes
+        if cas.is_casx_location(entry.location):
+            # Sub-chunked reference: exact per-chunk physical sizes are
+            # embedded in the location itself.
+            for algo, hexdigest, part_nbytes in cas.parse_casx_location(
+                entry.location
+            ):
+                chunk_bytes[f"{algo}/{hexdigest}"] = part_nbytes
+            continue
         end = byte_range[1] if byte_range else nbytes
         chunk_bytes[entry.location] = max(
             chunk_bytes.get(entry.location, 0), end

@@ -119,50 +119,48 @@ def _batch_write_requests_impl(
     if len(batchable) < 2:
         return entries, write_reqs
 
-    # Greedy packing preserving plan order; slabs capped at the threshold.
-    out_reqs = passthrough
-    slab: List[Tuple[WriteReq, TensorEntry, int]] = []
-    slab_bytes = 0
+    # The slab-boundary decision lives in chunker.plan_slabs (greedy
+    # plan-order packing capped at the threshold).  These are STRUCTURAL
+    # boundaries only — with the CAS layer's content-defined sub-chunking
+    # on (TPUSNAP_CDC), the physical chunk edges inside each slab come
+    # from the rolling hash at write time, so frozen bytes dedup
+    # regardless of how members landed in slabs.
+    from . import chunker
 
-    def _flush() -> None:
-        nonlocal slab, slab_bytes
-        if not slab:
-            return
+    out_reqs = passthrough
+
+    def _emit(slab: List[Tuple[WriteReq, TensorEntry, int]]) -> None:
         if len(slab) == 1:
             out_reqs.append(slab[0][0])
-        else:
-            # Deterministic location (digest of the member paths): two
-            # snapshots of the same app state produce identically-named
-            # slabs, so incremental saves can dedup an unchanged slab by
-            # path+checksum — a uuid name would defeat dedup for every
-            # payload under the slab threshold.  Member sets are disjoint
-            # within one snapshot, so names cannot collide.
-            member_key = "|".join(wr.path for wr, _, _ in slab).encode()
-            location = f"batched/{hashlib.sha1(member_key).hexdigest()[:24]}"
-            offset = 0
-            members: List[Tuple[BufferStager, int, int]] = []
-            for wr, entry, nbytes in slab:
-                entry.location = location
-                entry.byte_range = [offset, offset + nbytes]
-                members.append((wr.buffer_stager, offset, nbytes))
-                offset += nbytes
-            out_reqs.append(
-                WriteReq(
-                    path=location,
-                    buffer_stager=BatchedBufferStager(
-                        members=members, total=offset, scatter_ok=scatter_ok
-                    ),
-                )
+            return
+        # Deterministic location (digest of the member paths): two
+        # snapshots of the same app state produce identically-named
+        # slabs, so incremental saves can dedup an unchanged slab by
+        # path+checksum — a uuid name would defeat dedup for every
+        # payload under the slab threshold.  Member sets are disjoint
+        # within one snapshot, so names cannot collide.
+        member_key = "|".join(wr.path for wr, _, _ in slab).encode()
+        location = f"batched/{hashlib.sha1(member_key).hexdigest()[:24]}"
+        offset = 0
+        members: List[Tuple[BufferStager, int, int]] = []
+        for wr, entry, nbytes in slab:
+            entry.location = location
+            entry.byte_range = [offset, offset + nbytes]
+            members.append((wr.buffer_stager, offset, nbytes))
+            offset += nbytes
+        out_reqs.append(
+            WriteReq(
+                path=location,
+                buffer_stager=BatchedBufferStager(
+                    members=members, total=offset, scatter_ok=scatter_ok
+                ),
             )
-        slab = []
-        slab_bytes = 0
+        )
 
-    for item in batchable:
-        if slab_bytes + item[2] > slab_threshold:
-            _flush()
-        slab.append(item)
-        slab_bytes += item[2]
-    _flush()
+    for group, _ in chunker.plan_slabs(
+        batchable, [nbytes for _, _, nbytes in batchable], slab_threshold
+    ):
+        _emit(group)
     logger.debug(
         "Batcher: %d small writes coalesced into %d slabs (%d passthrough)",
         len(batchable),

@@ -115,13 +115,23 @@ def snapshot_fingerprint(metadata: Any) -> str:
 def full_key_for(namespace: str, path: str) -> Tuple[str, Optional[str]]:
     """``(full-object cache key, expected digest or None)`` for a storage
     path.  CAS locations key on their digest (namespace-independent —
-    chunks are immutable and shared across snapshots); everything else
-    keys under the snapshot fingerprint."""
+    chunks are immutable and shared across snapshots); ``casx://``
+    multi-chunk locations key on a digest of the location itself, which
+    IS a content identity (an ordered digest list), so two snapshots
+    referencing the same sub-chunked payload share one cache entry and a
+    re-saved step can never alias.  Everything else keys under the
+    snapshot fingerprint."""
     from . import cas
 
     if cas.is_cas_location(path):
         algo, hexdigest = cas.parse_cas_location(path)
         return f"cas/{algo}/{hexdigest}", f"{algo}:{hexdigest}"
+    if cas.is_casx_location(path):
+        spec = hashlib.sha1(path.encode("utf-8")).hexdigest()[:24]
+        # No whole-entry expected digest: the per-part digests live in the
+        # location; full-entry reads still self-digest-verify like every
+        # non-CAS entry.
+        return f"casx/{spec}", None
     return f"obj/{namespace}/{path}", None
 
 

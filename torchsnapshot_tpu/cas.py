@@ -100,6 +100,88 @@ def relpath_for_location(location: str) -> str:
     return chunk_relpath(algo, hexdigest)
 
 
+# Multi-chunk (content-defined sub-slab) reference: the payload's bytes are
+# the concatenation of several CAS chunks, split on FastCDC edges
+# (chunker.py) so the edges survive member insertion/growth and frozen
+# bytes dedup regardless of slab packing.  Format:
+#
+#     casx://<algo>/<hex>@<nbytes>+<hex>@<nbytes>+...
+#
+# Part lengths are embedded so ranged reads resolve to chunk sub-ranges
+# without a stat per part.  A part whose digest algorithm deviates from
+# the head algo (a >= STRIPED_MIN_BYTES part under a large max-size knob
+# hashes as "xxh64s") is written ``<algo>:<hex>@<nbytes>``.  Manifests
+# containing casx references declare version 0.6.0
+# (manifest.CDC_MANIFEST_VERSION); 0.1–0.5 readers reject them cleanly.
+CASX_SCHEME = "casx://"
+
+
+def is_casx_location(location: Any) -> bool:
+    return isinstance(location, str) and location.startswith(CASX_SCHEME)
+
+
+def is_chunk_location(location: Any) -> bool:
+    """Whether a manifest location references the content-addressed store
+    at all — a whole chunk (``cas://``) or sub-chunks (``casx://``)."""
+    return is_cas_location(location) or is_casx_location(location)
+
+
+def parse_casx_location(location: str) -> List[Tuple[str, str, int]]:
+    """``casx://...`` → ordered ``[(algo, hexdigest, nbytes), ...]``."""
+    body = location[len(CASX_SCHEME) :]
+    head_algo, sep, spec = body.partition("/")
+    if not sep or not head_algo or not spec:
+        raise ValueError(f"malformed casx location: {location!r}")
+    parts: List[Tuple[str, str, int]] = []
+    for token in spec.split("+"):
+        algo = head_algo
+        if ":" in token:
+            algo, _, token = token.partition(":")
+        hexdigest, sep, nbytes = token.partition("@")
+        if not sep or not hexdigest or not algo:
+            raise ValueError(f"malformed casx part {token!r} in {location!r}")
+        parts.append((algo, hexdigest, int(nbytes)))
+    if not parts:
+        raise ValueError(f"malformed casx location: {location!r}")
+    return parts
+
+
+def casx_location_for(parts: List[Tuple[str, str, int]]) -> str:
+    """The ``casx://`` string for ordered (algo, hexdigest, nbytes) parts.
+    A single part collapses to a plain ``cas://`` reference — one chunk is
+    one chunk, whichever path produced it."""
+    if len(parts) == 1:
+        return location_for(parts[0][0], parts[0][1])
+    head_algo = parts[0][0]
+    tokens = []
+    for algo, hexdigest, nbytes in parts:
+        prefix = "" if algo == head_algo else f"{algo}:"
+        tokens.append(f"{prefix}{hexdigest}@{nbytes}")
+    return f"{CASX_SCHEME}{head_algo}/" + "+".join(tokens)
+
+
+def chunk_relpaths_of_location(location: str) -> List[str]:
+    """Every chunk relpath a (cas or casx) location references, in part
+    order."""
+    if is_cas_location(location):
+        return [relpath_for_location(location)]
+    return [
+        chunk_relpath(algo, hexdigest)
+        for algo, hexdigest, _ in parse_casx_location(location)
+    ]
+
+
+def chunk_keys_of_location(location: str) -> List[str]:
+    """Digest-index keys of every chunk a (cas or casx) location
+    references."""
+    if is_cas_location(location):
+        return [_digest_key(*parse_cas_location(location))]
+    return [
+        _digest_key(algo, hexdigest)
+        for algo, hexdigest, _ in parse_casx_location(location)
+    ]
+
+
 def _digest_key(algo: str, hexdigest: str) -> str:
     return f"{algo}/{hexdigest}"
 
@@ -131,19 +213,21 @@ def manifest_uses_cas(manifest: Dict[str, Any]) -> bool:
     from .manifest import iter_payload_entries
 
     return any(
-        is_cas_location(entry.location)
+        is_chunk_location(entry.location)
         for _, entry in iter_payload_entries(manifest)
     )
 
 
 def referenced_chunk_relpaths(manifest: Dict[str, Any]) -> Set[str]:
-    """Root-relative chunk paths a manifest's entries reference."""
+    """Root-relative chunk paths a manifest's entries reference —
+    including every sub-chunk of ``casx://`` references (refcounting that
+    missed one would let prune/gc sweep live bytes)."""
     from .manifest import iter_payload_entries
 
     out: Set[str] = set()
     for _, entry in iter_payload_entries(manifest):
-        if is_cas_location(entry.location):
-            out.add(relpath_for_location(entry.location))
+        if is_chunk_location(entry.location):
+            out.update(chunk_relpaths_of_location(entry.location))
     return out
 
 
@@ -151,15 +235,36 @@ def referenced_chunk_relpaths(manifest: Dict[str, Any]) -> Set[str]:
 
 
 class DigestIndex:
-    """Digests known to be durable chunks in the root's CAS store.
+    """Digests known to be durable chunks in the root's CAS store, plus a
+    whole-payload map powering streaming delta detection.
 
-    Seeded from the root's committed manifests (the CAS analogue of
+    ``keys`` — chunk digests (``<algo>/<hex>``), seeded from the root's
+    committed manifests (the CAS analogue of
     ``incremental.checksums_by_location``) and maintained as this take
-    writes new chunks.  Thread-safe: the scheduler's event loop and the
-    sync repack path both consult it."""
+    writes new chunks.
 
-    def __init__(self, keys: Optional[Set[str]] = None) -> None:
+    ``payloads`` — recorded whole-payload digest (the manifest
+    ``checksum`` string) → ``(location, byte_range)``: exactly what a
+    manifest entry whose staged bytes hash to that digest may reference as
+    a pure metadata hit.  Stagers consult this BEFORE batching,
+    compression, and scheduler dispatch (:func:`prestage_delta_skip`), so
+    an unchanged leaf costs one hash and zero write-pipeline requests.
+    Lookups self-validate: a hit whose chunks were swept since recording
+    (prune/gc discarded their keys) is dropped instead of returned, so a
+    stale payload entry can never mint a dangling reference.
+
+    Thread-safe: the scheduler's event loop and the sync repack path both
+    consult it."""
+
+    def __init__(
+        self,
+        keys: Optional[Set[str]] = None,
+        payloads: Optional[Dict[str, Tuple[str, Optional[Tuple[int, int]]]]] = None,
+    ) -> None:
         self._keys: Set[str] = set(keys or ())
+        self._payloads: Dict[str, Tuple[str, Optional[Tuple[int, int]]]] = dict(
+            payloads or {}
+        )
         self._lock = threading.Lock()
 
     def __contains__(self, key: str) -> bool:
@@ -173,13 +278,61 @@ class DigestIndex:
     def discard(self, key: str) -> None:
         """Forget a digest whose chunk was swept (prune/gc) — a later take
         of the same bytes must re-probe/rewrite instead of dedup-hitting a
-        deleted chunk."""
+        deleted chunk.  Payload entries referencing the chunk invalidate
+        lazily at lookup time (``lookup_payload`` re-checks every chunk
+        key)."""
         with self._lock:
             self._keys.discard(key)
+
+    def record_payload(
+        self,
+        digest: Optional[str],
+        location: str,
+        byte_range: Optional[Tuple[int, int]] = None,
+    ) -> None:
+        """Remember that a payload hashing to ``digest`` is durably stored
+        as ``location`` (+ optional byte range into it)."""
+        if not digest:
+            return
+        with self._lock:
+            self._payloads[digest] = (
+                location,
+                tuple(byte_range) if byte_range else None,
+            )
+
+    def lookup_payload(
+        self, digest: Optional[str]
+    ) -> Optional[Tuple[str, Optional[Tuple[int, int]]]]:
+        """(location, byte_range) a payload with this digest may reference,
+        or None.  Validates that every chunk the location references is
+        still indexed — a sweep since recording drops the entry here rather
+        than handing out a dangling reference."""
+        if not digest:
+            return None
+        with self._lock:
+            hit = self._payloads.get(digest)
+            if hit is None:
+                return None
+            try:
+                keys = chunk_keys_of_location(hit[0])
+            except ValueError:
+                keys = None
+            if not keys or any(k not in self._keys for k in keys):
+                del self._payloads[digest]
+                return None
+            return hit
+
+    def payload_count(self) -> int:
+        with self._lock:
+            return len(self._payloads)
 
     def snapshot_keys(self) -> Set[str]:
         with self._lock:
             return set(self._keys)
+
+    def snapshot_payloads(self) -> Dict[str, Tuple[str, Optional[Tuple[int, int]]]]:
+        with self._lock:
+            return dict(self._payloads)
 
     def __len__(self) -> int:
         with self._lock:
@@ -206,6 +359,7 @@ def seed_digest_index(
     from .storage_plugin import url_to_storage_plugin
 
     keys: Set[str] = set()
+    payloads: Dict[str, Tuple[str, Optional[Tuple[int, int]]]] = {}
     own = storage is None
     if own:
         try:
@@ -225,12 +379,25 @@ def seed_digest_index(
             from .manifest import iter_payload_entries
 
             for _, entry in iter_payload_entries(metadata.manifest):
-                if is_cas_location(entry.location):
-                    keys.add(_digest_key(*parse_cas_location(entry.location)))
+                if not is_chunk_location(entry.location):
+                    continue
+                for key in chunk_keys_of_location(entry.location):
+                    keys.add(key)
+                # The streaming-delta map: the entry's recorded checksum is
+                # the digest of exactly the bytes this location (+ range)
+                # serves, so a later take staging identical bytes may
+                # reference it as pure metadata.
+                checksum = getattr(entry, "checksum", None)
+                if checksum:
+                    byte_range = getattr(entry, "byte_range", None)
+                    payloads[checksum] = (
+                        entry.location,
+                        tuple(byte_range) if byte_range else None,
+                    )
     finally:
         if own:
             storage.sync_close()
-    return DigestIndex(keys)
+    return DigestIndex(keys, payloads)
 
 
 # ------------------------------------------------------- persisted index
@@ -240,7 +407,9 @@ def seed_digest_index(
 # one LIST per take instead of one GET per committed step/segment.  Dot-
 # prefixed so it is protocol metadata, never a step dir or payload.
 INDEX_SIDECAR_FNAME = ".digest_index.json"
-_INDEX_SIDECAR_VERSION = 1
+# v2 adds the whole-payload map (streaming delta detection); v1 sidecars
+# fail validation and pay one re-seed.
+_INDEX_SIDECAR_VERSION = 2
 
 
 def committed_marker_relpaths(storage: StoragePlugin) -> List[str]:
@@ -279,6 +448,12 @@ def persist_index_sidecar(
         "version": _INDEX_SIDECAR_VERSION,
         "algo": algo,
         "keys": sorted(index.snapshot_keys()),
+        "payloads": {
+            digest: [location, list(byte_range) if byte_range else None]
+            for digest, (location, byte_range) in sorted(
+                index.snapshot_payloads().items()
+            )
+        },
         "committed": committed_marker_relpaths(storage),
     }
     storage.sync_write(
@@ -322,9 +497,15 @@ def load_or_seed_index(
             doc.get("version") == _INDEX_SIDECAR_VERSION
             and doc.get("algo") == algo
             and isinstance(doc.get("keys"), list)
+            and isinstance(doc.get("payloads"), dict)
             and doc.get("committed") == committed_marker_relpaths(storage)
         ):
-            return DigestIndex(set(doc["keys"]))
+            payloads = {
+                digest: (rec[0], tuple(rec[1]) if rec[1] else None)
+                for digest, rec in doc["payloads"].items()
+                if isinstance(rec, list) and len(rec) == 2
+            }
+            return DigestIndex(set(doc["keys"]), payloads)
         logger.debug(
             "digest index sidecar stale/invalid for %s; re-seeding", root_url
         )
@@ -337,9 +518,12 @@ def load_or_seed_index(
 
 
 async def _read_via_root(root: StoragePlugin, read_io: ReadIO) -> None:
-    """Resolve one ``cas://`` read against the root store, copying the
-    result back into the caller's ReadIO — the shared resolution used by
-    both wrapper plugins."""
+    """Resolve one ``cas://``/``casx://`` read against the root store,
+    copying the result back into the caller's ReadIO — the shared
+    resolution used by both wrapper plugins."""
+    if is_casx_location(read_io.path):
+        await _read_casx_via_root(root, read_io)
+        return
     sub = ReadIO(
         path=relpath_for_location(read_io.path),
         byte_range=read_io.byte_range,
@@ -350,6 +534,63 @@ async def _read_via_root(root: StoragePlugin, read_io: ReadIO) -> None:
     await root.read(sub)
     read_io.buf = sub.buf
     read_io.hash64 = sub.hash64
+
+
+async def _read_casx_via_root(root: StoragePlugin, read_io: ReadIO) -> None:
+    """Assemble a ``casx://`` (multi-chunk) read: fetch the sub-ranges of
+    exactly the chunks the requested byte range intersects, concatenated in
+    part order.  Ranged slab-member reads therefore fetch only their
+    overlapping chunks.  No fused digest is returned (``hash64`` stays
+    None): the recorded checksum covers the whole logical payload, and the
+    consumer verifies it over the assembled bytes."""
+    import asyncio
+
+    import numpy as np
+
+    parts = parse_casx_location(read_io.path)
+    total = sum(nbytes for _, _, nbytes in parts)
+    start, end = (
+        read_io.byte_range if read_io.byte_range is not None else [0, total]
+    )
+    if not (0 <= start <= end <= total):
+        raise ValueError(
+            f"byte range [{start}, {end}) outside casx payload of {total} "
+            f"bytes: {read_io.path}"
+        )
+    if read_io.into is not None and memoryview(read_io.into).nbytes == end - start:
+        out = memoryview(read_io.into).cast("B")
+    else:
+        out = memoryview(np.empty(end - start, dtype=np.uint8))
+
+    async def _one(relpath, sub_range, dst) -> None:
+        sub = ReadIO(path=relpath, byte_range=sub_range, into=dst)
+        await root.read(sub)
+        if sub.buf is not dst:
+            src = memoryview(sub.buf).cast("B")
+            if src.nbytes != dst.nbytes:
+                raise RuntimeError(
+                    f"casx part {relpath}[{sub_range[0]}:{sub_range[1]}] "
+                    f"returned {src.nbytes} bytes, expected {dst.nbytes}"
+                )
+            dst[:] = src
+
+    coros = []
+    offset = 0
+    for algo, hexdigest, nbytes in parts:
+        p0, p1 = max(start, offset), min(end, offset + nbytes)
+        if p0 < p1:
+            coros.append(
+                _one(
+                    chunk_relpath(algo, hexdigest),
+                    [p0 - offset, p1 - offset],
+                    out[p0 - start : p1 - start],
+                )
+            )
+        offset += nbytes
+    if coros:
+        await asyncio.gather(*coros)
+    read_io.buf = out
+    read_io.hash64 = None
 
 
 async def _read_chunk_digest(
@@ -407,7 +648,7 @@ class CASReaderPlugin(StoragePlugin):
         return getter() if getter is not None else None
 
     async def read(self, read_io: ReadIO) -> None:
-        if not is_cas_location(read_io.path):
+        if not is_chunk_location(read_io.path):
             await self._inner.read(read_io)
             return
         await _read_via_root(self._root, read_io)
@@ -416,16 +657,34 @@ class CASReaderPlugin(StoragePlugin):
         await self._inner.write(write_io)
 
     async def exists(self, path: str) -> bool:
-        if is_cas_location(path):
-            return await self._root.exists(relpath_for_location(path))
+        if is_chunk_location(path):
+            import asyncio
+
+            # Concurrent per-part probes, like the read path's assembly:
+            # one casx existence check must not cost N serial round trips
+            # on a latency-bound backend.
+            results = await asyncio.gather(
+                *(
+                    self._root.exists(relpath)
+                    for relpath in chunk_relpaths_of_location(path)
+                )
+            )
+            return all(results)
         return await self._inner.exists(path)
 
     async def list_dir(self, path: str) -> List[str]:
         return await self._inner.list_dir(path)
 
     async def delete(self, path: str) -> None:
-        if is_cas_location(path):
-            await self._root.delete(relpath_for_location(path))
+        if is_chunk_location(path):
+            import asyncio
+
+            await asyncio.gather(
+                *(
+                    self._root.delete(relpath)
+                    for relpath in chunk_relpaths_of_location(path)
+                )
+            )
             return
         await self._inner.delete(path)
 
@@ -475,7 +734,7 @@ class CASWriterPlugin(StoragePlugin):
         self._index = index
         self._algo = algo
         self._lock = threading.Lock()
-        # path written this take → "cas://<algo>/<hex>"
+        # path written this take → "cas://<algo>/<hex>" or "casx://..."
         self.relocations: Dict[str, str] = {}
         self.dedup_hits = 0
         self.bytes_saved = 0  # logical bytes deduplicated (not written)
@@ -487,6 +746,20 @@ class CASWriterPlugin(StoragePlugin):
         # take's "bytes the crash did not cost us" number.
         self.adopted_chunks = 0
         self.adopted_bytes = 0
+        # Streaming delta detection (prestage_delta_skip): leaves resolved
+        # to pure manifest references BEFORE batching/compression/dispatch
+        # — they never reach this plugin's write() at all — plus digests
+        # the prestage pass computed for MISSED leaves, reused at write
+        # time so a changed non-slabbed leaf hashes once, not twice.
+        self.prestage_hits = 0
+        self.prestage_bytes = 0
+        self._prestaged: Dict[str, Tuple[str, int]] = {}
+        # Content-defined sub-chunking (chunker.py, TPUSNAP_CDC): per-part
+        # accounting for payloads split on FastCDC edges.
+        self.cdc_payloads = 0
+        self.cdc_chunks = 0
+        self.cdc_dedup_hits = 0
+        self.cdc_bytes_saved = 0
         self._closed = False
 
     def _get_executor(self):
@@ -507,6 +780,23 @@ class CASWriterPlugin(StoragePlugin):
             or path.startswith("telemetry/")
         )
 
+    def note_prestaged(self, path: str, digest: str, nbytes: int) -> None:
+        """Remember the digest the prestage pass computed for a MISSED
+        (changed) leaf, so its write here skips the second hash pass —
+        valid only while the request kept its path (slabbed members write
+        under the slab path and never match)."""
+        with self._lock:
+            self._prestaged[path] = (digest, nbytes)
+
+    def record_prestage_hit(self, nbytes: int) -> None:
+        """Account one leaf resolved to a pure manifest reference before
+        the pipeline (the leaf never reaches write())."""
+        with self._lock:
+            self.prestage_hits += 1
+            self.prestage_bytes += nbytes
+            self.dedup_hits += 1
+            self.bytes_saved += nbytes
+
     async def write(self, write_io: WriteIO) -> None:
         if not self._is_payload_path(write_io.path):
             await self._inner.write(write_io)
@@ -517,12 +807,20 @@ class CASWriterPlugin(StoragePlugin):
         from . import integrity
 
         buf = write_io.buf
+        with self._lock:
+            prestaged = self._prestaged.pop(write_io.path, None)
 
         def _hash() -> Optional[str]:
             # contiguous() joins a slab ScatterBuffer once; the join is
             # covered by the staging cost (supports_scatter=False above).
             nonlocal buf
             buf = contiguous(buf)
+            if (
+                prestaged is not None
+                and prestaged[1] == memoryview(buf).nbytes
+            ):
+                # The prestage pass hashed these exact bytes already.
+                return prestaged[0]
             # digest(), not compute(): content addressing must work even
             # when save-side checksum RECORDING is knobbed off.
             return integrity.digest(buf)
@@ -542,19 +840,132 @@ class CASWriterPlugin(StoragePlugin):
             )
             await self._inner.write(write_io)
             return
+        nbytes = memoryview(buf).nbytes
+
+        from . import chunker
+
+        view = memoryview(buf).cast("B")
+        # Content-defined sub-chunking: payloads bigger than one max-size
+        # chunk split on FastCDC edges so an insertion re-writes only the
+        # edit-overlapping chunks.  Compression frames are exempt (their
+        # bytes mix under the codec; CDC over them never resynchronizes) —
+        # detected by the self-describing frame magic.
+        from .compression import MAGIC as _FRAME_MAGIC
+
+        if chunker.should_split(nbytes) and bytes(view[:4]) != _FRAME_MAGIC:
+            location = await self._write_cdc(view, nbytes, executor)
+            if location is not None:
+                with self._lock:
+                    self.relocations[write_io.path] = location
+                self._index.record_payload(digest, location, None)
+                return
+            # CDC degraded (no digest backend for a part — can't happen
+            # while the whole-payload digest above succeeded, but stay
+            # safe): fall through to the whole-chunk path.
+
         # The digest tag names the algorithm ("xxh64" small chunks,
         # "xxh64s" striped large ones) — the chunk's CAS namespace must
         # match its content's actual algo, not the configured default, or
         # the name↔content invariant (_verify_chunk) breaks.
         algo, _, hexdigest = digest.partition(":")
+        await self._store_chunk(view, algo, hexdigest, digest, nbytes, executor)
+        location = location_for(algo, hexdigest)
+        with self._lock:
+            self.relocations[write_io.path] = location
+        self._index.record_payload(digest, location, None)
+
+    async def _write_cdc(
+        self, view: memoryview, nbytes: int, executor
+    ) -> Optional[str]:
+        """Split ``view`` on content-defined edges and store each sub-chunk
+        (dedup / adopt / write, same trust ladder as whole chunks).
+        Returns the ``casx://`` (or collapsed ``cas://``) location, or None
+        when a part's digest could not be computed."""
+        import asyncio
+
+        from . import chunker, integrity, phase_stats
+
+        loop = asyncio.get_running_loop()
+        with phase_stats.timed("cdc_chunk", nbytes):
+            ends = await loop.run_in_executor(executor, chunker.boundaries, view)
+        parts = chunker.split(view, ends)
+        digests = await asyncio.gather(
+            *(loop.run_in_executor(executor, integrity.digest, p) for p in parts)
+        )
+        if any(d is None for d in digests):
+            return None
+        # Stores run concurrently under a bound: chunk keys are independent
+        # (the index/stats are lock-protected, duplicate in-flight keys are
+        # write-identical), and a large slab as N sequential probe+PUT
+        # round-trips would serialize what used to be one big write —
+        # latency-bound backends (object stores) care.  The bound keeps one
+        # payload from monopolizing the plugin's connection pool; the
+        # scheduler's io semaphore still governs cross-payload concurrency.
+        sem = asyncio.Semaphore(4)
+
+        async def _store_one(part, digest) -> Tuple[str, str, int]:
+            algo, _, hexdigest = digest.partition(":")
+            async with sem:
+                await self._store_chunk(
+                    part,
+                    algo,
+                    hexdigest,
+                    digest,
+                    part.nbytes,
+                    executor,
+                    cdc=True,
+                )
+            return algo, hexdigest, part.nbytes
+
+        tasks = [
+            asyncio.ensure_future(_store_one(p, d))
+            for p, d in zip(parts, digests)
+        ]
+        try:
+            spec: List[Tuple[str, str, int]] = list(
+                await asyncio.gather(*tasks)
+            )
+        except BaseException:
+            # Cancel-and-drain the sibling stores before re-raising (the
+            # scheduler's own teardown idiom): a raw gather would leave
+            # suspended coroutines for the GC to kill mid-await —
+            # "coroutine ignored GeneratorExit" noise at best, a
+            # semaphore/executor leak wedging the loop at worst.
+            for t in tasks:
+                if not t.done():
+                    t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        with self._lock:
+            self.cdc_payloads += 1
+            self.cdc_chunks += len(parts)
+        return casx_location_for(spec)
+
+    async def _store_chunk(
+        self,
+        view,
+        algo: str,
+        hexdigest: str,
+        digest: str,
+        nbytes: int,
+        executor,
+        cdc: bool = False,
+    ) -> None:
+        """The one chunk-store ladder: committed-index hit (pure dedup) →
+        content-verified orphan adoption → durable write with
+        delete-debris-on-failure.  Updates the counters; callers record
+        relocations/payloads themselves."""
         key = _digest_key(algo, hexdigest)
         relpath = chunk_relpath(algo, hexdigest)
-        nbytes = memoryview(buf).nbytes
-
         if key in self._index:
             # Referenced by a committed manifest (or written earlier this
             # take): the chunk is durable and immutable — pure dedup.
-            self._record_hit(write_io.path, algo, hexdigest, nbytes)
+            with self._lock:
+                self.dedup_hits += 1
+                self.bytes_saved += nbytes
+                if cdc:
+                    self.cdc_dedup_hits += 1
+                    self.cdc_bytes_saved += nbytes
             return
         if await self._probe_existing(relpath, digest, executor):
             # Resumable take: the chunk exists but no committed manifest
@@ -565,14 +976,18 @@ class CASWriterPlugin(StoragePlugin):
             with self._lock:
                 self.adopted_chunks += 1
                 self.adopted_bytes += nbytes
-            self._record_hit(write_io.path, algo, hexdigest, nbytes)
+                self.dedup_hits += 1
+                self.bytes_saved += nbytes
+                if cdc:
+                    self.cdc_dedup_hits += 1
+                    self.cdc_bytes_saved += nbytes
             return
         try:
             # durable=True: tmp+fsync+rename on fs — a chunk is only ever
             # visible complete, which is what makes sharing it across
             # concurrent takes safe (PR 3's commit machinery).
             await self._root.write(
-                WriteIO(path=relpath, buf=buf, durable=True)
+                WriteIO(path=relpath, buf=view, durable=True)
             )
         except BaseException:
             # A failed attempt may have left debris (a torn write through a
@@ -592,9 +1007,6 @@ class CASWriterPlugin(StoragePlugin):
         with self._lock:
             self.chunks_written += 1
             self.bytes_written += nbytes
-            self.relocations[write_io.path] = location_for(
-                algo, hexdigest
-            )
 
     async def _delete_if_mismatched(
         self, relpath: str, digest: str, executor
@@ -636,12 +1048,6 @@ class CASWriterPlugin(StoragePlugin):
             return False
         return True
 
-    def _record_hit(self, path: str, algo: str, hexdigest: str, nbytes: int) -> None:
-        with self._lock:
-            self.dedup_hits += 1
-            self.bytes_saved += nbytes
-            self.relocations[path] = location_for(algo, hexdigest)
-
     def stats(self) -> Dict[str, int]:
         with self._lock:
             physical = self.bytes_written
@@ -654,12 +1060,18 @@ class CASWriterPlugin(StoragePlugin):
                 "logical_bytes": physical + saved,
                 "adopted_chunks": self.adopted_chunks,
                 "adopted_bytes": self.adopted_bytes,
+                "prestage_hits": self.prestage_hits,
+                "prestage_bytes": self.prestage_bytes,
+                "cdc_payloads": self.cdc_payloads,
+                "cdc_chunks": self.cdc_chunks,
+                "cdc_dedup_hits": self.cdc_dedup_hits,
+                "cdc_bytes_saved": self.cdc_bytes_saved,
             }
 
     # ------------------------------------------------------------ plugin API
 
     async def read(self, read_io: ReadIO) -> None:
-        if is_cas_location(read_io.path):
+        if is_chunk_location(read_io.path):
             await _read_via_root(self._root, read_io)
             return
         await self._inner.read(read_io)
@@ -693,6 +1105,11 @@ class CASWriterPlugin(StoragePlugin):
             self._closed = True
             hits, saved = self.dedup_hits, self.bytes_saved
             written, wbytes = self.chunks_written, self.bytes_written
+            prestage_hits = self.prestage_hits
+            prestage_bytes = self.prestage_bytes
+            cdc_chunks = self.cdc_chunks
+            cdc_hits = self.cdc_dedup_hits
+            cdc_saved = self.cdc_bytes_saved
         if not (hits or written):
             return
         from .event import Event
@@ -700,6 +1117,8 @@ class CASWriterPlugin(StoragePlugin):
         from .telemetry import metrics as tmetrics
 
         tmetrics.record_cas_dedup(hits, saved)
+        tmetrics.record_cdc(cdc_chunks, cdc_hits, cdc_saved)
+        tmetrics.record_cas_prestage(prestage_hits, prestage_bytes)
         log_event(
             Event(
                 name="cas.dedup",
@@ -708,14 +1127,20 @@ class CASWriterPlugin(StoragePlugin):
                     "bytes_saved": saved,
                     "chunks_written": written,
                     "bytes_written": wbytes,
+                    "prestage_hits": prestage_hits,
+                    "prestage_bytes": prestage_bytes,
+                    "cdc_chunks": cdc_chunks,
+                    "cdc_dedup_hits": cdc_hits,
+                    "cdc_bytes_saved": cdc_saved,
                 },
             )
         )
         logger.info(
-            "CAS: %d payloads deduplicated (%.1f MB saved), %d new chunks "
-            "(%.1f MB written)",
+            "CAS: %d payloads deduplicated (%.1f MB saved, %d prestage-"
+            "skipped), %d new chunks (%.1f MB written)",
             hits,
             saved / 1e6,
+            prestage_hits,
             written,
             wbytes / 1e6,
         )
@@ -824,12 +1249,177 @@ def apply_relocations(storage: StoragePlugin, entries: Dict[str, Any]) -> None:
         if new_location is not None:
             entry.location = new_location
             rewritten += 1
+        # Feed the streaming-delta map with every entry-level digest —
+        # including SLAB MEMBERS (location + byte_range + the member's
+        # own checksum, annotated by the write-time hash sinks).  This is
+        # what lets the next save's prestage pass resolve an unchanged
+        # small leaf to its committed slab sub-range without the manager
+        # ever re-seeding from manifests.
+        checksum = getattr(entry, "checksum", None)
+        if checksum and is_chunk_location(entry.location):
+            byte_range = getattr(entry, "byte_range", None)
+            writer._index.record_payload(
+                checksum,
+                entry.location,
+                tuple(byte_range) if byte_range else None,
+            )
     logger.debug("CAS: rewrote %d manifest entry locations", rewritten)
 
 
 def writer_stats(storage: StoragePlugin) -> Optional[Dict[str, int]]:
     writer = find_writer(storage)
     return writer.stats() if writer is not None else None
+
+
+# ------------------------------------------------- streaming delta detection
+
+
+def prestage_delta_skip(
+    storage: StoragePlugin,
+    entries: Dict[str, Any],
+    write_reqs: List[Any],
+) -> Tuple[List[Any], Optional[Dict[str, int]]]:
+    """Consult the incremental :class:`DigestIndex` at stage time — BEFORE
+    batching, compression, and scheduler dispatch — and resolve unchanged
+    leaves to pure manifest references.
+
+    For every raw buffer-protocol array request: stage the host bytes (one
+    D2H for device arrays), hash them, and look the digest up in the
+    index's whole-payload map (seeded from the root's committed manifests
+    and maintained across this manager's saves).  A hit rewrites the entry
+    to the committed ``cas://``/``casx://`` location (+ byte range for
+    former slab members) and DROPS the write request: the leaf never
+    enters the write pipeline — zero batching, zero compression, zero
+    scheduler traffic, zero storage requests.  This is what turns the
+    journal's per-step diff from hash-everything-through-the-pipeline into
+    one hash per leaf.  A miss remembers the digest on the CAS writer so
+    the changed leaf hashes once, not twice.
+
+    Returns ``(remaining_write_reqs, stats_or_None)``.  No-op (and free)
+    when CAS is off, the index has no payload map yet (first take into an
+    empty root — probing would only double-stage everything), or nothing
+    qualifies."""
+    writer = find_writer(storage)
+    if writer is None:
+        return write_reqs, None
+    index = writer._index
+    if index.payload_count() == 0:
+        return write_reqs, None
+
+    import numpy as np
+
+    from . import integrity, knobs, serialization
+    from .batcher import _index_tensor_entries
+    from .compression import is_framed
+    from .io_preparers.array import ArrayBufferStager
+    from .serialization import Serializer
+    from .telemetry import trace as ttrace
+
+    entry_index = _index_tensor_entries(entries)
+
+    def _probe(wr):
+        """(entry, digest, nbytes) when the leaf qualifies and hashed, else
+        None.  Reads the stager's still-held object without consuming the
+        stager (a miss restages normally in the pipeline)."""
+        stager = wr.buffer_stager
+        if not isinstance(stager, ArrayBufferStager):
+            return None
+        entry = entry_index.get(wr.path)
+        if (
+            entry is None
+            or entry.serializer != Serializer.BUFFER_PROTOCOL.value
+            or is_framed(entry)
+            or entry.byte_range is not None
+        ):
+            return None
+        obj = getattr(stager, "_obj", None)
+        if obj is None:
+            return None
+        try:
+            host = np.asarray(obj)
+            mv = serialization.array_as_memoryview(host)
+        except Exception:
+            return None
+        digest = integrity.digest(mv)
+        if digest is None:
+            return None
+        return entry, digest, mv.nbytes
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    from . import staging
+
+    kept: List[Any] = []
+    hits = 0
+    hit_bytes = 0
+    probed = 0
+    record_checksums = integrity.save_checksums_enabled()
+
+    def _apply(wr, res) -> None:
+        nonlocal hits, hit_bytes, probed
+        if res is None:
+            kept.append(wr)
+            return
+        entry, digest, nbytes = res
+        probed += 1
+        hit = index.lookup_payload(digest)
+        if hit is None:
+            writer.note_prestaged(wr.path, digest, nbytes)
+            kept.append(wr)
+            return
+        location, byte_range = hit
+        entry.location = location
+        entry.byte_range = (
+            list(byte_range) if byte_range is not None else None
+        )
+        if record_checksums:
+            entry.checksum = digest
+        writer.record_prestage_hit(nbytes)
+        hits += 1
+        hit_bytes += nbytes
+
+    # Device-backed leaves probe ONE AT A TIME: each probe materializes a
+    # leaf-sized host copy (a real D2H) outside the scheduler's memory
+    # budget, so the bound must be one leaf, not threads × leaf.
+    # Host-backed leaves (np arrays, whose asarray is a zero-copy view)
+    # keep the thread pool — their probe cost is pure GIL-released
+    # hashing.  A changed DEVICE leaf pays its D2H twice (probe + stage);
+    # that is the documented trade for the frozen-majority case this pass
+    # exists for.
+    device_reqs = [
+        wr
+        for wr in write_reqs
+        if staging.is_jax_array(getattr(wr.buffer_stager, "_obj", None))
+    ]
+    device_set = set(map(id, device_reqs))
+    host_reqs = [wr for wr in write_reqs if id(wr) not in device_set]
+    results: Dict[int, Any] = {}
+    with ttrace.span("prestage_delta", n_reqs=len(write_reqs)):
+        with ThreadPoolExecutor(
+            max_workers=max(2, knobs.get_staging_threads() or 4),
+            thread_name_prefix="snap_prestage",
+        ) as pool:
+            for wr, res in zip(host_reqs, pool.map(_probe, host_reqs)):
+                results[id(wr)] = res
+        for wr in device_reqs:
+            results[id(wr)] = _probe(wr)
+        # Apply in the original request order so downstream slab grouping
+        # (plan-order packing) stays deterministic across steps.
+        for wr in write_reqs:
+            _apply(wr, results[id(wr)])
+    if hits:
+        logger.debug(
+            "prestage delta detection: %d/%d leaves unchanged "
+            "(%.1f MB skip the write pipeline)",
+            hits,
+            probed,
+            hit_bytes / 1e6,
+        )
+    return kept, {
+        "probed": probed,
+        "hits": hits,
+        "hit_bytes": hit_bytes,
+    }
 
 
 # --------------------------------------------------------------- chunk sweep
@@ -979,27 +1569,23 @@ def _repack_step_to_cas(
         manifest_version_for,
     )
 
+    from . import chunker
+    from .compression import MAGIC as _FRAME_MAGIC
+
     # location → entries sharing it (slab members, replicated references).
     by_location: Dict[str, List[Any]] = {}
     for _, entry in iter_payload_entries(metadata.manifest):
-        if not is_cas_location(entry.location):
+        if not is_chunk_location(entry.location):
             by_location.setdefault(entry.location, []).append(entry)
     relocated: List[str] = []
-    for location, entries in sorted(by_location.items()):
-        read_io = ReadIO(path=f"{step_name}/{location}")
-        root.sync_read(read_io)
-        digest = integrity.digest(read_io.buf)
-        if digest is None:
-            raise RuntimeError(
-                "repack requires the native xxh64 library (content "
-                "addressing is impossible without digests)"
-            )
-        # Chunk algo from the digest tag ("xxh64s" for striped large
-        # payloads), matching the write path's naming.
+
+    def _store(view, digest) -> Tuple[str, str]:
+        """One chunk into the store (content-verified dedup or durable
+        write) — the sync twin of the writer's _store_chunk ladder."""
         algo, _, hexdigest = digest.partition(":")
         key = _digest_key(algo, hexdigest)
         relpath = chunk_relpath(algo, hexdigest)
-        nbytes = memoryview(read_io.buf).nbytes
+        nbytes = memoryview(view).nbytes
         # Existence alone must not be trusted here: repack DELETES the
         # per-step originals afterwards, so deduplicating against a torn
         # chunk (a crashed take's debris) would destroy the only good copy.
@@ -1009,14 +1595,46 @@ def _repack_step_to_cas(
             stats["dedup_hits"] += 1
             stats["bytes_saved"] += nbytes
         else:
-            root.sync_write(
-                WriteIO(path=relpath, buf=read_io.buf, durable=True)
-            )
+            root.sync_write(WriteIO(path=relpath, buf=view, durable=True))
             stats["chunks_written"] += 1
             stats["bytes_written"] += nbytes
         index.add(key)
+        return algo, hexdigest
+
+    for location, entries in sorted(by_location.items()):
+        read_io = ReadIO(path=f"{step_name}/{location}")
+        root.sync_read(read_io)
+        digest = integrity.digest(read_io.buf)
+        if digest is None:
+            raise RuntimeError(
+                "repack requires the native xxh64 library (content "
+                "addressing is impossible without digests)"
+            )
+        nbytes = memoryview(read_io.buf).nbytes
+        view = memoryview(read_io.buf).cast("B")
+        # The CDC migration path: with TPUSNAP_CDC on, repack splits large
+        # payloads on content-defined edges exactly like the write path,
+        # converting a whole-slab-chunk root to the sub-chunked layout.
+        if chunker.should_split(nbytes) and bytes(view[:4]) != _FRAME_MAGIC:
+            ends = chunker.boundaries(view)
+            spec: List[Tuple[str, str, int]] = []
+            for part in chunker.split(view, ends):
+                part_digest = integrity.digest(part)
+                if part_digest is None:
+                    raise RuntimeError(
+                        "repack requires the native xxh64 library"
+                    )
+                algo, hexdigest = _store(part, part_digest)
+                spec.append((algo, hexdigest, part.nbytes))
+            new_location = casx_location_for(spec)
+        else:
+            # Chunk algo from the digest tag ("xxh64s" for striped large
+            # payloads), matching the write path's naming.
+            algo, hexdigest = _store(view, digest)
+            new_location = location_for(algo, hexdigest)
+        index.record_payload(digest, new_location, None)
         for entry in entries:
-            entry.location = location_for(algo, hexdigest)
+            entry.location = new_location
         relocated.append(location)
     if not relocated:
         return 0
@@ -1060,17 +1678,46 @@ def _export_step_from_cas(
 
     by_location: Dict[str, List[Any]] = {}
     for _, entry in iter_payload_entries(metadata.manifest):
-        if is_cas_location(entry.location):
+        if is_chunk_location(entry.location):
             by_location.setdefault(entry.location, []).append(entry)
     if not by_location:
         return
     for location, entries in sorted(by_location.items()):
-        _, hexdigest = parse_cas_location(location)
-        read_io = ReadIO(path=relpath_for_location(location))
-        root.sync_read(read_io)
-        dst = f"{EXPORT_DIR}/{hexdigest}"
+        if is_casx_location(location):
+            # Sub-chunked payload: materialize the concatenation back into
+            # the step as one self-contained file, named by the digest of
+            # the joined bytes — content-addressed, so two casx references
+            # to identical bytes share one exported file.
+            parts = parse_casx_location(location)
+            views = []
+            for algo, hexdigest, _ in parts:
+                part_io = ReadIO(path=chunk_relpath(algo, hexdigest))
+                root.sync_read(part_io)
+                views.append(bytes(part_io.buf))
+            payload: Any = b"".join(views)
+            from . import integrity
+
+            joined = integrity.digest(payload)
+            if joined is None:
+                # Same hard requirement as the pack direction: without a
+                # digest backend the export name cannot be content-derived,
+                # and any shorthand (first part + count) can collide
+                # between distinct payloads — silent corruption, not a
+                # degradation.
+                raise RuntimeError(
+                    "repack --export requires the native xxh64 library "
+                    "(content-derived file names are impossible without "
+                    "digests)"
+                )
+            dst = f"{EXPORT_DIR}/{joined.partition(':')[2]}"
+        else:
+            _, hexdigest = parse_cas_location(location)
+            read_io = ReadIO(path=relpath_for_location(location))
+            root.sync_read(read_io)
+            payload = read_io.buf
+            dst = f"{EXPORT_DIR}/{hexdigest}"
         root.sync_write(
-            WriteIO(path=f"{step_name}/{dst}", buf=read_io.buf, durable=True)
+            WriteIO(path=f"{step_name}/{dst}", buf=payload, durable=True)
         )
         for entry in entries:
             entry.location = dst

@@ -1,0 +1,288 @@
+"""Chunk-boundary decisions: plan-time slab packing + content-defined chunking.
+
+Two kinds of boundary live here, extracted from the places that used to
+hard-code them:
+
+1. **Structural (plan-time)**: :func:`plan_slabs` — the greedy
+   pack-members-into-slabs decision the batcher applies to small writes
+   (formerly inlined in ``batcher.py``).  Purely metadata: member sizes are
+   known from dtype×shape before any byte is staged.
+
+2. **Content-defined (write-time)**: :func:`boundaries` — FastCDC-style
+   rolling-hash chunking (gear hash, normalized two-mask selection, à la
+   restic/casync) over staged bytes.  The CAS writer (cas.py) splits large
+   payloads and slabs on these edges instead of storing one
+   slab-granularity chunk, so chunk boundaries *survive insertions*: when
+   one member of a 128 MB slab grows by K bytes, every chunk edge after the
+   edit re-synchronizes within ~one chunk, and only the chunks overlapping
+   the edit are new bytes.  This retires the "slabs dedup whole" caveat
+   (docs/performance.md, Deduplication).
+
+The rolling hash runs on the native worker pool (``tpusnap_cdc_boundaries``
+in ``_native/tpustore.cc``) at memory bandwidth; the pure-Python fallback
+here (vectorized gear-hash candidate scan + the same selection walk) is
+REQUIRED to produce byte-identical boundaries — both sides derive the gear
+table from the same splitmix64 seed, and the parity is pinned by
+tests/test_cdc.py.  Boundaries name CAS chunks, so a divergence between the
+two implementations would silently fork the dedup namespace.
+
+Algorithm (frozen — changing any constant changes every boundary):
+
+- ``GEAR[256]``: u64 table from splitmix64 seeded with ``_GEAR_SEED``.
+- Rolling hash from the START of the buffer: ``h_0 = GEAR[b_0]``,
+  ``h_i = (h_{i-1} << 1) + GEAR[b_i]  (mod 2^64)``.  Because the shift
+  ages contributions out of the 64-bit word, ``h_i`` depends only on the
+  trailing 64 bytes — the window that makes edges content-local (and lets
+  the native side stripe the scan with a 63-byte warm-up per stripe).
+- Selection (FastCDC normalization): with ``bits = floor(log2(avg))``,
+  ``mask_s = (1 << min(bits + 2, 62)) - 1`` applies up to the average
+  point, ``mask_l = (1 << max(bits - 2, 1)) - 1`` beyond it; a candidate
+  at index ``i`` cuts a chunk end at ``i + 1``; chunks are forced at
+  ``max`` and never end before ``min`` (except the buffer tail).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+# Seed of the gear table.  Part of the boundary definition: never change
+# without introducing a new location scheme (chunk names derive from the
+# boundaries these tables produce).
+_GEAR_SEED = 0x7470_7573_6E61_7031  # "tpusnap1"
+_M64 = (1 << 64) - 1
+
+_GEAR = None
+
+
+def gear_table():
+    """The 256-entry u64 gear table (numpy), derived deterministically from
+    ``_GEAR_SEED`` via splitmix64 — mirrored bit-for-bit by the native
+    implementation."""
+    global _GEAR
+    if _GEAR is None:
+        import numpy as np
+
+        out = np.empty(256, dtype=np.uint64)
+        x = _GEAR_SEED
+        for i in range(256):
+            x = (x + 0x9E3779B97F4A7C15) & _M64
+            z = x
+            z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+            z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+            out[i] = (z ^ (z >> 31)) & _M64
+        _GEAR = out
+    return _GEAR
+
+
+def masks_for(avg_size: int) -> Tuple[int, int]:
+    """(mask_s, mask_l) for an average chunk size — the normalized two-mask
+    selection: stricter before the average point, looser after."""
+    bits = avg_size.bit_length() - 1
+    mask_s = (1 << min(bits + 2, 62)) - 1
+    mask_l = (1 << max(bits - 2, 1)) - 1
+    return mask_s, mask_l
+
+
+def params() -> Tuple[int, int, int]:
+    """(min, avg, max) chunk sizes from the ``TPUSNAP_CDC_*`` knobs,
+    validated (64 <= min < avg <= max)."""
+    from . import knobs
+
+    return knobs.get_cdc_params()
+
+
+def should_split(nbytes: int, max_size: Optional[int] = None) -> bool:
+    """Whether a staged payload of ``nbytes`` gets content-defined
+    sub-chunking: the knob is on AND the payload exceeds one max-size
+    chunk (smaller payloads stay whole chunks — their own digest already
+    is a stable content-defined identity)."""
+    from . import knobs
+
+    if not knobs.cdc_enabled():
+        return False
+    if max_size is None:
+        max_size = params()[2]
+    return nbytes > max_size
+
+
+# Candidate scan block: bounds the numpy fallback's temporaries (the gear
+# image + rolling-hash accumulator are 16 bytes per input byte).
+_PY_BLOCK = 1 << 22
+
+
+def _candidates_py(view, mask_s: int, mask_l: int):
+    """(indices, s_flags): every index i with ``(h_i & mask_l) == 0``
+    (ascending) and whether it also satisfies the strict mask.  mask_s's
+    bits are a superset of mask_l's, so S-candidates ⊆ L-candidates and
+    one scan finds both."""
+    import numpy as np
+
+    data = np.frombuffer(view, dtype=np.uint8)
+    n = data.size
+    gear = gear_table()
+    idx_parts: List = []
+    flag_parts: List = []
+    m_l = np.uint64(mask_l)
+    m_s = np.uint64(mask_s)
+    for start in range(0, n, _PY_BLOCK):
+        stop = min(n, start + _PY_BLOCK)
+        lo = max(0, start - 63)
+        g = gear[data[lo:stop]]
+        # h_i = sum_{j=0..63} GEAR[b_{i-j}] << j (mod 2^64): contributions
+        # older than 63 shifts vanish from the 64-bit word, so a 63-byte
+        # context prefix makes every in-block value exact.
+        h = g.copy()
+        for j in range(1, 64):
+            np.add(
+                h[j:], g[:-j] << np.uint64(j), out=h[j:], casting="unsafe"
+            )
+        hh = h[start - lo :]
+        cand = np.flatnonzero((hh & m_l) == 0)
+        if cand.size:
+            idx_parts.append(cand.astype(np.int64) + start)
+            flag_parts.append((hh[cand] & m_s) == 0)
+    if not idx_parts:
+        return (
+            np.empty(0, dtype=np.int64),
+            np.empty(0, dtype=bool),
+        )
+    return np.concatenate(idx_parts), np.concatenate(flag_parts)
+
+
+def _walk(
+    n: int,
+    cand_idx,
+    cand_s,
+    min_size: int,
+    avg_size: int,
+    max_size: int,
+) -> List[int]:
+    """The selection walk shared (by specification) with the native side:
+    chunk ends from the candidate stream, enforcing min/avg/max."""
+    import numpy as np
+
+    ends: List[int] = []
+    last = 0
+    while n - last > min_size:
+        window_end = min(last + max_size, n)
+        norm_end = min(last + avg_size, window_end)
+        cut = 0
+        lo = int(np.searchsorted(cand_idx, last + min_size - 1, side="left"))
+        hi = int(np.searchsorted(cand_idx, norm_end - 1, side="right"))
+        for k in range(lo, hi):
+            if cand_s[k]:
+                cut = int(cand_idx[k]) + 1
+                break
+        if cut == 0:
+            hi2 = int(
+                np.searchsorted(cand_idx, window_end - 1, side="right")
+            )
+            if hi2 > hi:
+                cut = int(cand_idx[hi]) + 1
+        if cut == 0:
+            # No candidate: force a max-size chunk mid-buffer; at the tail
+            # the remainder is one chunk.
+            cut = window_end if window_end < n else n
+        ends.append(cut)
+        last = cut
+    if last < n:
+        ends.append(n)
+    return ends
+
+
+def boundaries_py(
+    view, min_size: int, avg_size: int, max_size: int
+) -> List[int]:
+    """Pure-Python (numpy-vectorized) chunk ends — the byte-identical
+    fallback for ``TPUSNAP_NATIVE=0`` / stale-library hosts."""
+    _validate(min_size, avg_size, max_size)
+    mv = memoryview(view)
+    if not mv.c_contiguous:
+        mv = memoryview(bytes(mv))
+    mv = mv.cast("B")
+    n = mv.nbytes
+    if n == 0:
+        return []
+    if n <= min_size:
+        return [n]
+    mask_s, mask_l = masks_for(avg_size)
+    cand_idx, cand_s = _candidates_py(mv, mask_s, mask_l)
+    return _walk(n, cand_idx, cand_s, min_size, avg_size, max_size)
+
+
+def _validate(min_size: int, avg_size: int, max_size: int) -> None:
+    if not (64 <= min_size < avg_size <= max_size):
+        raise ValueError(
+            "CDC parameters must satisfy 64 <= min < avg <= max, got "
+            f"min={min_size} avg={avg_size} max={max_size}"
+        )
+
+
+def boundaries(
+    view,
+    min_size: Optional[int] = None,
+    avg_size: Optional[int] = None,
+    max_size: Optional[int] = None,
+) -> List[int]:
+    """Content-defined chunk END offsets of ``view`` (ascending, last ==
+    len) under the knobbed (or given) min/avg/max.  Native when the worker
+    pool exports ``tpusnap_cdc_boundaries``; the Python fallback produces
+    identical values (pinned by tests/test_cdc.py)."""
+    if min_size is None or avg_size is None or max_size is None:
+        k_min, k_avg, k_max = params()
+        min_size = k_min if min_size is None else min_size
+        avg_size = k_avg if avg_size is None else avg_size
+        max_size = k_max if max_size is None else max_size
+    _validate(min_size, avg_size, max_size)
+    from .native_io import NativeFileIO
+
+    native = NativeFileIO.maybe_create()
+    if native is not None and native.has_cdc:
+        return native.cdc_boundaries(view, min_size, avg_size, max_size)
+    return boundaries_py(view, min_size, avg_size, max_size)
+
+
+def split(view, ends: Sequence[int]) -> List[memoryview]:
+    """The chunk views of ``view`` given its boundary ends."""
+    mv = memoryview(view)
+    if not mv.c_contiguous:
+        mv = memoryview(bytes(mv))
+    mv = mv.cast("B")
+    out: List[memoryview] = []
+    last = 0
+    for end in ends:
+        out.append(mv[last:end])
+        last = end
+    return out
+
+
+# ------------------------------------------------------- plan-time slabs
+
+
+def plan_slabs(items: Sequence, sizes: Sequence[int], threshold: int):
+    """Greedy plan-order packing of ``items`` into slabs capped at
+    ``threshold`` bytes — the structural boundary decision the batcher
+    applies to small batchable writes (moved here from ``batcher.py`` so
+    every chunk-boundary policy lives in one module).  Returns a list of
+    (item-list, total-bytes) groups, preserving plan order.
+
+    Deliberately order-preserving, not content-aware: with the CAS layer's
+    content-defined sub-chunking on, the physical chunk edges inside each
+    slab come from :func:`boundaries`, so the slab grouping only has to be
+    deterministic, not stable under membership changes."""
+    groups = []
+    group: List = []
+    group_bytes = 0
+    for item, nbytes in zip(items, sizes):
+        if group and group_bytes + nbytes > threshold:
+            groups.append((group, group_bytes))
+            group = []
+            group_bytes = 0
+        group.append(item)
+        group_bytes += nbytes
+    if group:
+        groups.append((group, group_bytes))
+    return groups

@@ -139,6 +139,67 @@ def _zstd_backends():
     return native, (_ZSTD_WHEEL or None)
 
 
+def _zstd_params() -> Tuple[int, bool]:
+    """(window_log, enable_ldm) from the ``TPUSNAP_ZSTD_*`` knobs —
+    (0, False) means plain level-only encoding (today's path)."""
+    from . import knobs
+
+    return knobs.get_zstd_window_log(), knobs.zstd_ldm_enabled()
+
+
+def _zstd_encode_into(native, mv, out, level) -> Optional[int]:
+    """Native zstd encode of ``mv`` into ``out``, honoring the advanced
+    knobs (window log / long-distance matching) when set.  Ancient
+    backends without the cctx API degrade to the plain encode with a
+    one-time warning — frames are standard either way, only the match
+    window shrinks."""
+    from .native_io import NativeZstdError
+
+    window_log, ldm = _zstd_params()
+    if window_log or ldm:
+        if native.has_zstd_params:
+            try:
+                return native.zstd_encode2_into(
+                    mv, out, level, window_log, ldm
+                )
+            except NativeZstdError:
+                # An ancient libzstd without the cctx API reports itself
+                # here (rc -3); degrade to the plain encode below.
+                pass
+        if "zstd-params" not in _WARNED:
+            _WARNED.add("zstd-params")
+            logger.warning(
+                "TPUSNAP_ZSTD_WINDOW_LOG/TPUSNAP_ZSTD_LDM requested but the "
+                "zstd backend lacks the advanced API; encoding with the "
+                "plain level-only path"
+            )
+    return native.zstd_encode_into(mv, out, level)
+
+
+def _wheel_zstd_compressor(wheel, level):
+    """A wheel compressor honoring the advanced knobs when set (and
+    constructible); plain level compressor otherwise."""
+    window_log, ldm = _zstd_params()
+    if window_log or ldm:
+        try:
+            params = wheel.ZstdCompressionParameters.from_level(
+                level,
+                window_log=window_log or 0,
+                enable_ldm=bool(ldm),
+            )
+            return wheel.ZstdCompressor(compression_params=params)
+        except Exception:
+            if "zstd-params-wheel" not in _WARNED:
+                _WARNED.add("zstd-params-wheel")
+                logger.warning(
+                    "zstandard wheel rejected the advanced parameters "
+                    "(window_log=%s ldm=%s); encoding level-only",
+                    window_log,
+                    ldm,
+                )
+    return wheel.ZstdCompressor(level=level)
+
+
 def _make_zstd() -> Optional[_Codec]:
     native, wheel = _zstd_backends()
     if native is None and wheel is None:
@@ -156,7 +217,7 @@ def _make_zstd() -> Optional[_Codec]:
             # (_native_codec_frame) and never reaches here.
             out = bytearray(mv.nbytes + (mv.nbytes >> 8) + 1024)
             try:
-                n = native.zstd_encode_into(mv, memoryview(out), level)
+                n = _zstd_encode_into(native, mv, memoryview(out), level)
             except NativeZstdError:
                 n = None
                 native = None  # real failure: fall through to the wheel
@@ -164,7 +225,7 @@ def _make_zstd() -> Optional[_Codec]:
                 del out[n:]
                 return out
         if wheel is not None:
-            return wheel.ZstdCompressor(level=level).compress(data)
+            return _wheel_zstd_compressor(wheel, level).compress(data)
         raise RuntimeError("no zstd backend available (native or wheel)")
 
     def _decompress(data, uncompressed_len):
@@ -341,7 +402,12 @@ def _native_codec_frame(mv, usize: int, codec: _Codec, level: Optional[int]):
     elif codec.name == "zstd":
         if not native.has_zstd:
             return False
-        encode_into = native.zstd_encode_into
+
+        # Routed through the advanced-parameter shim so the window-log /
+        # LDM knobs apply to the large-payload frame path too.
+        def encode_into(src, dst, level):
+            return _zstd_encode_into(native, src, dst, level)
+
     else:
         return False
     import numpy as np

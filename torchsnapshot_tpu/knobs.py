@@ -76,6 +76,13 @@ PARTIAL_READ_MIN_SAVED_ENV_VAR = _ENV_PREFIX + "PARTIAL_READ_MIN_SAVED_BYTES"
 LEASE_INTERVAL_S_ENV_VAR = _ENV_PREFIX + "LEASE_INTERVAL_S"
 LEASE_GRACE_S_ENV_VAR = _ENV_PREFIX + "LEASE_GRACE_S"
 SAVE_DEADLINE_S_ENV_VAR = _ENV_PREFIX + "SAVE_DEADLINE_S"
+CDC_ENV_VAR = _ENV_PREFIX + "CDC"
+CDC_MIN_BYTES_ENV_VAR = _ENV_PREFIX + "CDC_MIN_BYTES"
+CDC_AVG_BYTES_ENV_VAR = _ENV_PREFIX + "CDC_AVG_BYTES"
+CDC_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "CDC_MAX_BYTES"
+STAGING_THREADS_ENV_VAR = _ENV_PREFIX + "STAGING_THREADS"
+ZSTD_WINDOW_LOG_ENV_VAR = _ENV_PREFIX + "ZSTD_WINDOW_LOG"
+ZSTD_LDM_ENV_VAR = _ENV_PREFIX + "ZSTD_LDM"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -981,6 +988,110 @@ def override_lease_grace_s(value: float) -> Generator[None, None, None]:
 @contextmanager
 def override_save_deadline_s(value: float) -> Generator[None, None, None]:
     with _override_env(SAVE_DEADLINE_S_ENV_VAR, str(value)):
+        yield
+
+
+# Content-defined chunking defaults (chunker.py / cas.py): FastCDC-style
+# min/avg/max chunk sizes.  1 MB average balances dedup granularity (an
+# edit re-writes ~avg bytes) against manifest/chunk-count overhead; the
+# 4x spread between min and max is the normalized-chunking sweet spot the
+# FastCDC paper converges on.  Payloads at or below one max-size chunk
+# stay whole chunks — their own digest is already a content-defined
+# identity.
+_DEFAULT_CDC_MIN_BYTES = 256 * 1024
+_DEFAULT_CDC_AVG_BYTES = 1024 * 1024
+_DEFAULT_CDC_MAX_BYTES = 4 * 1024 * 1024
+
+
+def cdc_enabled() -> bool:
+    """Whether the CAS writer splits large payloads/slabs on content-defined
+    (FastCDC-style rolling hash) chunk edges instead of storing them as one
+    slab-granularity chunk (``TPUSNAP_CDC``, off by default).  Requires
+    ``TPUSNAP_CAS=1`` to have any effect.  Sub-chunked manifests declare
+    version 0.6.0, which pre-CDC readers reject cleanly."""
+    return _get_bool_env(CDC_ENV_VAR)
+
+
+def get_cdc_params() -> "tuple[int, int, int]":
+    """(min, avg, max) content-defined chunk sizes from the
+    ``TPUSNAP_CDC_{MIN,AVG,MAX}_BYTES`` knobs, validated: chunk boundaries
+    define CAS chunk names, so nonsensical parameters fail loudly instead
+    of silently forking the dedup namespace."""
+    min_b = _get_int_env(CDC_MIN_BYTES_ENV_VAR, _DEFAULT_CDC_MIN_BYTES)
+    avg_b = _get_int_env(CDC_AVG_BYTES_ENV_VAR, _DEFAULT_CDC_AVG_BYTES)
+    max_b = _get_int_env(CDC_MAX_BYTES_ENV_VAR, _DEFAULT_CDC_MAX_BYTES)
+    if not (64 <= min_b < avg_b <= max_b):
+        raise ValueError(
+            f"TPUSNAP_CDC_*_BYTES must satisfy 64 <= min < avg <= max, "
+            f"got min={min_b} avg={avg_b} max={max_b}"
+        )
+    return min_b, avg_b, max_b
+
+
+@contextmanager
+def override_cdc(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(CDC_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_cdc_params(
+    min_bytes: int, avg_bytes: int, max_bytes: int
+) -> Generator[None, None, None]:
+    with _override_env(CDC_MIN_BYTES_ENV_VAR, str(min_bytes)), _override_env(
+        CDC_AVG_BYTES_ENV_VAR, str(avg_bytes)
+    ), _override_env(CDC_MAX_BYTES_ENV_VAR, str(max_bytes)):
+        yield
+
+
+def get_staging_threads() -> int:
+    """Pinned size of the scheduler's staging executor
+    (``TPUSNAP_STAGING_THREADS``), or 0 (the default) for automatic
+    sizing: 4 threads normally, widened to min(16, cores) when the
+    resolved compression codec is real — compressed saves are
+    staging-executor-bound (the codecs release the GIL, so more threads
+    are more encode bandwidth), while raw saves are storage-bound and
+    extra threads only add contention."""
+    return max(0, _get_int_env(STAGING_THREADS_ENV_VAR, 0))
+
+
+@contextmanager
+def override_staging_threads(value: int) -> Generator[None, None, None]:
+    with _override_env(STAGING_THREADS_ENV_VAR, str(value)):
+        yield
+
+
+def get_zstd_window_log() -> int:
+    """zstd match-window log2 override (``TPUSNAP_ZSTD_WINDOW_LOG``), or 0
+    (the default) for the level's own default.  Clamped to [10, 27]:
+    27 is the largest window every decoder accepts without opt-in, and the
+    point of raising it is long-range matching across a whole staged slab
+    — the many-similar-chunks fleet case."""
+    val = _get_int_env(ZSTD_WINDOW_LOG_ENV_VAR, 0)
+    if val <= 0:
+        return 0
+    return min(max(val, 10), 27)
+
+
+def zstd_ldm_enabled() -> bool:
+    """Whether zstd long-distance matching is requested
+    (``TPUSNAP_ZSTD_LDM``): finds repeats beyond the regular match window
+    — worth ~free ratio on checkpoint streams with many similar chunks.
+    Applied through the native advanced API (or the zstandard wheel's
+    compression parameters); hosts with neither degrade to the plain
+    encode with a one-time warning.  Frames stay standard zstd frames."""
+    return _get_bool_env(ZSTD_LDM_ENV_VAR)
+
+
+@contextmanager
+def override_zstd_window_log(value: int) -> Generator[None, None, None]:
+    with _override_env(ZSTD_WINDOW_LOG_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_zstd_ldm(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(ZSTD_LDM_ENV_VAR, "1" if enabled else None):
         yield
 
 

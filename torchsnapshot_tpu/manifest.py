@@ -501,11 +501,19 @@ CAS_MANIFEST_VERSION = "0.4.0"
 # to restore a delta outside the replay path (Snapshot.restore guards on
 # ``metadata.journal``).
 JOURNAL_MANIFEST_VERSION = "0.5.0"
+# Snapshots whose entries reference content-defined SUB-chunks
+# (``casx://<algo>/<hex>@<n>+...`` locations, cas.py) declare 0.6.0: the
+# payload bytes are the concatenation of several CAS chunks split on
+# FastCDC edges, which a 0.4/0.5 reader would treat as one malformed
+# ``cas://`` reference and fail confusingly.  0.1–0.5 readers reject 0.6.0
+# cleanly via the from_json version validation below.
+CDC_MANIFEST_VERSION = "0.6.0"
 SUPPORTED_MANIFEST_VERSIONS = (
     MANIFEST_VERSION,
     FRAMED_MANIFEST_VERSION,
     CAS_MANIFEST_VERSION,
     JOURNAL_MANIFEST_VERSION,
+    CDC_MANIFEST_VERSION,
 )
 
 
@@ -530,18 +538,23 @@ def iter_payload_entries(manifest: "Manifest"):
 
 
 def manifest_version_for(manifest: "Manifest") -> str:
-    """The version a manifest must declare: ``CAS_MANIFEST_VERSION`` when any
-    payload is a digest reference into the content-addressed store,
-    ``FRAMED_MANIFEST_VERSION`` when any payload is frame-encoded, else the
-    base ``MANIFEST_VERSION``."""
-    from .cas import is_cas_location
+    """The version a manifest must declare: ``CDC_MANIFEST_VERSION`` when
+    any payload is a multi-chunk (content-defined sub-slab) reference,
+    ``CAS_MANIFEST_VERSION`` when any payload is a whole-chunk digest
+    reference into the content-addressed store, ``FRAMED_MANIFEST_VERSION``
+    when any payload is frame-encoded, else the base ``MANIFEST_VERSION``."""
+    from .cas import is_cas_location, is_casx_location
     from .compression import is_framed
 
     framed = False
+    cas = False
     for _, entry in iter_payload_entries(manifest):
-        if is_cas_location(entry.location):
-            return CAS_MANIFEST_VERSION
+        if is_casx_location(entry.location):
+            return CDC_MANIFEST_VERSION
+        cas = cas or is_cas_location(entry.location)
         framed = framed or is_framed(entry)
+    if cas:
+        return CAS_MANIFEST_VERSION
     return FRAMED_MANIFEST_VERSION if framed else MANIFEST_VERSION
 
 

@@ -260,6 +260,36 @@ class NativeFileIO:
         self.has_direct_io = _bind(
             "tpusnap_direct_io_configure", ctypes.c_int, [ctypes.c_int]
         ) and _bind("tpusnap_direct_io_mode", ctypes.c_int, [])
+        self.has_cdc = _bind(
+            "tpusnap_cdc_boundaries",
+            ctypes.c_int64,
+            [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+            ],
+        )
+        # Advanced-parameter zstd (window log / long-distance matching).
+        # Probed independently of the basic codec pair: a stale library can
+        # have zstd without it, and the codec tier then falls back to the
+        # plain encode with a one-time warning.
+        self.has_zstd_params = _bind(
+            "tpusnap_zstd_encode2",
+            ctypes.c_int64,
+            [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_int,
+            ],
+        )
         self.has_zlib = False
         if _bind("tpusnap_has_zlib", ctypes.c_int, []):
             _bind(
@@ -583,6 +613,79 @@ class NativeFileIO:
         if n == -1:
             return None  # would not shrink below the cap
         raise NativeZstdError(f"ZSTD_compress failed (rc {int(n)})")
+
+    def cdc_boundaries(
+        self, buf, min_size: int, avg_size: int, max_size: int
+    ) -> List[int]:
+        """Content-defined chunk END offsets of ``buf`` (ascending, last ==
+        nbytes) — the gear-hash candidate scan striped across the native
+        worker pool.  Byte-identical to ``chunker.boundaries_py`` (the
+        boundaries name CAS chunks; parity is pinned by tests).  Requires
+        ``has_cdc``."""
+        import numpy as np
+
+        view = memoryview(buf)
+        if not view.c_contiguous:
+            view = memoryview(bytes(view))
+        view = view.cast("B")
+        n = view.nbytes
+        if n == 0:
+            return []
+        arr = np.frombuffer(view, np.uint8)
+        cap = n // min_size + 2
+        out = (ctypes.c_int64 * cap)()
+        rc = self._lib.tpusnap_cdc_boundaries(
+            ctypes.c_void_p(arr.ctypes.data),
+            n,
+            min_size,
+            avg_size,
+            max_size,
+            out,
+            cap,
+        )
+        if rc < 0:
+            raise ValueError(
+                f"tpusnap_cdc_boundaries failed (rc {int(rc)}) for "
+                f"min={min_size} avg={avg_size} max={max_size}"
+            )
+        return list(out[: int(rc)])
+
+    def zstd_encode2_into(
+        self, src, dst, level: int, window_log: int, enable_ldm: bool
+    ) -> Optional[int]:
+        """Native zstd encode with advanced parameters (window log /
+        long-distance matching) straight into ``dst``.  Same didn't-fit
+        contract as :meth:`zstd_encode_into` (None = store raw); raises
+        :class:`NativeZstdError` on real failures, including an ancient
+        libzstd without the cctx API — callers fall back to the plain
+        encode (standard frames either way)."""
+        if not self.has_zstd or not self.has_zstd_params:
+            raise NativeZstdError("native zstd advanced API unavailable")
+        import numpy as np
+
+        src_view = memoryview(src)
+        if not src_view.c_contiguous:
+            src_view = memoryview(bytes(src_view))
+        src_view = src_view.cast("B")
+        if src_view.nbytes == 0:
+            raise NativeZstdError("empty input")
+        dst_view = memoryview(dst)
+        src_arr = np.frombuffer(src_view, np.uint8)
+        dst_arr = np.frombuffer(dst_view, np.uint8)
+        n = self._lib.tpusnap_zstd_encode2(
+            ctypes.c_void_p(src_arr.ctypes.data),
+            src_view.nbytes,
+            ctypes.c_void_p(dst_arr.ctypes.data),
+            dst_view.nbytes,
+            int(level),
+            int(window_log),
+            1 if enable_ldm else 0,
+        )
+        if n > 0:
+            return int(n)
+        if n == -1:
+            return None  # would not shrink below the cap
+        raise NativeZstdError(f"ZSTD_compress2 failed (rc {int(n)})")
 
     def zstd_decode_into(self, src, dst) -> int:
         """Native zstd decode of one frame's payload into ``dst`` (a

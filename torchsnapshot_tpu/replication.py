@@ -478,7 +478,10 @@ def _copy_cas_snapshot(
                 {
                     e.location
                     for _, e in iter_payload_entries(md.manifest)
-                    if not cas.is_cas_location(e.location)
+                    # cas:// AND casx:// references already replicated via
+                    # the chunk union above — a casx reference read as a
+                    # literal step path would be a bogus FileNotFoundError.
+                    if not cas.is_chunk_location(e.location)
                 }
             ):
                 payload_items.append(

@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import socket
+import threading
 import time
 from collections import deque
 from concurrent.futures import Executor, ThreadPoolExecutor
@@ -65,6 +66,80 @@ logger = logging.getLogger(__name__)
 _MAX_PER_RANK_MEMORY_BUDGET_BYTES = 32 * 1024 * 1024 * 1024
 _AVAILABLE_MEMORY_MULTIPLIER = 0.6
 _NUM_EXECUTOR_THREADS = 4
+# Ceiling of the compression-aware automatic executor sizing below.
+_MAX_EXECUTOR_THREADS = 16
+
+# Requests handed to the write/read pipelines this process, by verb — the
+# observable the streaming-delta acceptance rests on: an unchanged leaf
+# must cost ZERO pipeline requests (it was resolved to a manifest
+# reference before dispatch), which this counter proves without scraping
+# metrics.  Monotonic; tests snapshot-and-diff around an operation.
+# Lock-guarded: pipelines run on per-op background threads, and a bare
+# `+=` read-modify-write could lose an increment under concurrent ops —
+# a counter that exists to PROVE an invariant must not under-count.
+_DISPATCHED_REQUESTS = {"write": 0, "read": 0}
+_DISPATCH_LOCK = threading.Lock()
+
+
+def _count_dispatched(verb: str, n: int) -> None:
+    with _DISPATCH_LOCK:
+        _DISPATCHED_REQUESTS[verb] += n
+
+
+def dispatched_requests(verb: str) -> int:
+    """Total requests the ``verb`` pipeline has been asked to execute in
+    this process (monotonic)."""
+    with _DISPATCH_LOCK:
+        return _DISPATCHED_REQUESTS[verb]
+
+
+def _staging_executor_workers() -> int:
+    """Size of the WRITE pipeline's staging executor.
+
+    ``TPUSNAP_STAGING_THREADS`` pins it; the automatic default is 4 —
+    except when the resolved compression codec is real, where it widens to
+    min(16, cores): compressed saves are staging-executor-bound (ROADMAP
+    4b — the codecs release the GIL, so every extra thread is extra encode
+    bandwidth), while raw saves are storage-bound and extra threads only
+    add wakeup contention."""
+    override = knobs.get_staging_threads()
+    if override > 0:
+        return override
+    codec, _ = knobs.get_compression()
+    if codec != "raw":
+        from . import compression
+
+        if compression.resolve(codec) != "raw":
+            return _wide_executor_workers()
+    return _NUM_EXECUTOR_THREADS
+
+
+def _wide_executor_workers() -> int:
+    import os
+
+    return max(
+        _NUM_EXECUTOR_THREADS,
+        min(_MAX_EXECUTOR_THREADS, os.cpu_count() or _NUM_EXECUTOR_THREADS),
+    )
+
+
+def _read_executor_workers(read_reqs: List[ReadReq]) -> int:
+    """The read pipeline's executor keys off the WORKLOAD, not the
+    save-side compression knob: a restore-only process (knob unset)
+    pulling a compressed snapshot is exactly the decode-bound case that
+    needs the wide pool, and a knob-carrying process restoring a raw
+    snapshot is not.  Framed payloads are visible on their consumers (the
+    codec rides the read request); ``TPUSNAP_STAGING_THREADS`` still
+    pins."""
+    override = knobs.get_staging_threads()
+    if override > 0:
+        return override
+    if any(
+        getattr(rr.buffer_consumer, "_codec", None) is not None
+        for rr in read_reqs
+    ):
+        return _wide_executor_workers()
+    return _NUM_EXECUTOR_THREADS
 
 
 def get_local_world_size(pg: PGWrapper) -> int:
@@ -363,7 +438,8 @@ async def execute_write_reqs(
     loop = asyncio.get_running_loop()
     own_executor = executor is None
     if executor is None:
-        executor = ThreadPoolExecutor(max_workers=_NUM_EXECUTOR_THREADS)
+        executor = ThreadPoolExecutor(max_workers=_staging_executor_workers())
+    _count_dispatched("write", len(write_reqs))
 
     budget = _BudgetTracker(memory_budget_bytes)
     phases_before = phase_stats.snapshot()
@@ -746,7 +822,8 @@ async def execute_read_reqs(
     rank: int,
 ) -> None:
     """Budget-gated read → consume pipeline (reference scheduler.py:386-447)."""
-    executor = ThreadPoolExecutor(max_workers=_NUM_EXECUTOR_THREADS)
+    executor = ThreadPoolExecutor(max_workers=_read_executor_workers(read_reqs))
+    _count_dispatched("read", len(read_reqs))
     budget = _BudgetTracker(memory_budget_bytes)
     ready_for_io: deque[_ReadPipeline] = deque(
         sorted(

@@ -474,6 +474,19 @@ class Snapshot:
         with ttrace.span("partition", n_write_reqs=len(write_reqs)):
             entries, write_reqs = partition_write_reqs(entries, write_reqs, pg)
 
+        # Streaming delta detection (cas.prestage_delta_skip): unchanged
+        # leaves resolve to pure manifest references BEFORE batching,
+        # compression, and scheduler dispatch — one hash, zero pipeline
+        # traffic.  Skipped for device-staged async takes: their D2H runs
+        # on the background thread, and probing here would pull it into
+        # the training stall this mode exists to avoid.
+        if not (is_async_snapshot and staging_mode != "host"):
+            from . import cas as cas_mod
+
+            write_reqs, _prestage = cas_mod.prestage_delta_skip(
+                storage, entries, write_reqs
+            )
+
         if not knobs.is_batching_disabled():
             entries, write_reqs = batch_write_requests(
                 entries,

@@ -11,12 +11,17 @@ reference (fs.py:31-34); byte-ranged reads seek (fs.py:42-51).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Set
 
 from ..io_types import ReadIO, StoragePlugin, WriteIO
+
+# Per-process sequence for tmp-file names (see _blocking_write): thread-safe
+# (itertools.count's __next__ is atomic under the GIL).
+_TMP_SEQ = itertools.count()
 
 from ._ranged import PARALLEL_READ_CHUNK_BYTES as _PARALLEL_READ_CHUNK
 from ._ranged import PARALLEL_READ_MAX_WAYS as _PARALLEL_READ_MAX_WAYS
@@ -239,7 +244,14 @@ class FSStoragePlugin(StoragePlugin):
         from ..io_types import ScatterBuffer
 
         self._prepare_parent(path)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # Unique per call, not just per process: two concurrent writers of
+        # the SAME path in one process are legal (CAS chunk writers racing
+        # identical content-defined chunks from different payloads), and a
+        # shared tmp name would let one writer's rename/cleanup steal the
+        # other's in-progress file (observed as FileNotFoundError at
+        # os.replace).  Each writer renames its own tmp; last-rename-wins
+        # is safe because same-path writes carry identical bytes.
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
         scatter = isinstance(buf, ScatterBuffer)
         nbytes = buf.nbytes if scatter else memoryview(buf).nbytes
         fused = (

@@ -51,6 +51,10 @@ PHASE_GROUPS: Dict[str, frozenset] = {
             "slab_pack",
             "consume_copy",
             "scatter_copy",
+            # Content-defined chunk-boundary scan (chunker.py): a rolling
+            # hash over the staged bytes — hash-class work, same group as
+            # checksum.
+            "cdc_chunk",
         }
     ),
     "h2d": frozenset({"h2d_dispatch", "h2d_land"}),

@@ -423,6 +423,42 @@ def record_cas_dedup(hits: int, bytes_saved: int) -> None:
     ).inc(bytes_saved)
 
 
+def record_cdc(chunks: int, dedup_hits: int, bytes_saved: int) -> None:
+    """Content-defined sub-chunking outcome of one take (cas.py +
+    chunker.py): sub-slab chunks produced on FastCDC edges, and the
+    per-chunk dedup they unlocked."""
+    if not enabled() or not (chunks or dedup_hits):
+        return
+    counter(
+        "tpusnap_cdc_chunks_total",
+        "Content-defined sub-chunks produced by the CAS writer",
+    ).inc(chunks)
+    counter(
+        "tpusnap_cdc_dedup_hits_total",
+        "Sub-chunk writes deduplicated against the content-addressed store",
+    ).inc(dedup_hits)
+    counter(
+        "tpusnap_cdc_bytes_saved_total",
+        "Bytes not written thanks to content-defined sub-chunk dedup",
+    ).inc(bytes_saved)
+
+
+def record_cas_prestage(hits: int, bytes_skipped: int) -> None:
+    """Streaming delta detection outcome of one take: leaves resolved to
+    pure manifest references BEFORE the write pipeline (one hash, zero
+    scheduler traffic)."""
+    if not enabled() or not hits:
+        return
+    counter(
+        "tpusnap_cas_prestage_hits_total",
+        "Unchanged leaves skipped before the write pipeline",
+    ).inc(hits)
+    counter(
+        "tpusnap_cas_prestage_bytes_total",
+        "Bytes of unchanged leaves that never entered the write pipeline",
+    ).inc(bytes_skipped)
+
+
 def record_cache(
     hits: int, misses: int, hit_bytes: int, miss_bytes: int
 ) -> None:
