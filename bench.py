@@ -287,6 +287,7 @@ def _serve_worker(path: str) -> int:
 
     from torchsnapshot_tpu import Snapshot
     from torchsnapshot_tpu import cache as tcache
+    from torchsnapshot_tpu import peer as tpeer
     from torchsnapshot_tpu import phase_stats
     from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
     from torchsnapshot_tpu.telemetry import fleet as tfleet
@@ -295,6 +296,23 @@ def _serve_worker(path: str) -> int:
 
     snap = Snapshot(path)
     md = snap.metadata
+    if os.environ.get("BENCH_SERVE_SEED_WARM"):
+        # Seed posture: pre-fault the full chunk set into the host cache
+        # through the peer-aware read stack (run with TPUSNAP_PEER_FETCH=1)
+        # so every part lands under its servable cas/<algo>/<hex> key — a
+        # restore alone populates ranged sub-keys the exporting daemon
+        # cannot serve.  This process's miss_bytes then meter the fleet's
+        # ONE origin pull; the restore below hits the warmed cache.
+        from torchsnapshot_tpu import cas as tcas
+
+        warm_storage = tcache.maybe_wrap_cache_reads(
+            tcas.maybe_wrap_cas_reads(url_to_storage_plugin(path), path, md),
+            md,
+        )
+        try:
+            tcache.warm_snapshot(warm_storage, md)
+        finally:
+            warm_storage.sync_close()
     keys = sorted(
         {p.split("/", 2)[1] for p in md.manifest if "/" in p}
     )
@@ -356,6 +374,9 @@ def _serve_worker(path: str) -> int:
         "telemetry_overhead_raw_s": round(tfleet.process_overhead_s(), 6),
         "telemetry_publishes": cal["publishes"],
         **cache_stats,
+        # Peer-tier split (all zero unless TPUSNAP_PEER_FETCH was on):
+        # peer_hit_bytes came from sibling daemons instead of origin.
+        **{f"peer_{k}": v for k, v in tpeer.process_stats().items()},
     }
     print(json.dumps(out), flush=True)
     return 0
@@ -1813,6 +1834,162 @@ def main() -> None:
         # the first cohort sees (the fleet scenario is thousands of pulls).
         warm_docs = _run_serve_workers(n_serve, serve_cache_dir)
         warm = _round_stats(warm_docs)
+
+        # Round 3 — MULTI-HOST peer distribution: H simulated hosts with
+        # SEPARATE cache dirs and one shared origin.  One seed host pulls
+        # from origin and runs `tpusnap serve --daemon`; every later host
+        # pulls peer-first (TPUSNAP_PEER_FETCH).  The acceptance pair:
+        # total origin traffic stays ~one snapshot regardless of host
+        # count, while AGGREGATE restore bandwidth scales with hosts —
+        # the fan-out a shared-cache single host cannot give.
+        from torchsnapshot_tpu import knobs as _peer_knobs
+
+        n_hosts = max(3, min(n_serve, 6))
+        peer_root = os.path.join(serve_root, "peer")
+        peer_snap = os.path.join(peer_root, "snap")
+        # CAS layout is what makes chunks digest-addressed (the peer
+        # protocol's unit); the serving snapshot above is layout-default.
+        with _peer_knobs.override_cas(True):
+            Snapshot.take(peer_snap, serve_state)
+        peer_kv = os.path.join(peer_root, "kv")
+
+        def _peer_env(host_idx, peer_fetch, seed_warm=False):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["TPUSNAP_CACHE_DIR"] = os.path.join(
+                peer_root, f"host{host_idx}"
+            )
+            env["TPUSNAP_STORE_PATH"] = peer_kv
+            env["TPUSNAP_FAULTS"] = "none"  # pure per-host origin meter
+            env["TPUSNAP_PEER_FETCH"] = "1" if peer_fetch else "0"
+            # Large whole-slab chunks over GIL-shared loopback can stall a
+            # socket read past the 5 s default on a starved box; a timed-out
+            # fetch silently falls back to origin and the probe reads as
+            # "peer tier off".  The probe measures distribution economics,
+            # not timeout tuning — give transfers a generous ceiling.
+            env.setdefault("TPUSNAP_PEER_TIMEOUT_S", "60")
+            if seed_warm:
+                env["BENCH_SERVE_SEED_WARM"] = "1"
+            else:
+                env.pop("BENCH_SERVE_SEED_WARM", None)
+            env.pop("TPUSNAP_FLEET_TELEMETRY", None)
+            return env
+
+        def _run_peer_hosts(host_indices, peer_fetch, seed_warm=False):
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        os.path.abspath(__file__),
+                        "--serve-worker",
+                        peer_snap,
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=_peer_env(i, peer_fetch, seed_warm),
+                )
+                for i in host_indices
+            ]
+            docs = []
+            for proc in procs:
+                out, err = proc.communicate(
+                    timeout=max(_watchdog_remaining_s() - 10, 60)
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"peer host worker failed (rc {proc.returncode}): "
+                        f"{err.strip().splitlines()[-1:] or out}"
+                    )
+                docs.append(json.loads(out.strip().splitlines()[-1]))
+            return docs
+
+        def _start_daemon(host_idx):
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "torchsnapshot_tpu",
+                    "serve",
+                    peer_snap,
+                    "--daemon",
+                    "--advertise",
+                    "127.0.0.1",
+                    "--cache-dir",
+                    os.path.join(peer_root, f"host{host_idx}"),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=_peer_env(host_idx, peer_fetch=False),
+            )
+            line = proc.stdout.readline()
+            if "listening on" not in line:
+                proc.terminate()
+                raise RuntimeError(f"peer daemon failed to start: {line!r}")
+            return proc
+
+        daemons = []
+        try:
+            # Seed host 0: the ONE origin pull — a part-wise warm through
+            # the peer-aware stack (servable cas/ keys), then a restore
+            # that hits the warmed cache.
+            seed_doc = _run_peer_hosts([0], peer_fetch=True, seed_warm=True)[0]
+            daemons.append(_start_daemon(0))
+            # Single puller (host 1): the per-host peer-path baseline.
+            single_doc = _run_peer_hosts([1], peer_fetch=True)[0]
+            daemons.append(_start_daemon(1))
+            # H hosts pull concurrently from the two seeded daemons.
+            multi_docs = _run_peer_hosts(
+                range(2, 2 + n_hosts), peer_fetch=True
+            )
+        finally:
+            for proc in daemons:
+                proc.terminate()
+            for proc in daemons:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+        all_pull_docs = [single_doc] + multi_docs
+        multi_span = max(
+            max(d["end"] for d in multi_docs)
+            - min(d["start"] for d in multi_docs),
+            1e-6,
+        )
+        origin_total = seed_doc["miss_bytes"] + sum(
+            d["miss_bytes"] for d in all_pull_docs
+        )
+        peer_bytes = sum(d["peer_hit_bytes"] for d in all_pull_docs)
+        single_agg = single_doc["bytes"] / 1e9 / max(single_doc["wall_s"], 1e-6)
+        multi_agg = sum(d["bytes"] for d in multi_docs) / 1e9 / multi_span
+        multihost = {
+            "hosts": 2 + n_hosts,
+            "concurrent_pullers": n_hosts,
+            "snapshot_bytes": serve_logical,
+            "seed_origin_bytes": seed_doc["miss_bytes"],
+            "origin_bytes_total": origin_total,
+            "origin_amplification": round(origin_total / serve_logical, 3),
+            "peer_bytes": peer_bytes,
+            "peer_rejects": sum(d["peer_rejects"] for d in all_pull_docs),
+            "single_puller_gbps": round(single_agg, 3),
+            "aggregate_gbps": round(multi_agg, 3),
+            "puller_walls_s": sorted(d["wall_s"] for d in multi_docs),
+            # Acceptance: origin ~one snapshot at >=3 hosts, and the
+            # concurrent fleet's aggregate beats one peer-path puller.
+            "origin_bytes_near_snapshot_size": origin_total
+            <= 1.25 * serve_logical,
+            "aggregate_scales_with_hosts": multi_agg >= 1.3 * single_agg,
+        }
+        log(
+            f"multi-host peer probe ({multihost['hosts']} hosts, "
+            f"{n_hosts} concurrent pullers): origin "
+            f"{multihost['origin_amplification']}x snapshot, "
+            f"{peer_bytes / 1e9:.2f} GB served peer-to-peer, aggregate "
+            f"{multihost['aggregate_gbps']} GB/s vs single puller "
+            f"{multihost['single_puller_gbps']} GB/s"
+        )
         # Fleet-telemetry acceptance: the spool must carry one terminal
         # entry per worker process (baseline + cold + warm rounds), the
         # aggregated cache totals must equal the workers' own accounting,
@@ -1850,6 +2027,7 @@ def main() -> None:
         }
         serve_probe = {
             "fleet": fleet_probe,
+            "multihost": multihost,
             "workers": n_serve,
             "snapshot_bytes": serve_logical,
             "single_restore_s": baseline["wall_s"],

@@ -1,0 +1,689 @@
+"""Peer-to-peer chunk distribution: daemon HTTP serving, peer-first
+restore, digest verification on receipt, rollout, and chaos.
+
+Covers peerd.py (the ``tpusnap serve --daemon`` server: digest-addressed
+``/chunk`` with range support, ``/healthz``, ``/inventory``,
+``/rollout``), peer.py (registry leases/tombstones, rendezvous routing,
+the PeerReaderPlugin fetch policy with verify-by-digest + quarantine +
+origin fallback), the peer fault kinds, the staged rollout, and the
+stdlib-only HTTP consumer in examples/.  Origin traffic is asserted
+through the fault wrapper's read counters (``TPUSNAP_FAULTS=none`` = pure
+meter), exactly like test_serve.py.
+"""
+
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, faults, knobs
+from torchsnapshot_tpu import cache as cache_mod
+from torchsnapshot_tpu import cas as cas_mod
+from torchsnapshot_tpu import peer as peer_mod
+from torchsnapshot_tpu import peerd as peerd_mod
+from torchsnapshot_tpu.manager import SnapshotManager
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _payload_read_bytes() -> int:
+    """Origin bytes requested for payloads (metadata/sidecar excluded)."""
+    return sum(
+        nbytes
+        for path, nbytes in faults.read_counters().items()
+        if not path.rsplit("/", 1)[-1].startswith(".")
+        and not path.startswith("telemetry/")
+    )
+
+
+def _state(nbytes_per_leaf=1 << 20, leaves=4, seed=0):
+    return {
+        "m": StateDict(
+            {
+                f"w{i}": np.frombuffer(
+                    np.random.RandomState(seed * 100 + i).bytes(
+                        nbytes_per_leaf
+                    ),
+                    np.uint8,
+                ).copy()
+                for i in range(leaves)
+            }
+        )
+    }
+
+
+def _zeros_like(state):
+    return {
+        "m": StateDict({k: np.zeros_like(v) for k, v in state["m"].items()})
+    }
+
+
+def _warm_into(snap_path, metadata, cache_dir):
+    """Warm a snapshot into ``cache_dir`` through the normal read stack."""
+    with knobs.override_cache_dir(cache_dir):
+        storage = peerd_mod._rollout_storage(snap_path, metadata)
+        try:
+            return cache_mod.warm_snapshot(storage, metadata)
+        finally:
+            storage.sync_close()
+
+
+@contextlib.contextmanager
+def _daemon(cache_dir, root=None, register=True):
+    d = peerd_mod.PeerDaemon(
+        root=root, cache_dir=cache_dir, advertise="127.0.0.1",
+        register=register,
+    )
+    d.start()
+    try:
+        yield d
+    finally:
+        d.close()
+
+
+@pytest.fixture
+def peer_env(tmp_path):
+    """Coordination store + metered origin, the common peer-test setup."""
+    with knobs.override_store_path(
+        str(tmp_path / "kv")
+    ), knobs.override_faults("none"):
+        faults.reset_read_counters()
+        peer_mod.reset_process_stats()
+        yield tmp_path
+
+
+# ------------------------------------------------- the check.sh gate test
+
+
+def test_two_daemon_peer_first_restore_fast(peer_env):
+    """TIER-1 GATE: with two registered daemons (one seeded, one empty),
+    a fresh host restores entirely peer-first — zero origin payload
+    bytes, bit-identical data, and the peer split recorded."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    with _daemon(str(tmp_path / "cacheA")), _daemon(
+        str(tmp_path / "cacheB")  # registered but EMPTY: 404s route onward
+    ):
+        with knobs.override_cache_dir(
+            str(tmp_path / "cacheC")
+        ), knobs.override_peer_fetch(True):
+            faults.reset_read_counters()
+            dst = _zeros_like(state)
+            snap.restore(dst)
+            origin = _payload_read_bytes()
+    for key, arr in state["m"].items():
+        np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+    assert origin == 0, f"peer-first restore read {origin} origin bytes"
+    stats = peer_mod.process_stats()
+    assert stats["hits"] > 0 and stats["hit_bytes"] > 0
+    assert stats["rejects"] == 0
+
+
+# ------------------------------------------------------ daemon HTTP surface
+
+
+def test_daemon_http_surface(peer_env):
+    tmp_path = peer_env
+    state = _state(leaves=2)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    with _daemon(str(tmp_path / "cacheA")) as d:
+        base = f"http://{d.addr}"
+        health = json.loads(urllib.request.urlopen(f"{base}/healthz").read())
+        assert health["ok"] and health["addr"] == d.addr
+
+        inv = json.loads(urllib.request.urlopen(f"{base}/inventory").read())
+        assert inv["entries"] >= 1 and not inv["truncated"]
+        key = inv["chunks"][0]["key"]
+        _, algo, hexdigest = key.split("/")
+
+        full = urllib.request.urlopen(f"{base}/chunk/{algo}/{hexdigest}").read()
+        assert len(full) == inv["chunks"][0]["nbytes"]
+
+        # Single range -> 206 + Content-Range + the exact slice.
+        req = urllib.request.Request(
+            f"{base}/chunk/{algo}/{hexdigest}",
+            headers={"Range": "bytes=10-41"},
+        )
+        resp = urllib.request.urlopen(req)
+        assert resp.status == 206
+        assert resp.headers["Content-Range"] == f"bytes 10-41/{len(full)}"
+        assert resp.read() == full[10:42]
+
+        # Suffix range (-N = last N bytes).
+        req = urllib.request.Request(
+            f"{base}/chunk/{algo}/{hexdigest}",
+            headers={"Range": "bytes=-16"},
+        )
+        assert urllib.request.urlopen(req).read() == full[-16:]
+
+        # Unsatisfiable range -> 416; unknown chunk -> 404; bad path -> 404.
+        req = urllib.request.Request(
+            f"{base}/chunk/{algo}/{hexdigest}",
+            headers={"Range": f"bytes={len(full)}-"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req)
+        assert err.value.code == 416
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/chunk/{algo}/{'0' * 16}")
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nonsense")
+        assert err.value.code == 404
+
+
+# ------------------------------------------------------- registry + routing
+
+
+def test_registry_lease_staleness_and_tombstone(peer_env):
+    kv = peer_mod.resolve_kv_store()
+    assert kv is not None
+    reg_a = peer_mod.PeerRegistration(kv, "10.0.0.1:8997")
+    reg_b = peer_mod.PeerRegistration(kv, "10.0.0.2:8997")
+    addrs = {p.addr for p in peer_mod.live_peers(kv)}
+    assert addrs == {"10.0.0.1:8997", "10.0.0.2:8997"}
+    # Self-exclusion: a fetching daemon must not dial itself.
+    addrs = {
+        p.addr
+        for p in peer_mod.live_peers(kv, exclude_addr="10.0.0.1:8997")
+    }
+    assert addrs == {"10.0.0.2:8997"}
+    # A stale stamp (no refresh within grace) drops the peer.  Stop the
+    # refresh thread first so it cannot re-freshen the record mid-assert.
+    reg_b._stop.set()
+    reg_b._thread.join(timeout=5.0)
+    stale = json.dumps(
+        {
+            "addr": "10.0.0.2:8997",
+            "host": "h",
+            "pid": 1,
+            "stamp": time.time() - 9999.0,
+            "done": False,
+        }
+    ).encode("utf-8")
+    kv.set(f"{peer_mod.PEERD_PREFIX}/{reg_b.slot}", stale)
+    addrs = {p.addr for p in peer_mod.live_peers(kv)}
+    assert addrs == {"10.0.0.1:8997"}
+    # Clean close writes a tombstone: dropped immediately.
+    reg_a.close()
+    reg_b.close()
+    assert peer_mod.live_peers(kv) == []
+
+
+def test_rendezvous_order_deterministic_and_balanced(peer_env):
+    kv = peer_mod.resolve_kv_store()
+    regs = [
+        peer_mod.PeerRegistration(kv, f"10.0.0.{i}:9000") for i in range(4)
+    ]
+    try:
+        peers = peer_mod.live_peers(kv)
+        order1 = [p.addr for p in peer_mod.rendezvous_order("chunk/x", peers)]
+        order2 = [
+            p.addr
+            for p in peer_mod.rendezvous_order(
+                "chunk/x", list(reversed(peers))
+            )
+        ]
+        assert order1 == order2  # placement is peer-set, not list-order
+        firsts = {
+            peer_mod.rendezvous_order(f"chunk/{i}", peers)[0].addr
+            for i in range(64)
+        }
+        assert len(firsts) > 1  # different chunks spread across peers
+    finally:
+        for reg in regs:
+            reg.close()
+
+
+# --------------------------------------- verify-by-digest on receipt
+
+
+class _RogueServer:
+    """An HTTP server that claims chunks but serves garbage — the
+    compromised/corrupt peer the digest gate must reject."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self, *a):  # noqa: N802
+                body = b"\x00garbage\x00" * 400
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # noqa: A003
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.addr = f"127.0.0.1:{self._srv.server_address[1]}"
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_corrupt_peer_rejected_quarantined_refetched(peer_env):
+    """A peer serving bytes that do not hash to the requested digest is
+    rejected, marked bad, and the chunk comes from a good source — the
+    restore stays bit-identical and the reject is counted."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    kv = peer_mod.resolve_kv_store()
+    rogue = _RogueServer()
+    rogue_reg = peer_mod.PeerRegistration(kv, rogue.addr)
+    try:
+        with knobs.override_cache_dir(
+            str(tmp_path / "cacheB")
+        ), knobs.override_peer_fetch(True):
+            faults.reset_read_counters()
+            dst = _zeros_like(state)
+            snap.restore(dst)
+            origin = _payload_read_bytes()
+        for key, arr in state["m"].items():
+            np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+        stats = peer_mod.process_stats()
+        # Only the rogue was registered: every chunk fell back to origin.
+        assert stats["rejects"] > 0
+        assert stats["hit_bytes"] == 0
+        assert origin > 0
+    finally:
+        rogue_reg.close()
+        rogue.close()
+
+
+def test_corrupt_peer_skipped_in_favor_of_good_peer(peer_env):
+    """With a rogue AND a good daemon registered, the fetch policy walks
+    past the rejected candidate and still restores peer-only."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    kv = peer_mod.resolve_kv_store()
+    rogue = _RogueServer()
+    rogue_reg = peer_mod.PeerRegistration(kv, rogue.addr)
+    try:
+        with _daemon(str(tmp_path / "cacheA")):
+            with knobs.override_cache_dir(
+                str(tmp_path / "cacheB")
+            ), knobs.override_peer_fetch(True):
+                faults.reset_read_counters()
+                dst = _zeros_like(state)
+                snap.restore(dst)
+                origin = _payload_read_bytes()
+        for key, arr in state["m"].items():
+            np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+        assert origin == 0
+        stats = peer_mod.process_stats()
+        assert stats["hit_bytes"] > 0
+    finally:
+        rogue_reg.close()
+        rogue.close()
+
+
+def test_quarantine_expires_after_bad_ttl(peer_env):
+    tmp_path = peer_env
+    kv = peer_mod.resolve_kv_store()
+    reg = peer_mod.PeerRegistration(kv, "127.0.0.1:1")  # nothing listening
+    try:
+        with knobs.override_peer_bad_ttl_s(0.2), knobs.override_peer_timeout_s(
+            0.1
+        ), knobs.override_peer_retries(0):
+            client = peer_mod.PeerClient(kv)
+            assert client.fetch_chunk("xxh64", "0" * 16) is None
+            assert client.candidates("k") == []  # quarantined now
+            time.sleep(0.25)
+            assert len(client.candidates("k")) == 1  # TTL expired
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------------- peer fault kinds
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "peer:1:peer_unreachable",
+        "peer:1:peer_slow:0.05",
+        "peer:1:peer_truncated",
+    ],
+)
+def test_peer_fault_kinds_fall_back_cleanly(peer_env, spec):
+    """Injected peer faults (dead peer, slow peer, truncated body) never
+    corrupt a restore — at worst the bytes come from origin."""
+    tmp_path = peer_env
+    state = _state(leaves=2)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    with _daemon(str(tmp_path / "cacheA")):
+        with knobs.override_cache_dir(
+            str(tmp_path / "cacheB")
+        ), knobs.override_peer_fetch(True), knobs.override_faults(
+            spec
+        ), knobs.override_peer_timeout_s(
+            2.0
+        ):
+            dst = _zeros_like(state)
+            snap.restore(dst)
+    for key, arr in state["m"].items():
+        np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+
+
+def test_peer_fault_spec_validation():
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("read:1:peer_unreachable")  # wrong op
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("peer:1:latency:0.1")  # non-peer kind
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("peer:1:peer_unreachable:3")  # no param
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("peer:1:peer_slow:-1")  # negative delay
+    rules = faults.parse_fault_spec("peer:1:peer_slow:0.5")
+    assert rules[0].op == "peer" and rules[0].param == 0.5
+
+
+# ----------------------------------------------------- casx sub-chunk fetch
+
+
+def test_casx_parts_fetch_peer_first(peer_env):
+    """A CDC (casx) snapshot restores peer-first at sub-chunk
+    granularity: parts come from the peer individually and assemble
+    bit-identically."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True), knobs.override_cdc(
+        True
+    ), knobs.override_cdc_params(16384, 65536, 262144):
+        snap = Snapshot.take(snap_path, state)
+    locations = cache_mod.payload_locations(snap.metadata)
+    has_casx = any(cas_mod.is_casx_location(loc) for loc, _ in locations)
+    if not has_casx:
+        pytest.skip("CDC produced no casx locations on this build")
+    # Seed with the peer tier ON (no peers yet): casx entries then warm
+    # PART-WISE into the cache — chunk-granular keys are what the daemon
+    # can serve onward.  A whole-entry warm would hold only the private
+    # assembly key.
+    with knobs.override_peer_fetch(True):
+        _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    with _daemon(str(tmp_path / "cacheA")):
+        with knobs.override_cache_dir(
+            str(tmp_path / "cacheB")
+        ), knobs.override_peer_fetch(True):
+            faults.reset_read_counters()
+            dst = _zeros_like(state)
+            snap.restore(dst)
+            origin = _payload_read_bytes()
+    for key, arr in state["m"].items():
+        np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+    assert origin == 0
+    assert peer_mod.process_stats()["hits"] > 0
+
+
+# ------------------------------------------------------------------ rollout
+
+
+def test_rollout_delta_canary_then_fleet(peer_env):
+    """A two-step fine-tune rolls out as a DELTA: the canary pulls only
+    the changed chunks from origin, the fleet host pulls them from the
+    canary, and digest spot-checks gate the fleet wave."""
+    tmp_path = peer_env
+    root = str(tmp_path / "ckpts")
+    with knobs.override_cas(True):
+        mgr = SnapshotManager(root)
+        mgr.save(1, _state(seed=0))
+        state2 = _state(seed=0)
+        state2["m"]["w0"] = np.frombuffer(
+            np.random.RandomState(777).bytes(1 << 20), np.uint8
+        ).copy()
+        mgr.save(2, state2)
+
+    step, snap_path, md, prev_md = peerd_mod.resolve_rollout_target(root, None)
+    assert step == 2
+    delta = peerd_mod.delta_locations(md, prev_md)
+    full = peerd_mod.delta_locations(md, None)
+    assert 0 < len(delta) < len(full)
+    delta_bytes = sum(n for _, n in delta)
+    assert delta_bytes < sum(n for _, n in full)
+
+    with knobs.override_peer_fetch(True):
+        with _daemon(str(tmp_path / "cacheA"), root=root), _daemon(
+            str(tmp_path / "cacheB"), root=root
+        ):
+            faults.reset_read_counters()
+            out = peerd_mod.rollout_fleet(root, None, canary=1)
+    assert out["ok"], out
+    assert out["step"] == 2
+    assert len(out["canaries"]) == 1 and len(out["fleet"]) == 1
+    assert all(r["ok"] for r in out["canary_verify"])
+    assert out["canary_verify"][0]["chunks_verified"] > 0
+    # The fleet host's delta came from the canary, not origin.
+    fleet_warm = out["fleet_results"][0]["warm"]
+    assert fleet_warm["peer"]["hit_bytes"] > 0
+    assert fleet_warm["cache"]["miss_bytes"] == 0
+
+
+def test_rollout_aborts_before_fleet_on_canary_failure(peer_env):
+    """A canary that cannot warm (daemon with no root) aborts the rollout
+    before any fleet host is touched."""
+    tmp_path = peer_env
+    root = str(tmp_path / "ckpts")
+    with knobs.override_cas(True):
+        SnapshotManager(root).save(1, _state(leaves=1))
+    with _daemon(str(tmp_path / "cacheA"), root=None), _daemon(
+        str(tmp_path / "cacheB"), root=None
+    ):
+        out = peerd_mod.rollout_fleet(root, None, canary=1)
+    assert not out["ok"]
+    assert out["aborted"] == "canary warm failed"
+    assert "fleet_results" not in out
+
+
+# ------------------------------------------------------------ CLI + consumer
+
+
+def test_cli_daemon_and_stdlib_consumer(peer_env):
+    """`tpusnap serve --daemon` as a real subprocess, consumed by the
+    stdlib-only example script (no torchsnapshot_tpu import): the pulled
+    entry is bit-identical and its xxh64 self-verifies."""
+    tmp_path = peer_env
+    state = _state(leaves=2)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPUSNAP_STORE_PATH"] = str(tmp_path / "kv")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchsnapshot_tpu",
+            "serve",
+            snap_path,
+            "--daemon",
+            "--advertise",
+            "127.0.0.1",
+            "--cache-dir",
+            str(tmp_path / "cacheA"),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "listening on" in line, line
+        addr = line.split("listening on", 1)[1].split()[0]
+
+        out_file = str(tmp_path / "w0.bin")
+        consumer = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "examples", "http_range_pull.py"),
+                snap_path,
+                f"http://{addr}",
+                "0/m/w0",
+                out_file,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env={"PATH": os.environ.get("PATH", "")},  # no repo on sys.path
+        )
+        assert consumer.returncode == 0, consumer.stderr or consumer.stdout
+        assert "verified xxh64:" in consumer.stdout
+        with open(out_file, "rb") as f:
+            assert f.read() == state["m"]["w0"].tobytes()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+# ------------------------------------------------------------------- chaos
+
+
+def test_kill9_daemon_mid_restore_falls_back_to_origin(peer_env):
+    """SIGKILL the serving daemon: the puller walks past the dead peer
+    (connection refused -> quarantine) and completes from origin, no
+    corruption, bounded wall."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TPUSNAP_STORE_PATH"] = str(tmp_path / "kv")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchsnapshot_tpu",
+            "serve",
+            snap_path,
+            "--daemon",
+            "--advertise",
+            "127.0.0.1",
+            "--cache-dir",
+            str(tmp_path / "cacheA"),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    try:
+        assert "listening on" in proc.stdout.readline()
+        # SIGKILL: no tombstone, the registry record goes stale in place.
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        begin = time.monotonic()
+        with knobs.override_cache_dir(
+            str(tmp_path / "cacheB")
+        ), knobs.override_peer_fetch(True), knobs.override_peer_timeout_s(
+            1.0
+        ), knobs.override_peer_retries(
+            0
+        ):
+            faults.reset_read_counters()
+            dst = _zeros_like(state)
+            snap.restore(dst)
+            origin = _payload_read_bytes()
+        wall = time.monotonic() - begin
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    for key, arr in state["m"].items():
+        np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+    assert origin > 0  # origin served; the dead peer couldn't
+    # Bounded stall: ONE failed dial (then quarantine), not one per chunk.
+    assert wall < 30.0, wall
+
+
+@pytest.mark.slow
+def test_multi_peer_soak(peer_env):
+    """Slow soak: 3 seeded daemons + a rogue, several fresh hosts restore
+    concurrently peer-first; zero origin bytes from the good paths and
+    every restore bit-identical."""
+    tmp_path = peer_env
+    state = _state(nbytes_per_leaf=1 << 21)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    kv = peer_mod.resolve_kv_store()
+    rogue = _RogueServer()
+    rogue_reg = peer_mod.PeerRegistration(kv, rogue.addr)
+    seeded = [str(tmp_path / f"cache_seed{i}") for i in range(3)]
+    for cdir in seeded:
+        _warm_into(snap_path, snap.metadata, cdir)
+    with contextlib.ExitStack() as stack:
+        for cdir in seeded:
+            stack.enter_context(_daemon(cdir))
+        results = []
+
+        def _pull(i):
+            with knobs.override_cache_dir(
+                str(tmp_path / f"cache_pull{i}")
+            ), knobs.override_peer_fetch(True):
+                dst = _zeros_like(state)
+                snap.restore(dst)
+                results.append(dst)
+
+        try:
+            threads = [
+                threading.Thread(target=_pull, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=240)
+        finally:
+            rogue_reg.close()
+            rogue.close()
+    assert len(results) == 4
+    for dst in results:
+        for key, arr in state["m"].items():
+            np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)

@@ -139,6 +139,23 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Multi-host peer-serving aggregate: the --serve probe's round-3
+        # fleet bandwidth (H hosts pulling peer-first from seeded
+        # daemons).  Its own gated series so a change that silently
+        # drops the peer tier back to per-host origin pulls — same
+        # correctness, none of the fan-out — fails the gate.
+        mh_agg = (serve.get("multihost") or {}).get("aggregate_gbps")
+        if isinstance(mh_agg, (int, float)):
+            records.append(
+                {
+                    "series": f"serve_fleet_aggregate:{backend}",
+                    "round": rnd,
+                    "value": float(mh_agg),
+                    "unit": "GB/s",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
         # Compressed-save throughput: the compression probe's effective
         # GB/s (logical bytes over compressed-save wall).  Its own series
         # so the --fail-on-regression gate covers compressed saves — the

@@ -71,6 +71,15 @@ timeout -k 10 300 python -m pytest \
   tests/test_serve.py::test_two_worker_concurrent_restore_fast -q \
   -p no:cacheprovider || fail=1
 
+# Peer-serve smoke: 2 in-process peer daemons, digest-addressed range
+# serving, and a fresh host restoring entirely peer-first (origin payload
+# bytes == 0).  Also part of tier-1 above; its own gate line so a peer
+# distribution regression is visible by name.
+step "peer-serve smoke (2-daemon peer-first restore, zero origin bytes)"
+timeout -k 10 300 python -m pytest \
+  tests/test_peer.py::test_two_daemon_peer_first_restore_fast -q \
+  -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

@@ -935,17 +935,60 @@ def cmd_warm(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve_daemon(args: argparse.Namespace) -> int:
+    """``serve --daemon``: run the peerd chunk server in the foreground
+    until SIGINT/SIGTERM — register on the coordination plane, answer
+    digest-addressed ``/chunk`` range requests from the host cache, and
+    accept ``/rollout`` warm orders."""
+    import contextlib
+    import signal
+    import threading
+
+    from . import knobs
+    from . import peerd as peerd_mod
+
+    ctx = (
+        knobs.override_cache_dir(args.cache_dir)
+        if args.cache_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        daemon = peerd_mod.PeerDaemon(
+            root=args.path, port=args.port, advertise=args.advertise
+        )
+        addr = daemon.start()
+        print(
+            f"peerd listening on {addr} (cache: {daemon.cache_dir})",
+            flush=True,
+        )
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda signum, frame: stop.set())
+        try:
+            while not stop.wait(1.0):
+                pass
+        finally:
+            daemon.close()
+    return 0
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """Report a snapshot's cache residency — how ready this host is to
     serve N concurrent restores from local disk — plus the cache
     directory's totals.  Payload-read-only (run ``warm`` to change the
     answer); like take/restore it records a ``serve`` telemetry sidecar
     with the residency probe (``TPUSNAP_SIDECAR=0`` opts out) and shows
-    up in the ``tpusnap top`` fleet view when publishing is on."""
+    up in the ``tpusnap top`` fleet view when publishing is on.
+
+    With ``--daemon``, instead serve this host's cache to the fleet over
+    HTTP (see docs/serving.md)."""
     import contextlib
     import json
     import time as _time
     import uuid as _uuid
+
+    if getattr(args, "daemon", False):
+        return _cmd_serve_daemon(args)
 
     from . import cache as cache_mod
     from . import knobs, phase_stats
@@ -1038,6 +1081,59 @@ def cmd_serve(args: argparse.Namespace) -> int:
         if pct < 100.0:
             print("run 'warm' to pre-fault the remaining chunks")
     return 0
+
+
+def cmd_rollout(args: argparse.Namespace) -> int:
+    """Staged delta broadcast: warm one step's changed chunks onto every
+    live peer daemon, canary-first with digest verification before the
+    fleet wave.  Exit 0 only when every host rolled clean."""
+    import json
+
+    from . import peerd as peerd_mod
+
+    try:
+        result = peerd_mod.rollout_fleet(
+            args.path,
+            args.step,
+            canary=args.canary,
+            verify_chunks=args.verify_chunks,
+            concurrency=args.concurrency,
+            timeout_s=args.timeout,
+        )
+    except ValueError as e:
+        print(f"rollout failed: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(result, indent=1))
+        return 0 if result.get("ok") else 1
+    print(f"root:     {result['root']}")
+    print(f"step:     {result['step']}")
+    print(f"canaries: {', '.join(result['canaries']) or '(none)'}")
+    for phase_name in ("canary_results", "fleet_results"):
+        for row in result.get(phase_name, ()):
+            if row.get("ok"):
+                warm = row.get("warm") or {}
+                peer_bytes = (warm.get("peer") or {}).get("hit_bytes", 0)
+                print(
+                    f"  {row['peer']}: ok, "
+                    f"{warm.get('delta_locations', 0)} delta chunk(s), "
+                    f"{_human(warm.get('delta_bytes', 0))} "
+                    f"({_human(peer_bytes)} from peers) "
+                    f"in {warm.get('wall_s', 0):.2f}s"
+                )
+            else:
+                print(f"  {row['peer']}: FAILED: {row.get('error')}")
+    for row in result.get("canary_verify", ()):
+        status = (
+            f"verified {row.get('chunks_verified', 0)} chunk(s)"
+            if row.get("ok")
+            else f"VERIFY FAILED: {row.get('error')}"
+        )
+        print(f"  {row['peer']}: {status}")
+    if result.get("aborted"):
+        print(f"aborted before fleet wave: {result['aborted']}")
+    print("ok" if result.get("ok") else "FAILED")
+    return 0 if result.get("ok") else 1
 
 
 def main(argv=None) -> int:
@@ -1249,7 +1345,66 @@ def main(argv=None) -> int:
             p.add_argument(
                 "--json", action="store_true", help="machine-readable output"
             )
+            p.add_argument(
+                "--daemon",
+                action="store_true",
+                help="serve this host's cache to the fleet over HTTP "
+                "(digest-addressed range requests) until SIGINT/SIGTERM",
+            )
+            p.add_argument(
+                "--port",
+                type=int,
+                default=None,
+                help="daemon listen port (default: TPUSNAP_PEER_PORT or "
+                "ephemeral)",
+            )
+            p.add_argument(
+                "--advertise",
+                default=None,
+                help="address peers should dial, 'host' or 'host:port' "
+                "(default: TPUSNAP_PEER_ADDR or this hostname)",
+            )
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "rollout",
+        help="staged delta broadcast of one step to the peer-daemon fleet",
+    )
+    p.add_argument("path", help="SnapshotManager root the daemons serve")
+    p.add_argument(
+        "--step",
+        type=int,
+        default=None,
+        help="restore point to roll out (default: latest)",
+    )
+    p.add_argument(
+        "--canary",
+        type=int,
+        default=1,
+        help="hosts that warm + digest-verify before the fleet wave",
+    )
+    p.add_argument(
+        "--verify-chunks",
+        type=int,
+        default=4,
+        help="delta chunks spot-checked against each canary",
+    )
+    p.add_argument(
+        "--concurrency",
+        type=int,
+        default=8,
+        help="concurrent chunk fetches per host",
+    )
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=600.0,
+        help="per-host HTTP timeout in seconds",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.set_defaults(fn=cmd_rollout)
 
     p = sub.add_parser(
         "history", help="render a manager root's step-save history/trend"

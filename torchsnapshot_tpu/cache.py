@@ -626,6 +626,10 @@ class CacheReaderPlugin(StoragePlugin):
     def store(self) -> CacheStore:
         return self._store
 
+    @property
+    def namespace(self) -> str:
+        return self._ns
+
     @staticmethod
     def _cacheable(path: str) -> bool:
         name = path.rsplit("/", 1)[-1]
@@ -925,9 +929,15 @@ def maybe_wrap_cache_reads(storage: StoragePlugin, metadata: Any) -> StoragePlug
             exc_info=True,
         )
         return storage
-    return CacheReaderPlugin(
+    reader = CacheReaderPlugin(
         inner=storage, store=store, namespace=snapshot_fingerprint(metadata)
     )
+    # The peer tier rides OUTSIDE the cache: a local hit never touches the
+    # network, a miss tries the fleet before origin (peer.py; off unless
+    # TPUSNAP_PEER_FETCH and a coordination store are configured).
+    from . import peer as peer_mod
+
+    return peer_mod.maybe_wrap_peer_reads(reader)
 
 
 def find_reader(storage: StoragePlugin) -> Optional[CacheReaderPlugin]:
@@ -975,6 +985,7 @@ def warm_snapshot(
     metadata: Any,
     concurrency: int = 8,
     max_in_flight_bytes: int = 2 << 30,
+    items: Optional[List[Tuple[str, int]]] = None,
 ) -> Dict[str, int]:
     """Pre-fault every payload of a snapshot into the cache: one full read
     per distinct location through ``storage`` (which must already be
@@ -983,12 +994,14 @@ def warm_snapshot(
     In-flight bytes are capped at ``max_in_flight_bytes`` (each fetched
     object is wholly buffered until its populate lands; without the cap,
     concurrency × multi-GB slabs could OOM the host the warm is meant to
-    prepare — an over-limit object is admitted alone).  Returns totals:
-    locations, bytes, and how many were already resident (cache hits) vs
-    fetched."""
+    prepare — an over-limit object is admitted alone).  ``items`` narrows
+    the warm to an explicit location subset (the rollout path warms only a
+    step's DELTA).  Returns totals: locations, bytes, and how many were
+    already resident (cache hits) vs fetched."""
     from concurrent.futures import ThreadPoolExecutor
 
-    items = payload_locations(metadata)
+    if items is None:
+        items = payload_locations(metadata)
     limit = max(1, max_in_flight_bytes)
     cv = threading.Condition()
     in_flight = [0]
@@ -1019,6 +1032,11 @@ def warm_snapshot(
     stats = reader_stats(storage)
     if stats is not None:
         out.update(stats)
+    from . import peer as peer_mod
+
+    pstats = peer_mod.reader_stats(storage)
+    if pstats is not None:
+        out.update({f"peer_{k}": v for k, v in pstats.items()})
     return out
 
 

@@ -13,7 +13,7 @@ the inner plugin sees it).  Spec grammar::
 
     spec  := rule (";" rule)*             # "none" = no rules (wrapper only)
     rule  := op ":" when ":" kind [":" param] ["@" glob]
-    op    := write | read | delete | delete_dir | list | exists | any
+    op    := write | read | delete | delete_dir | list | exists | any | peer
     when  := N        fire on the Nth matching call only (1-based)
            | N+       fire on the Nth matching call and every one after
            | *        alias for 1+
@@ -27,6 +27,12 @@ the inner plugin sees it).  Spec grammar::
                                   death (no teardown, no finally blocks) —
                                   the kill-chaos harness's seeded SIGKILL
                                   analogue
+           | peer_unreachable     op=peer only: the peer fetch raises
+                                  ConnectionError (dead/refusing host)
+           | peer_slow[:seconds]  op=peer only: delay the fetch (0.25)
+           | peer_truncated       op=peer only: the received body is cut
+                                  in half AFTER wire framing — only the
+                                  digest gate can catch it
     glob  := fnmatch pattern on the storage-relative path
 
 Each rule keeps its own call counter **per plugin instance** — and the
@@ -60,9 +66,14 @@ from .telemetry import metrics as tmetrics
 logger = logging.getLogger(__name__)
 
 _OPS = frozenset(
-    {"write", "read", "delete", "delete_dir", "list", "exists", "any"}
+    {"write", "read", "delete", "delete_dir", "list", "exists", "any", "peer"}
 )
 _KINDS = frozenset({"transient", "terminal", "latency", "torn", "crash"})
+# Peer-side kinds fire in the peer HTTP *client* (peer.PeerClient builds
+# its own injector from the same spec), never in the storage wrapper: a
+# peer fault's blast radius is one candidate fetch, and the observable
+# outcome is always "fell back to the next peer / origin".
+_PEER_KINDS = frozenset({"peer_unreachable", "peer_slow", "peer_truncated"})
 
 _DEFAULT_LATENCY_S = 0.05
 _DEFAULT_TORN_FRACTION = 0.5
@@ -201,10 +212,19 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             raise ValueError(
                 f"fault rule {raw!r}: unknown op {op!r} (one of {sorted(_OPS)})"
             )
-        if kind not in _KINDS:
+        if kind not in _KINDS and kind not in _PEER_KINDS:
             raise ValueError(
                 f"fault rule {raw!r}: unknown kind {kind!r} "
-                f"(one of {sorted(_KINDS)})"
+                f"(one of {sorted(_KINDS | _PEER_KINDS)})"
+            )
+        if kind in _PEER_KINDS and op != "peer":
+            raise ValueError(
+                f"fault rule {raw!r}: {kind!r} applies to op 'peer' only"
+            )
+        if op == "peer" and kind not in _PEER_KINDS:
+            raise ValueError(
+                f"fault rule {raw!r}: op 'peer' takes one of "
+                f"{sorted(_PEER_KINDS)}"
             )
         if kind == "torn" and op != "write":
             raise ValueError(
@@ -212,6 +232,8 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
             )
         if kind == "crash" and param_str is not None:
             raise ValueError(f"fault rule {raw!r}: 'crash' takes no param")
+        if kind in ("peer_unreachable", "peer_truncated") and param_str is not None:
+            raise ValueError(f"fault rule {raw!r}: {kind!r} takes no param")
         if when == "*":
             first, open_ended = 1, True
         elif when.endswith("+"):
@@ -227,7 +249,7 @@ def parse_fault_spec(spec: str) -> List[FaultRule]:
                 raise ValueError(
                     f"fault rule {raw!r}: torn fraction must be in [0, 1)"
                 )
-            if kind == "latency" and param < 0:
+            if kind in ("latency", "peer_slow") and param < 0:
                 raise ValueError(f"fault rule {raw!r}: negative latency")
         rules.append(
             FaultRule(
@@ -403,3 +425,56 @@ def maybe_wrap_faults(
     if spec is None or not spec.strip():
         return plugin
     return FaultyStoragePlugin(plugin, parse_fault_spec(spec))
+
+
+class PeerFaultInjector:
+    """The peer HTTP client's side of the spec: only ``op=peer`` rules,
+    one counter per rule per injector instance (one injector per
+    PeerClient, so "the 2nd peer fetch of this operation" is
+    deterministic).  ``fire(path)`` advances counters and returns the rule
+    the client must act out — the *client* owns the behavior, because
+    ``peer_truncated`` must corrupt bytes after receipt and
+    ``peer_unreachable`` must look like a connect failure, neither of
+    which a storage-op wrapper can stage."""
+
+    def __init__(self, rules: List[FaultRule]) -> None:
+        self._rules = [r for r in rules if r.op == "peer"]
+        self._lock = threading.Lock()
+        self._counts = [0] * len(self._rules)
+
+    def __len__(self) -> int:
+        return len(self._rules)
+
+    def fire(self, path: str) -> Optional[FaultRule]:
+        fired: Optional[FaultRule] = None
+        with self._lock:
+            for i, rule in enumerate(self._rules):
+                if not rule.matches_path(path):
+                    continue
+                self._counts[i] += 1
+                n = self._counts[i]
+                hits = n >= rule.first if rule.open_ended else n == rule.first
+                if hits and fired is None:
+                    fired = rule
+        if fired is not None:
+            tmetrics.record_fault("peer", fired.kind)
+            logger.info(
+                "fault injected: op=peer kind=%s path=%s", fired.kind, path
+            )
+        return fired
+
+
+def maybe_peer_injector(spec: Optional[str]) -> Optional[PeerFaultInjector]:
+    """A :class:`PeerFaultInjector` for the ``op=peer`` rules of ``spec``,
+    or None when there are none (the common case — the client skips the
+    per-fetch rule scan entirely).  A malformed spec disables injection
+    rather than failing the read path; the storage-side wrapper is the
+    layer that surfaces spec typos loudly."""
+    if spec is None or not spec.strip():
+        return None
+    try:
+        rules = parse_fault_spec(spec)
+    except ValueError:
+        return None
+    injector = PeerFaultInjector(rules)
+    return injector if len(injector) else None

@@ -83,6 +83,13 @@ CDC_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "CDC_MAX_BYTES"
 STAGING_THREADS_ENV_VAR = _ENV_PREFIX + "STAGING_THREADS"
 ZSTD_WINDOW_LOG_ENV_VAR = _ENV_PREFIX + "ZSTD_WINDOW_LOG"
 ZSTD_LDM_ENV_VAR = _ENV_PREFIX + "ZSTD_LDM"
+PEER_FETCH_ENV_VAR = _ENV_PREFIX + "PEER_FETCH"
+PEER_PORT_ENV_VAR = _ENV_PREFIX + "PEER_PORT"
+PEER_ADDR_ENV_VAR = _ENV_PREFIX + "PEER_ADDR"
+PEER_TIMEOUT_S_ENV_VAR = _ENV_PREFIX + "PEER_TIMEOUT_S"
+PEER_RETRIES_ENV_VAR = _ENV_PREFIX + "PEER_RETRIES"
+PEER_GRACE_S_ENV_VAR = _ENV_PREFIX + "PEER_GRACE_S"
+PEER_BAD_TTL_S_ENV_VAR = _ENV_PREFIX + "PEER_BAD_TTL_S"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -1118,4 +1125,116 @@ def override_partial_read_min_saved_bytes(
     value: int,
 ) -> Generator[None, None, None]:
     with _override_env(PARTIAL_READ_MIN_SAVED_ENV_VAR, str(value)):
+        yield
+
+
+# Peer-to-peer chunk distribution defaults (peer.py / peerd.py): the fetch
+# timeout is per-HTTP-request against a same-fleet host — seconds, not the
+# tens-of-seconds an origin object store gets, because a slow peer has a
+# healthy fallback (another peer, then origin).  The bad-peer quarantine
+# keeps a host that served corrupt bytes (or kept timing out) out of the
+# candidate set long enough for it to restart or be replaced, without
+# blacklisting it forever on one bad read.
+_DEFAULT_PEER_TIMEOUT_S = 5.0
+_DEFAULT_PEER_RETRIES = 1
+_DEFAULT_PEER_BAD_TTL_S = 60.0
+
+
+def peer_fetch_enabled() -> bool:
+    """Whether restore/warm reads resolve cache misses peer-first
+    (``TPUSNAP_PEER_FETCH``, default off).  Takes effect only when a
+    coordination store (``TPUSNAP_STORE_PATH``/``TPUSNAP_STORE_ADDR``) and
+    a cache dir (``TPUSNAP_CACHE_DIR``) are also configured — the peer
+    tier discovers daemons through the store and lands fetched chunks in
+    the cache."""
+    return _get_bool_env(PEER_FETCH_ENV_VAR)
+
+
+def get_peer_port() -> int:
+    """TCP port ``tpusnap serve --daemon`` binds (0 = ephemeral, the
+    default — the registry advertises whatever the kernel assigned)."""
+    return max(0, _get_int_env(PEER_PORT_ENV_VAR, 0))
+
+
+def get_peer_addr() -> Optional[str]:
+    """Advertised ``host:port`` override for this host's peer daemon.
+    Defaults to the daemon's bound address; set it when peers must reach
+    the daemon through a different interface/NAT than it bound."""
+    val = os.environ.get(PEER_ADDR_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_peer_timeout_s() -> float:
+    """Per-request timeout for a peer chunk fetch.  Deliberately short:
+    a peer that can't answer in seconds is worth skipping — the chunk has
+    other homes."""
+    val = os.environ.get(PEER_TIMEOUT_S_ENV_VAR)
+    return max(0.05, float(val)) if val is not None else _DEFAULT_PEER_TIMEOUT_S
+
+
+def get_peer_retries() -> int:
+    """Transient-failure retries per peer before moving to the next
+    candidate (classified by ``retry.is_transient``; terminal failures and
+    digest rejects never retry the same peer)."""
+    return max(0, _get_int_env(PEER_RETRIES_ENV_VAR, _DEFAULT_PEER_RETRIES))
+
+
+def get_peer_grace_s() -> float:
+    """Age past which a peer daemon's unrefreshed registry stamp drops it
+    from the candidate set — the same presumed-dead rule the op-lease
+    machinery applies (defaults to ``TPUSNAP_LEASE_GRACE_S``'s resolved
+    value; clamped >= 2x the lease refresh interval)."""
+    val = os.environ.get(PEER_GRACE_S_ENV_VAR)
+    if val is None:
+        grace = get_lease_grace_s()
+        return grace if grace > 0 else _DEFAULT_LEASE_GRACE_S
+    return max(float(val), 2.0 * get_lease_interval_s())
+
+
+def get_peer_bad_ttl_s() -> float:
+    """Seconds a peer stays quarantined after serving bytes that failed
+    digest verification (or exhausting its transient budget)."""
+    val = os.environ.get(PEER_BAD_TTL_S_ENV_VAR)
+    return max(0.0, float(val)) if val is not None else _DEFAULT_PEER_BAD_TTL_S
+
+
+@contextmanager
+def override_peer_fetch(enabled: bool) -> Generator[None, None, None]:
+    with _override_env(PEER_FETCH_ENV_VAR, "1" if enabled else None):
+        yield
+
+
+@contextmanager
+def override_peer_addr(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(PEER_ADDR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_peer_timeout_s(value: float) -> Generator[None, None, None]:
+    with _override_env(PEER_TIMEOUT_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_retries(value: int) -> Generator[None, None, None]:
+    with _override_env(PEER_RETRIES_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_grace_s(value: float) -> Generator[None, None, None]:
+    with _override_env(PEER_GRACE_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_bad_ttl_s(value: float) -> Generator[None, None, None]:
+    with _override_env(PEER_BAD_TTL_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_store_path(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(STORE_PATH_ENV_VAR, value):
         yield

@@ -1,0 +1,642 @@
+"""peerd: the per-host chunk-serving daemon behind ``tpusnap serve --daemon``.
+
+A deliberately small HTTP/1.1 server (stdlib ``ThreadingHTTPServer``, no
+new dependencies) that exposes THIS host's chunk cache to the fleet:
+
+- ``GET /chunk/<algo>/<digest>`` — the chunk's bytes, digest-verified from
+  the local cache before they leave the host.  Honors single-range
+  ``Range:`` headers (``206`` + ``Content-Range``), so consumers can pull
+  sub-slices — including consumers that aren't this package at all (the
+  response is plain bytes whose name IS their checksum, so any HTTP
+  client can verify what it got; see examples/http_range_pull.py).
+  Content-addressed responses are immutable, hence ``Cache-Control:
+  immutable``.
+- ``GET /healthz`` — liveness, plus the daemon's identity.
+- ``GET /inventory`` — what this host can serve (bounded listing).
+- ``POST /rollout?step=N`` — warm the DELTA of a manager-root step into
+  the local cache through the normal read stack (peer-first when
+  ``TPUSNAP_PEER_FETCH`` is on — so a canary pulls from origin once and
+  the fleet pulls from the canaries), and report what moved.  This is the
+  server half of ``tpusnap rollout``.
+
+The daemon serves ONLY what the host already holds: a ``/chunk`` request
+for a non-resident digest is a 404, never a proxied origin read — the
+fetch policy (peer.PeerReaderPlugin) owns origin fallback, and keeping the
+daemon read-only-from-cache means fleet traffic can never amplify origin
+traffic behind the operator's back.
+
+Discovery: on start the daemon registers on the coordination KV plane
+(peer.PeerRegistration — op-lease stamps, tombstone on clean stop); peers
+find it via peer.live_peers.  No store configured = serving without
+discovery (useful for the plain-HTTP consumer demo and tests).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "PeerDaemon",
+    "resolve_rollout_target",
+    "delta_locations",
+    "rollout_fleet",
+]
+
+_INVENTORY_CAP = 5000
+
+
+# ----------------------------------------------------------- delta resolve
+
+
+def resolve_rollout_target(root: str, step: Optional[int]):
+    """``(step, snapshot_path, metadata, prev_metadata)`` for a rollout of
+    ``step`` (default: latest) under a manager root.  ``prev_metadata`` is
+    the previous committed restore point's merged view, or None when
+    ``step`` is the first — the baseline the delta is computed against."""
+    from . import journal as journal_mod
+    from .manager import SnapshotManager
+    from .pg_wrapper import PGWrapper
+    from .snapshot import Snapshot
+    from .storage_plugin import url_to_storage_plugin
+
+    mgr = SnapshotManager(root, pg=PGWrapper())
+    points = mgr.restore_points()
+    if not points:
+        raise ValueError(f"{root} has no committed restore points")
+    steps = sorted({s for s, _ in points})
+    if step is None:
+        step = steps[-1]
+    if step not in steps:
+        raise ValueError(f"step {step} has no committed restore point")
+
+    def _resolve(s: int):
+        kinds = [k for ss, k in points if ss == s]
+        if "full" in kinds:
+            snap_path = f"{root.rstrip('/')}/step_{s}"
+            return snap_path, Snapshot(snap_path).metadata
+        storage = url_to_storage_plugin(root)
+        try:
+            merged, _ = journal_mod.merged_metadata(storage, s)
+        finally:
+            storage.sync_close()
+        return journal_mod.segment_path(root.rstrip("/"), s), merged
+
+    snap_path, metadata = _resolve(step)
+    prior = [s for s in steps if s < step]
+    prev_metadata = _resolve(prior[-1])[1] if prior else None
+    return step, snap_path, metadata, prev_metadata
+
+
+def delta_locations(metadata: Any, prev_metadata: Optional[Any]):
+    """The ``(location, nbytes)`` items ``step`` introduced over the
+    previous restore point — under CAS/journal, exactly the changed
+    chunks, so pushing a fine-tune is a delta broadcast.  With no
+    baseline, everything is the delta."""
+    from . import cache as cache_mod
+
+    items = cache_mod.payload_locations(metadata)
+    if prev_metadata is None:
+        return items
+    prev = {loc for loc, _ in cache_mod.payload_locations(prev_metadata)}
+    return [(loc, nbytes) for loc, nbytes in items if loc not in prev]
+
+
+def _rollout_storage(snap_path: str, metadata: Any):
+    """The same read stack ``tpusnap warm`` uses: backend → (faults) →
+    CAS resolve → cache → (peer)."""
+    from . import cache as cache_mod
+    from . import cas as cas_mod
+    from .storage_plugin import url_to_storage_plugin
+
+    storage = url_to_storage_plugin(snap_path)
+    storage = cas_mod.maybe_wrap_cas_reads(storage, snap_path, metadata)
+    return cache_mod.maybe_wrap_cache_reads(storage, metadata)
+
+
+# --------------------------------------------------------------- the daemon
+
+
+class PeerDaemon:
+    """One host's chunk server + its registry row.
+
+    ``root`` (optional) is the manager root ``/rollout`` warms from;
+    ``cache_dir`` (default ``TPUSNAP_CACHE_DIR``) is what ``/chunk``
+    serves.  ``advertise`` overrides the registered ``host:port`` (a bare
+    host is combined with the bound port).  Registration requires a
+    coordination store (TPUSNAP_STORE_PATH/ADDR); without one the daemon
+    serves but is only reachable by explicit address.
+    """
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        cache_dir: Optional[str] = None,
+        port: Optional[int] = None,
+        advertise: Optional[str] = None,
+        register: bool = True,
+    ) -> None:
+        from . import cache as cache_mod
+        from . import knobs
+
+        self.root = root
+        cache_dir = cache_dir or knobs.get_cache_dir()
+        if not cache_dir:
+            raise ValueError(
+                "peerd needs a cache to serve: set TPUSNAP_CACHE_DIR or "
+                "pass --cache-dir"
+            )
+        self.cache_dir = cache_dir
+        self.store = cache_mod.CacheStore(cache_dir)
+        self._port = knobs.get_peer_port() if port is None else port
+        self._advertise = (
+            advertise if advertise is not None else knobs.get_peer_addr()
+        )
+        self._register = register
+        self._registration = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._rollout_lock = threading.Lock()
+        self.started_at = time.time()
+        self.addr: Optional[str] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> str:
+        """Bind, register, serve in a background thread; returns the
+        advertised ``host:port``."""
+        daemon = self
+        handler = type(
+            "_BoundHandler", (_ChunkRequestHandler,), {"daemon": daemon}
+        )
+        self._server = ThreadingHTTPServer(("", self._port), handler)
+        self._server.daemon_threads = True
+        bound_port = self._server.server_address[1]
+        self.addr = self._advertised_addr(bound_port)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpusnap_peerd",
+            daemon=True,
+        )
+        self._thread.start()
+        if self._register:
+            from . import peer as peer_mod
+
+            kv = peer_mod.resolve_kv_store()
+            if kv is not None:
+                self._registration = peer_mod.PeerRegistration(kv, self.addr)
+            else:
+                logger.warning(
+                    "peerd serving on %s without registration: no "
+                    "coordination store configured",
+                    self.addr,
+                )
+        logger.info("peerd serving %s on %s", self.cache_dir, self.addr)
+        return self.addr
+
+    def _advertised_addr(self, bound_port: int) -> str:
+        adv = self._advertise
+        if adv and ":" in adv:
+            return adv
+        host = adv or _default_host()
+        return f"{host}:{bound_port}"
+
+    def close(self) -> None:
+        """Deregister (tombstone — peers drop this host immediately) and
+        stop serving."""
+        if self._registration is not None:
+            self._registration.close()
+            self._registration = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # ----------------------------------------------------------- endpoints
+
+    def read_chunk(self, algo: str, hexdigest: str) -> Optional[bytes]:
+        """The chunk's verified bytes from the local cache, or None.  The
+        store's get() re-verifies the digest before returning, so corrupt
+        local entries are dropped rather than spread to the fleet."""
+        data = self.store.get(f"cas/{algo}/{hexdigest}")
+        if data is None or data is True:
+            return None
+        return bytes(data) if not isinstance(data, bytes) else data
+
+    def healthz(self) -> Dict[str, Any]:
+        return {
+            "ok": True,
+            "addr": self.addr,
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "cache_dir": self.cache_dir,
+            "uptime_s": round(time.time() - self.started_at, 3),
+        }
+
+    def inventory(self) -> Dict[str, Any]:
+        """What this host can serve: cache totals plus a bounded chunk
+        listing (key + size) — enough for an operator to answer "does the
+        fleet hold step N" without a full spool scan."""
+        totals = self.store.stats()
+        chunks: List[Dict[str, Any]] = []
+        truncated = False
+        for _, nbytes, _, meta_path in self.store._walk_entries():
+            if len(chunks) >= _INVENTORY_CAP:
+                truncated = True
+                break
+            try:
+                with open(meta_path, "r", encoding="utf-8") as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            chunks.append({"key": meta.get("key"), "nbytes": nbytes})
+        return {
+            "entries": totals["entries"],
+            "bytes": totals["bytes"],
+            "max_bytes": totals["max_bytes"],
+            "chunks": chunks,
+            "truncated": truncated,
+        }
+
+    def rollout(self, step: Optional[int], concurrency: int = 8) -> Dict[str, Any]:
+        """Warm ``step``'s delta into the local cache and report the
+        split: peer-served vs origin vs already-resident bytes.  One
+        rollout at a time per daemon — concurrent waves would double-fetch
+        the same delta."""
+        import uuid as _uuid
+
+        from . import cache as cache_mod
+        from . import knobs
+        from .telemetry import monitor as tmonitor
+
+        if not self.root:
+            raise ValueError("this daemon serves no manager root")
+        with self._rollout_lock, knobs.override_cache_dir(self.cache_dir):
+            # The override pins the warm to the SAME cache this daemon
+            # serves — what /rollout pulls is exactly what /chunk offers.
+            step, snap_path, metadata, prev_md = resolve_rollout_target(
+                self.root, step
+            )
+            items = delta_locations(metadata, prev_md)
+            storage = _rollout_storage(snap_path, metadata)
+            health = tmonitor.op_started(
+                "rollout", _uuid.uuid4().hex, 0, watchdog=False
+            )
+            begin = time.monotonic()
+            try:
+                stats = cache_mod.warm_snapshot(
+                    storage, metadata, concurrency=concurrency, items=items
+                )
+            except BaseException:
+                tmonitor.op_finished(health, success=False)
+                raise
+            finally:
+                storage.sync_close()
+            tmonitor.op_finished(health, success=True)
+            wall = time.monotonic() - begin
+        return {
+            "step": step,
+            "snapshot": snap_path,
+            "delta_locations": len(items),
+            "delta_bytes": stats["bytes"],
+            "wall_s": round(wall, 4),
+            "cache": {
+                k: stats.get(k, 0)
+                for k in ("hits", "misses", "hit_bytes", "miss_bytes")
+            },
+            "peer": {
+                k: stats.get(f"peer_{k}", 0)
+                for k in ("hits", "misses", "hit_bytes", "miss_bytes")
+            },
+        }
+
+
+def _default_host() -> str:
+    """The host peers should dial: the machine's name when it resolves,
+    else loopback (single-host fleets, minimal containers)."""
+    host = socket.gethostname()
+    try:
+        socket.getaddrinfo(host, None)
+        return host
+    except OSError:
+        return "127.0.0.1"
+
+
+# ------------------------------------------------------------ HTTP plumbing
+
+
+class _ChunkRequestHandler(BaseHTTPRequestHandler):
+    server_version = "tpusnap-peerd/1.0"
+    protocol_version = "HTTP/1.1"
+    daemon: PeerDaemon  # bound via subclassing in PeerDaemon.start
+
+    # Route table kept flat and explicit — this is a 4-endpoint server,
+    # not a framework.
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._send_json(200, self.daemon.healthz(), kind="healthz")
+            return
+        if path == "/inventory":
+            self._send_json(200, self.daemon.inventory(), kind="inventory")
+            return
+        if path.startswith("/chunk/"):
+            self._serve_chunk(path)
+            return
+        self._send_json(404, {"error": f"no such endpoint: {path}"}, kind="other")
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(self.path)
+        if parsed.path != "/rollout":
+            self._send_json(
+                404, {"error": f"no such endpoint: {parsed.path}"}, kind="other"
+            )
+            return
+        query = parse_qs(parsed.query)
+        try:
+            step = (
+                int(query["step"][0]) if "step" in query else None
+            )
+            concurrency = (
+                int(query["concurrency"][0]) if "concurrency" in query else 8
+            )
+        except ValueError:
+            self._send_json(
+                400, {"error": "step/concurrency must be integers"},
+                kind="rollout",
+            )
+            return
+        try:
+            result = self.daemon.rollout(step, concurrency=concurrency)
+        except Exception as e:  # noqa: BLE001 - report, don't kill the daemon
+            logger.warning("rollout failed", exc_info=True)
+            self._send_json(500, {"error": str(e)}, kind="rollout")
+            return
+        self._send_json(200, result, kind="rollout")
+
+    # ------------------------------------------------------------- chunks
+
+    def _serve_chunk(self, path: str) -> None:
+        parts = path.split("/")
+        # /chunk/<algo>/<hexdigest>
+        if len(parts) != 4 or not parts[2] or not parts[3]:
+            self._send_json(
+                400, {"error": "expected /chunk/<algo>/<digest>"}, kind="chunk"
+            )
+            return
+        algo, hexdigest = parts[2], parts[3]
+        data = self.daemon.read_chunk(algo, hexdigest)
+        if data is None:
+            self._send_json(
+                404, {"error": f"{algo}/{hexdigest} not resident"}, kind="chunk"
+            )
+            return
+        total = len(data)
+        byte_range = self._parse_range(total)
+        if byte_range is _RANGE_INVALID:
+            self._begin(416, "application/json", 0, kind="chunk")
+            self.send_header("Content-Range", f"bytes */{total}")
+            self.end_headers()
+            return
+        if byte_range is not None:
+            start, end = byte_range
+            body = data[start : end + 1]
+            self._begin(206, "application/octet-stream", len(body), kind="chunk")
+            self.send_header("Content-Range", f"bytes {start}-{end}/{total}")
+        else:
+            body = data
+            self._begin(200, "application/octet-stream", len(body), kind="chunk")
+        # Content-addressed: the name is the checksum, the bytes can
+        # never change — downstream caches may hold them forever.
+        self.send_header("Cache-Control", "public, max-age=31536000, immutable")
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("X-Chunk-Digest", f"{algo}:{hexdigest}")
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-body; its digest gate handles it
+
+    def _parse_range(self, total: int):
+        """A single ``Range: bytes=a-b`` / ``a-`` / ``-n`` header as a
+        closed interval, None when absent, ``_RANGE_INVALID`` when
+        unsatisfiable.  Multi-range requests are answered whole (200) —
+        allowed by RFC 7233 and nobody in this fleet sends them."""
+        header = self.headers.get("Range")
+        if not header or not header.startswith("bytes="):
+            return None
+        spec = header[len("bytes=") :].strip()
+        if "," in spec:
+            return None
+        start_s, sep, end_s = spec.partition("-")
+        if not sep:
+            return _RANGE_INVALID
+        try:
+            if start_s == "":
+                n = int(end_s)
+                if n <= 0:
+                    return _RANGE_INVALID
+                return max(0, total - n), total - 1
+            start = int(start_s)
+            end = int(end_s) if end_s else total - 1
+        except ValueError:
+            return _RANGE_INVALID
+        if start >= total or end < start:
+            return _RANGE_INVALID
+        return start, min(end, total - 1)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _begin(self, status: int, ctype: str, nbytes: int, kind: str) -> None:
+        from .telemetry import metrics as tmetrics
+
+        tmetrics.record_peerd_request(kind, status, nbytes)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(nbytes))
+
+    def _send_json(self, status: int, doc: Dict[str, Any], kind: str) -> None:
+        body = json.dumps(doc).encode("utf-8")
+        self._begin(status, "application/json", len(body), kind=kind)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
+        logger.debug("peerd %s: " + fmt, self.client_address[0], *args)
+
+
+_RANGE_INVALID = object()
+
+
+# -------------------------------------------------------- rollout (client)
+
+
+def rollout_fleet(
+    root: str,
+    step: Optional[int],
+    canary: int = 1,
+    verify_chunks: int = 4,
+    concurrency: int = 8,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Staged delta broadcast of ``step`` to every live daemon: the first
+    ``canary`` hosts (rendezvous-ranked by the rollout identity, so
+    repeated rollouts pick the same canaries) warm + digest-verify first;
+    only if every canary both warms AND serves spot-checked delta chunks
+    whose bytes hash to their names does the rest of the fleet go.  Fleet
+    hosts warm peer-first (TPUSNAP_PEER_FETCH in the daemon's
+    environment), so the delta leaves origin ~once and fans out
+    peer-to-peer.  Watch it live via ``tpusnap top`` on the fleet spool.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+    from urllib import request as urlrequest
+
+    from . import cas, integrity
+    from . import peer as peer_mod
+    from .event import Event
+    from .event_handlers import log_event
+
+    kv = peer_mod.resolve_kv_store()
+    if kv is None:
+        raise ValueError(
+            "rollout needs the coordination store: set TPUSNAP_STORE_PATH "
+            "or TPUSNAP_STORE_ADDR"
+        )
+    peers = peer_mod.live_peers(kv)
+    if not peers:
+        raise ValueError("no live peer daemons registered")
+    # Deterministic canary choice: rendezvous over a rollout identity.
+    ranked = peer_mod.rendezvous_order(f"rollout/{root}/{step}", peers)
+    canaries = ranked[: max(1, canary)]
+    fleet = ranked[max(1, canary) :]
+
+    log_event(
+        Event(
+            name="rollout.start",
+            metadata={
+                "root": root,
+                "step": step,
+                "canaries": len(canaries),
+                "fleet": len(fleet),
+            },
+        )
+    )
+
+    def _roll_one(p: peer_mod.PeerInfo) -> Dict[str, Any]:
+        url = f"http://{p.addr}/rollout?concurrency={concurrency}"
+        if step is not None:
+            url += f"&step={step}"
+        req = urlrequest.Request(url, method="POST")
+        try:
+            with urlrequest.urlopen(req, timeout=timeout_s) as resp:
+                doc = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001
+            return {"peer": p.addr, "ok": False, "error": repr(e)}
+        return {"peer": p.addr, "ok": True, "warm": doc}
+
+    def _verify_one(p: peer_mod.PeerInfo, sample) -> Dict[str, Any]:
+        """Spot-check: the canary must SERVE delta chunks whose bytes
+        hash to their requested names — the same trust gate every peer
+        fetch applies, applied before the fleet is pointed at it."""
+        checked = 0
+        for algo, hexdigest in sample:
+            url = f"http://{p.addr}/chunk/{algo}/{hexdigest}"
+            try:
+                with urlrequest.urlopen(url, timeout=timeout_s) as resp:
+                    body = resp.read()
+            except Exception as e:  # noqa: BLE001
+                return {"peer": p.addr, "ok": False, "error": repr(e)}
+            expect = f"{algo}:{hexdigest}"
+            if integrity.digest_as(body, expect) != expect:
+                return {
+                    "peer": p.addr,
+                    "ok": False,
+                    "error": f"digest mismatch serving {expect}",
+                }
+            checked += 1
+        return {"peer": p.addr, "ok": True, "chunks_verified": checked}
+
+    result: Dict[str, Any] = {
+        "root": root,
+        "step": step,
+        "canaries": [p.addr for p in canaries],
+        "fleet": [p.addr for p in fleet],
+    }
+    with ThreadPoolExecutor(
+        max_workers=max(1, len(peers)), thread_name_prefix="tpusnap_rollout"
+    ) as pool:
+        canary_out = list(pool.map(_roll_one, canaries))
+        result["canary_results"] = canary_out
+        failed = [r for r in canary_out if not r.get("ok")]
+        if failed:
+            result["ok"] = False
+            result["aborted"] = "canary warm failed"
+            log_event(
+                Event(
+                    name="rollout.end",
+                    metadata={"root": root, "step": step, "success": False},
+                )
+            )
+            return result
+        # Digest spot-check against each canary, on a sample of the delta
+        # the canary itself reported warming.
+        resolved_step, _, metadata, prev_md = resolve_rollout_target(root, step)
+        result["step"] = resolved_step
+        sample: List[Tuple[str, str]] = []
+        for loc, _ in delta_locations(metadata, prev_md):
+            if cas.is_cas_location(loc):
+                sample.append(cas.parse_cas_location(loc))
+            elif cas.is_casx_location(loc):
+                sample.extend(
+                    (algo, hexd)
+                    for algo, hexd, _ in cas.parse_casx_location(loc)
+                )
+            if len(sample) >= verify_chunks:
+                break
+        sample = sample[:verify_chunks]
+        verify_out = list(
+            pool.map(lambda p: _verify_one(p, sample), canaries)
+        )
+        result["canary_verify"] = verify_out
+        failed = [r for r in verify_out if not r.get("ok")]
+        if failed:
+            result["ok"] = False
+            result["aborted"] = "canary digest verification failed"
+            log_event(
+                Event(
+                    name="rollout.end",
+                    metadata={"root": root, "step": step, "success": False},
+                )
+            )
+            return result
+        fleet_out = list(pool.map(_roll_one, fleet))
+        result["fleet_results"] = fleet_out
+        result["ok"] = all(r.get("ok") for r in fleet_out)
+    log_event(
+        Event(
+            name="rollout.end",
+            metadata={
+                "root": root,
+                "step": resolved_step,
+                "success": result["ok"],
+            },
+        )
+    )
+    return result

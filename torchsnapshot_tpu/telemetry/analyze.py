@@ -76,8 +76,13 @@ PHASE_GROUPS: Dict[str, frozenset] = {
     # phases (cache.py) are local-disk I/O standing in for origin storage,
     # so they classify the same way (cache_read would suffix-match anyway;
     # both are listed so the registry is explicit).
+    # peer_read is wall spent pulling a chunk from a fleet peer's daemon
+    # (peer.py) — network I/O standing in for origin storage, same group
+    # (it would suffix-match _read anyway; listed so the registry is
+    # explicit).
     "storage_io": frozenset(
-        {"native_write_hash", "native_read", "cache_read", "cache_populate"}
+        {"native_write_hash", "native_read", "cache_read", "cache_populate",
+         "peer_read"}
     ),
 }
 _STORAGE_SUFFIXES = ("_write", "_read")

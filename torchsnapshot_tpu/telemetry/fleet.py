@@ -156,6 +156,12 @@ def build_entry(mon: Any) -> Dict[str, Any]:
         doc["cache"] = cache_mod.process_stats()
     except Exception:  # cache layer must never fail telemetry
         doc["cache"] = {}
+    try:
+        from .. import peer as peer_mod
+
+        doc["peer"] = peer_mod.process_stats()
+    except Exception:  # peer layer must never fail telemetry
+        doc["peer"] = {}
     return doc
 
 
@@ -467,6 +473,7 @@ def _worker_row(doc: Dict[str, Any]) -> Dict[str, Any]:
         "age_s": doc.get("_age_s", 0.0),
         "proc": doc.get("proc") or {},
         "cache": doc.get("cache") or {},
+        "peer": doc.get("peer") or {},
     }
 
 
@@ -490,6 +497,13 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         # Newest entry per process wins (entries arrive oldest-first).
         per_proc[w["worker"]] = w
     cache_totals = {"hits": 0, "misses": 0, "hit_bytes": 0, "miss_bytes": 0}
+    peer_totals = {
+        "hits": 0,
+        "misses": 0,
+        "hit_bytes": 0,
+        "miss_bytes": 0,
+        "rejects": 0,
+    }
     proc_totals = {
         "ops_done": 0,
         "ops_failed": 0,
@@ -500,6 +514,8 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
     for w in per_proc.values():
         for k in cache_totals:
             cache_totals[k] += int(w["cache"].get(k, 0) or 0)
+        for k in peer_totals:
+            peer_totals[k] += int(w["peer"].get(k, 0) or 0)
         for k in proc_totals:
             proc_totals[k] += w["proc"].get(k, 0) or 0
     proc_totals["overhead_s"] = round(proc_totals["overhead_s"], 6)
@@ -517,6 +533,12 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
             if hit_and_miss
             else None
         ),
+    }
+    peer_view = {
+        **peer_totals,
+        # Bytes the fleet DIDN'T pull from origin because a peer served
+        # them — the distribution tier's offload headline.
+        "offload_bytes": peer_totals["hit_bytes"],
     }
     # Straggler ranking over LIVE workers: unknown-ETA workers rank by
     # lowest completion fraction (they haven't even sized their work).
@@ -557,6 +579,7 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "op_totals": op_totals,
         "proc_totals": proc_totals,
         "cache": cache_view,
+        "peer": peer_view,
         "stragglers": stragglers,
         "straggler": stragglers[0] if stragglers else None,
     }
@@ -592,6 +615,14 @@ def render(view: Dict[str, Any], spool: str) -> str:
         f"({_fmt_bytes(cache['origin_bytes'])} from origin); "
         f"telemetry overhead {view['proc_totals']['overhead_s']:.3f}s"
     )
+    peer = view.get("peer") or {}
+    if peer.get("hits") or peer.get("misses") or peer.get("rejects"):
+        lines.append(
+            f"peer: {_fmt_bytes(peer.get('hit_bytes', 0))} from "
+            f"{peer.get('hits', 0)} peer fetches, "
+            f"{peer.get('misses', 0)} origin fallbacks, "
+            f"{peer.get('rejects', 0)} rejected"
+        )
     for dead in view.get("suspected_dead") or ():
         lines.append(
             f"SUSPECTED DEAD: {dead['worker']} rank {dead['rank']} "
@@ -719,6 +750,15 @@ def render_prometheus(entries: List[Dict[str, Any]]) -> str:
     )
     lines.append("# TYPE tpusnap_fleet_origin_bytes gauge")
     lines.append(f"tpusnap_fleet_origin_bytes {view['cache']['origin_bytes']}")
+    lines.append(
+        "# HELP tpusnap_fleet_peer_bytes Bytes served by fleet peers "
+        "instead of origin across fleet processes"
+    )
+    lines.append("# TYPE tpusnap_fleet_peer_bytes gauge")
+    lines.append(
+        f"tpusnap_fleet_peer_bytes "
+        f"{int((view.get('peer') or {}).get('hit_bytes', 0))}"
+    )
     if "tpusnap_fleet_stale_peers" not in fams:
         # (skip when a merged worker registry already carries the family —
         # a duplicate TYPE line is invalid exposition)

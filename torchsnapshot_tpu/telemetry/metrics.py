@@ -487,6 +487,61 @@ def record_cache(
         ).inc(miss_bytes)
 
 
+def record_peer(
+    hits: int, misses: int, hit_bytes: int, miss_bytes: int
+) -> None:
+    """One read operation's peer-tier outcome (peer.py): chunks fetched
+    from fleet peers vs fallen back to origin, and the byte split — the
+    cross-host distribution headline (origin offload = peer_hit_bytes)."""
+    if not enabled() or not (hits or misses):
+        return
+    if hits:
+        counter(
+            "tpusnap_peer_hits_total",
+            "Chunks fetched from fleet peers instead of origin",
+        ).inc(hits)
+        counter(
+            "tpusnap_peer_hit_bytes_total",
+            "Bytes fetched from fleet peers instead of origin",
+        ).inc(hit_bytes)
+    if misses:
+        counter(
+            "tpusnap_peer_misses_total",
+            "Digest chunk reads no peer could serve (origin fallback)",
+        ).inc(misses)
+        counter(
+            "tpusnap_peer_miss_bytes_total",
+            "Bytes read from origin after the peer tier came up empty",
+        ).inc(miss_bytes)
+
+
+def record_peer_reject(reason: str) -> None:
+    """One peer response discarded before trust: digest mismatch,
+    truncation, or an unverifiable body.  The peer is quarantined; the
+    read proceeds from the next candidate or origin."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_peer_rejects_total",
+        "Peer chunk responses rejected by digest verification",
+    ).inc(reason=reason)
+
+
+def record_peerd_request(kind: str, status: int, nbytes: int = 0) -> None:
+    """One request served by this host's peer daemon (peerd.py)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_peerd_requests_total",
+        "HTTP requests served by the peer chunk daemon",
+    ).inc(kind=kind, status=str(status))
+    if nbytes:
+        counter(
+            "tpusnap_peerd_bytes_total",
+            "Chunk bytes served to peers by this host's daemon",
+        ).inc(nbytes, kind=kind)
+
+
 def record_cache_wait(seconds: float) -> None:
     """Wall one cold read spent parked on a sibling's in-flight populate
     (the cache's per-key single-flight lock, cache.py).  A fleet whose
@@ -656,6 +711,9 @@ DIRECT_METRIC_EVENTS = frozenset(
         "cache.miss",  # record_cache
         "cache.evict",  # record_cache_evicted
         "cache.wait",  # record_cache_wait
+        "peer.hit",  # record_peer
+        "peer.miss",  # record_peer
+        "peer.reject",  # record_peer_reject
     }
 )
 
