@@ -293,6 +293,7 @@ def _serve_worker(path: str) -> int:
     from torchsnapshot_tpu.telemetry import fleet as tfleet
     from torchsnapshot_tpu.telemetry import monitor as tmonitor
     from torchsnapshot_tpu.telemetry import sidecar as tsidecar
+    from torchsnapshot_tpu.telemetry import trace as ttrace
 
     snap = Snapshot(path)
     md = snap.metadata
@@ -319,6 +320,10 @@ def _serve_worker(path: str) -> int:
     op_id = uuid.uuid4().hex
     phases_before = phase_stats.snapshot()
     mon = tmonitor.op_started("serve", op_id, 0, watchdog=False)
+    # With TPUSNAP_TRACE_DIR set this op (and the peer_fetch spans inside
+    # it) lands in a per-worker trace file — the serving-plane tracing the
+    # overhead proof below bills for.
+    trace_op = ttrace.begin_op("serve", op_id, 0)
     start = time.time()
     t0 = time.monotonic()
     nbytes = 0
@@ -327,9 +332,11 @@ def _serve_worker(path: str) -> int:
             state = snap.get_state_dict_for_key(key)
             nbytes += _serve_state_nbytes(state)
     except BaseException:
+        ttrace.end_op(trace_op, success=False)
         tmonitor.op_finished(mon, success=False)
         raise
     wall = time.monotonic() - t0
+    ttrace.end_op(trace_op, success=True)
     tmonitor.op_finished(mon, success=True)
     cache_stats = tcache.process_stats()
     if tsidecar.enabled():
@@ -364,6 +371,8 @@ def _serve_worker(path: str) -> int:
     # wall total includes time the publisher thread spent descheduled
     # behind this very restore and is reported alongside for reference.
     cal = tfleet.calibrated_overhead_s()
+    span_cal = ttrace.calibrated_span_cost_s()
+    board_cal = tpeer.calibrated_scoreboard_cost_s()
     out = {
         "start": start,
         "end": time.time(),
@@ -373,6 +382,12 @@ def _serve_worker(path: str) -> int:
         "telemetry_overhead_s": cal["estimated_s"],
         "telemetry_overhead_raw_s": round(tfleet.process_overhead_s(), 6),
         "telemetry_publishes": cal["publishes"],
+        # Serving-plane tracing bill, measured the same way: isolated
+        # per-unit cost x units this process actually performed.
+        "trace_overhead_s": span_cal["estimated_s"],
+        "trace_spans": span_cal["spans"],
+        "scoreboard_overhead_s": board_cal["estimated_s"],
+        "scoreboard_updates": board_cal["updates"],
         **cache_stats,
         # Peer-tier split (all zero unless TPUSNAP_PEER_FETCH was on):
         # peer_hit_bytes came from sibling daemons instead of origin.
@@ -1852,6 +1867,7 @@ def main() -> None:
         with _peer_knobs.override_cas(True):
             Snapshot.take(peer_snap, serve_state)
         peer_kv = os.path.join(peer_root, "kv")
+        peer_trace_dir = os.path.join(peer_root, "trace")
 
         def _peer_env(host_idx, peer_fetch, seed_warm=False):
             env = dict(os.environ)
@@ -1861,6 +1877,10 @@ def main() -> None:
             )
             env["TPUSNAP_STORE_PATH"] = peer_kv
             env["TPUSNAP_FAULTS"] = "none"  # pure per-host origin meter
+            # Serving-plane tracing ON for the whole peer round (client
+            # peer_fetch spans, daemon peerd_handle spans + access logs):
+            # the overhead proof below runs against real traced traffic.
+            env["TPUSNAP_TRACE_DIR"] = peer_trace_dir
             env["TPUSNAP_PEER_FETCH"] = "1" if peer_fetch else "0"
             # Large whole-slab chunks over GIL-shared loopback can stall a
             # socket read past the 5 s default on a starved box; a timed-out
@@ -1982,6 +2002,36 @@ def main() -> None:
             <= 1.25 * serve_logical,
             "aggregate_scales_with_hosts": multi_agg >= 1.3 * single_agg,
         }
+        # Serving-plane tracing + peer-scoreboard overhead, measured the
+        # same way as the fleet-telemetry budget: isolated per-unit cost x
+        # units each traced worker performed, summed over the peer round
+        # (the only round that ran with TPUSNAP_TRACE_DIR set) and held
+        # against those workers' own op wall.
+        traced_docs = [seed_doc] + all_pull_docs
+        traced_wall = sum(d["wall_s"] for d in traced_docs)
+        trace_overhead_s = sum(
+            d.get("trace_overhead_s", 0.0) for d in traced_docs
+        )
+        scoreboard_overhead_s = sum(
+            d.get("scoreboard_overhead_s", 0.0) for d in traced_docs
+        )
+        tracing_total_s = trace_overhead_s + scoreboard_overhead_s
+        tracing_probe = {
+            "trace_overhead_s": round(trace_overhead_s, 6),
+            "trace_spans": sum(d.get("trace_spans", 0) for d in traced_docs),
+            "scoreboard_overhead_s": round(scoreboard_overhead_s, 6),
+            "scoreboard_updates": sum(
+                d.get("scoreboard_updates", 0) for d in traced_docs
+            ),
+            "overhead_s": round(tracing_total_s, 6),
+            "worker_wall_s": round(traced_wall, 4),
+            "overhead_frac_of_wall": round(
+                tracing_total_s / traced_wall, 6
+            )
+            if traced_wall
+            else 0.0,
+            "overhead_below_1pct": tracing_total_s < 0.01 * traced_wall,
+        }
         log(
             f"multi-host peer probe ({multihost['hosts']} hosts, "
             f"{n_hosts} concurrent pullers): origin "
@@ -2027,6 +2077,7 @@ def main() -> None:
         }
         serve_probe = {
             "fleet": fleet_probe,
+            "tracing": tracing_probe,
             "multihost": multihost,
             "workers": n_serve,
             "snapshot_bytes": serve_logical,
@@ -2066,6 +2117,13 @@ def main() -> None:
             f"{fleet_probe['telemetry_overhead_s']}s = "
             f"{100 * fleet_probe['overhead_frac_of_wall']:.3f}% of op wall "
             f"(<1%: {fleet_probe['overhead_below_1pct']})"
+        )
+        log(
+            f"serving-plane tracing: {tracing_probe['trace_spans']} spans + "
+            f"{tracing_probe['scoreboard_updates']} scoreboard updates cost "
+            f"{tracing_probe['overhead_s']}s = "
+            f"{100 * tracing_probe['overhead_frac_of_wall']:.3f}% of op "
+            f"wall (<1%: {tracing_probe['overhead_below_1pct']})"
         )
         shutil.rmtree(serve_root, ignore_errors=True)
         _PARTIAL.setdefault("banked", {})["serve"] = serve_probe

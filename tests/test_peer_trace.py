@@ -1,0 +1,594 @@
+"""Serving-plane distributed tracing and observability.
+
+Covers trace-context propagation end to end (client ``peer_fetch`` spans
+→ ``traceparent`` header → daemon ``peerd_handle`` spans sharing one
+trace id), fleet trace stitching (``tpusnap trace --fleet``), the
+``analyze --peer`` report, the peer scoreboard + demotion policy,
+fault-injected span outcomes, the daemon access log schema, live rollout
+progress in the fleet view, and the regression that a long-lived daemon
+is never triaged suspected-dead while its ``serve`` op keeps refreshing.
+
+The check.sh serving-plane tracing gate runs this file.
+"""
+
+import contextlib
+import glob
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import Snapshot, StateDict, faults, knobs
+from torchsnapshot_tpu import cache as cache_mod
+from torchsnapshot_tpu import peer as peer_mod
+from torchsnapshot_tpu import peerd as peerd_mod
+from torchsnapshot_tpu.event_handlers import (
+    register_event_handler,
+    unregister_event_handler,
+)
+from torchsnapshot_tpu.telemetry import analyze as tanalyze
+from torchsnapshot_tpu.telemetry import fleet as tfleet
+from torchsnapshot_tpu.telemetry import monitor as tmonitor
+from torchsnapshot_tpu.telemetry import trace as ttrace
+
+
+def _state(nbytes_per_leaf=1 << 20, leaves=4, seed=0):
+    return {
+        "m": StateDict(
+            {
+                f"w{i}": np.frombuffer(
+                    np.random.RandomState(seed * 100 + i).bytes(
+                        nbytes_per_leaf
+                    ),
+                    np.uint8,
+                ).copy()
+                for i in range(leaves)
+            }
+        )
+    }
+
+
+def _zeros_like(state):
+    return {
+        "m": StateDict({k: np.zeros_like(v) for k, v in state["m"].items()})
+    }
+
+
+def _warm_into(snap_path, metadata, cache_dir):
+    with knobs.override_cache_dir(cache_dir):
+        storage = peerd_mod._rollout_storage(snap_path, metadata)
+        try:
+            return cache_mod.warm_snapshot(storage, metadata)
+        finally:
+            storage.sync_close()
+
+
+@contextlib.contextmanager
+def _daemon(cache_dir, root=None, register=True):
+    d = peerd_mod.PeerDaemon(
+        root=root, cache_dir=cache_dir, advertise="127.0.0.1",
+        register=register,
+    )
+    d.start()
+    try:
+        yield d
+    finally:
+        d.close()
+
+
+@pytest.fixture
+def peer_env(tmp_path):
+    with knobs.override_store_path(
+        str(tmp_path / "kv")
+    ), knobs.override_faults("none"):
+        faults.reset_read_counters()
+        peer_mod.reset_process_stats()
+        yield tmp_path
+
+
+def _trace_docs(trace_dir):
+    docs = []
+    for path in sorted(
+        glob.glob(os.path.join(trace_dir, f"*{ttrace.TRACE_FILE_SUFFIX}"))
+    ):
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        doc["_file"] = os.path.basename(path)
+        docs.append(doc)
+    return docs
+
+
+def _spans(docs, name):
+    return [
+        ev
+        for doc in docs
+        for ev in doc.get("traceEvents", [])
+        if ev.get("ph") == "X" and ev.get("name") == name
+    ]
+
+
+# ------------------------------------------------- trace-context plumbing
+
+
+def test_traceparent_roundtrip_and_trace_id_determinism():
+    tid = ttrace.trace_id_for("op-123")
+    assert tid == ttrace.trace_id_for("op-123")
+    assert len(tid) == 32 and int(tid, 16) != 0
+    header = f"00-{tid}-00000000000000ab-01"
+    assert ttrace.parse_traceparent(header) == (tid, 0xAB)
+    assert ttrace.parse_traceparent(None) is None
+    assert ttrace.parse_traceparent("junk") is None
+    assert ttrace.parse_traceparent("00-short-ab-01") is None
+    # All-zero trace / span ids are invalid per W3C trace-context.
+    assert ttrace.parse_traceparent(f"00-{'0' * 32}-{'1' * 16}-01") is None
+    assert ttrace.parse_traceparent(f"00-{tid}-{'0' * 16}-01") is None
+
+
+def test_current_traceparent_tracks_active_span(tmp_path):
+    assert ttrace.current_traceparent() is None
+    with knobs.override_trace_dir(str(tmp_path / "tr")):
+        op = ttrace.begin_op("restore", "ctxop1", 0)
+        try:
+            header = ttrace.current_traceparent()
+            trace_id, parent = ttrace.parse_traceparent(header)
+            assert trace_id == ttrace.trace_id_for("ctxop1")
+            assert parent == op.root_span_id
+            with ttrace.span("peer_fetch", cat="phase") as sp:
+                _, inner = ttrace.parse_traceparent(
+                    ttrace.current_traceparent()
+                )
+                assert inner != parent  # child span is now the parent
+        finally:
+            ttrace.end_op(op)
+    assert ttrace.current_traceparent() is None
+
+
+# ------------------------------------------- fault-injected span outcomes
+
+
+@pytest.mark.parametrize(
+    "spec,expect_status",
+    [
+        ("peer:1:peer_unreachable", "error"),
+        ("peer:1:peer_slow:0.2", "hit"),
+        ("peer:1:peer_truncated", "reject"),
+    ],
+)
+def test_fault_injected_fetch_spans(peer_env, spec, expect_status):
+    """Each injected peer fault leaves a ``peer_fetch`` span whose status
+    and duration reflect the fault; the reject path's quarantine event
+    carries the trace id."""
+    tmp_path = peer_env
+    state = _state(leaves=1)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    trace_dir = str(tmp_path / "traces")
+    events = []
+    handler = events.append
+    register_event_handler(handler)
+    try:
+        with _daemon(str(tmp_path / "cacheA")) as d:
+            inv = json.loads(
+                urllib.request.urlopen(f"http://{d.addr}/inventory").read()
+            )
+            _, algo, hexdigest = inv["chunks"][0]["key"].split("/")
+            kv = peer_mod.resolve_kv_store()
+            with knobs.override_trace_dir(trace_dir), knobs.override_faults(
+                spec
+            ), knobs.override_peer_timeout_s(2.0), knobs.override_peer_retries(
+                0
+            ):
+                op = ttrace.begin_op("restore", "faultop1", 0)
+                try:
+                    client = peer_mod.PeerClient(kv)
+                    data = client.fetch_chunk(algo, hexdigest)
+                finally:
+                    ttrace.end_op(op)
+    finally:
+        unregister_event_handler(handler)
+
+    fetch_spans = _spans(_trace_docs(trace_dir), "peer_fetch")
+    assert fetch_spans
+    span = fetch_spans[0]
+    assert span["args"]["status"] == expect_status
+    assert span["args"]["peer"] == d.addr
+    if expect_status == "hit":
+        assert data is not None
+        # The injected 0.2s delay must show up in the span's wall.
+        assert span["dur"] >= 0.18e6, span["dur"]
+    else:
+        assert data is None
+    if expect_status == "reject":
+        rejects = [e for e in events if e.name == "peer.reject"]
+        assert rejects
+        assert rejects[0].metadata.get("trace") == ttrace.trace_id_for(
+            "faultop1"
+        )
+
+
+# --------------------------------------------- two-daemon fleet stitching
+
+
+def test_two_daemon_restore_stitches_one_trace(peer_env):
+    """END-TO-END TRACE PROOF: a peer-first restore against two daemons
+    yields ONE trace id spanning the client's ``peer_fetch`` spans and
+    both daemons' ``peerd_handle`` spans (remote parent = the client span
+    that issued the request); ``merge_fleet_traces`` stitches all files
+    into one schema-valid timeline; the access log is schema-valid; the
+    fleet view grows a populated PEERS scoreboard."""
+    tmp_path = peer_env
+    state = _state()
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheB"))
+    trace_dir = str(tmp_path / "traces")
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    with knobs.override_trace_dir(trace_dir), knobs.override_fleet_telemetry(
+        spool
+    ):
+        with _daemon(str(tmp_path / "cacheA")), _daemon(
+            str(tmp_path / "cacheB")
+        ):
+            with knobs.override_cache_dir(
+                str(tmp_path / "cacheC")
+            ), knobs.override_peer_fetch(True):
+                dst = _zeros_like(state)
+                snap.restore(dst)
+    for key, arr in state["m"].items():
+        np.testing.assert_array_equal(np.asarray(dst["m"][key]), arr)
+
+    docs = _trace_docs(trace_dir)
+    restore_docs = [
+        d for d in docs if d["otherData"].get("kind") == "restore"
+    ]
+    assert restore_docs
+    trace_id = restore_docs[0]["otherData"]["trace_id"]
+    assert trace_id
+    client_fetches = _spans(restore_docs, "peer_fetch")
+    assert client_fetches
+
+    peerd_docs = [d for d in docs if d["otherData"].get("kind") == "peerd"]
+    assert peerd_docs, [d["_file"] for d in docs]
+    handles = _spans(peerd_docs, "peerd_handle")
+    stitched = [
+        ev for ev in handles if ev["args"].get("trace") == trace_id
+    ]
+    assert stitched, handles
+    # The daemon spans' remote parents are real client peer_fetch spans.
+    fetch_span_ids = {
+        f"{ev['args']['span_id']:016x}"
+        for ev in client_fetches
+        if "span_id" in ev.get("args", {})
+    }
+    assert any(
+        ev["args"].get("parent") in fetch_span_ids for ev in stitched
+    )
+    for ev in stitched:
+        assert ev["args"]["status"] in (200, 206, 404)
+        assert "digest" in ev["args"]
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, f"*{ttrace.TRACE_FILE_SUFFIX}"))
+    )
+    merged = ttrace.merge_fleet_traces(paths, spool=spool)
+    assert ttrace.validate_trace(merged) == []
+    assert trace_id in merged["otherData"]["trace_ids"]
+    merged_files = {
+        src["file"] for src in merged["otherData"]["merged_from"]
+    }
+    assert len(merged_files) >= 3  # client op + two daemons
+
+    logs = glob.glob(os.path.join(trace_dir, f"*{ttrace.ACCESS_LOG_SUFFIX}"))
+    assert logs
+    for log_path in logs:
+        assert ttrace.validate_access_log(log_path) == []
+        with open(log_path, "r", encoding="utf-8") as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+    traced_lines = [ln for ln in lines if ln.get("trace") == trace_id]
+    assert traced_lines and all(
+        ln["status"] in (200, 206, 404) for ln in traced_lines
+    )
+
+    # The scoreboard rode the restore's terminal fleet entry.
+    view = tfleet.aggregate(tfleet.collect(spool, stale_s=1e9))
+    assert view["peer_scoreboard"]
+    assert any(
+        row.get("hits", 0) > 0 for row in view["peer_scoreboard"].values()
+    )
+    assert "PEERS" in tfleet.render(view, spool)
+
+
+# ---------------------------------------------------- analyze --peer report
+
+
+def test_analyze_peer_report_names_slowest_peer(peer_env):
+    tmp_path = peer_env
+    state = _state(leaves=2)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    trace_dir = str(tmp_path / "traces")
+    with _daemon(str(tmp_path / "cacheA")) as d:
+        slow_addr = d.addr
+        with knobs.override_trace_dir(trace_dir), knobs.override_cache_dir(
+            str(tmp_path / "cacheB")
+        ), knobs.override_peer_fetch(True), knobs.override_faults(
+            "peer:1:peer_slow:0.1"
+        ), knobs.override_peer_timeout_s(5.0):
+            dst = _zeros_like(state)
+            snap.restore(dst)
+
+    docs = tanalyze.load_trace_dir(trace_dir)
+    report = tanalyze.peer_report(docs)
+    assert report["slowest_peer"] == slow_addr
+    row = report["peers"][slow_addr]
+    assert row["fetches"] > 0
+    assert row["p99_s"] >= 0.09  # the injected delay dominates
+    assert row["hit_rate"] > 0
+    assert row["ttfb_mean_s"] + row["transfer_mean_s"] > 0
+    rendered = tanalyze.render_peer(report)
+    assert slow_addr in rendered and "slowest peer" in rendered
+
+
+# ----------------------------------------------------------- scoreboard
+
+
+def test_scoreboard_demotes_persistently_slow_peer(peer_env):
+    """A peer whose latency EWMA exceeds factor x fleet median (>=2 other
+    peers reporting) is demoted — flagged in the scoreboard and moved to
+    the back of the candidate order — and factor 0 disables the policy."""
+    peer_mod.reset_peer_scoreboard()
+    with knobs.override_peer_demote_factor(3.0):
+        for _ in range(8):
+            peer_mod.record_fetch_outcome("10.0.0.1:1", 0.01, "hit", 100)
+            peer_mod.record_fetch_outcome("10.0.0.2:1", 0.012, "hit", 100)
+        demoted = False
+        for _ in range(8):
+            demoted = (
+                peer_mod.record_fetch_outcome("10.0.0.3:1", 0.5, "hit", 100)
+                or demoted
+            )
+        assert demoted
+        board = peer_mod.peer_scoreboard()
+        assert board["10.0.0.3:1"]["demoted"]
+        assert not board["10.0.0.1:1"]["demoted"]
+        assert board["10.0.0.3:1"]["p99_s"] >= board["10.0.0.1:1"]["p99_s"]
+        assert peer_mod._demoted_addrs() == {"10.0.0.3:1"}
+
+    peer_mod.reset_peer_scoreboard()
+    with knobs.override_peer_demote_factor(0.0):
+        for _ in range(8):
+            peer_mod.record_fetch_outcome("a:1", 0.01, "hit")
+            peer_mod.record_fetch_outcome("b:1", 0.01, "hit")
+            assert not peer_mod.record_fetch_outcome("c:1", 5.0, "hit")
+    assert peer_mod._demoted_addrs() == set()
+    peer_mod.reset_peer_scoreboard()
+
+
+def test_scoreboard_demotes_flaky_peer_on_error_ewma(peer_env):
+    peer_mod.reset_peer_scoreboard()
+    demoted = False
+    for _ in range(12):
+        demoted = (
+            peer_mod.record_fetch_outcome("bad:1", 0.01, "error") or demoted
+        )
+    assert demoted
+    board = peer_mod.peer_scoreboard()
+    assert board["bad:1"]["ewma_error"] > 0.5
+    assert board["bad:1"]["errors"] == 12
+    peer_mod.reset_peer_scoreboard()
+
+
+def test_demoted_peer_ranked_last_in_candidates(peer_env):
+    kv = peer_mod.resolve_kv_store()
+    regs = [
+        peer_mod.PeerRegistration(kv, f"10.9.0.{i}:9000") for i in range(3)
+    ]
+    try:
+        peer_mod.reset_peer_scoreboard()
+        client = peer_mod.PeerClient(kv)
+        baseline = [p.addr for p in client.candidates("chunk/z")]
+        front = baseline[0]
+        for _ in range(12):
+            peer_mod.record_fetch_outcome(front, 0.01, "error")
+        reordered = [p.addr for p in client.candidates("chunk/z")]
+        assert reordered[-1] == front
+        assert set(reordered) == set(baseline)
+    finally:
+        peer_mod.reset_peer_scoreboard()
+        for reg in regs:
+            reg.close()
+
+
+# ------------------------------------------------ daemon fleet presence
+
+
+def test_daemon_outliving_stale_window_not_suspected_dead(peer_env):
+    """REGRESSION: a daemon older than TPUSNAP_FLEET_TELEMETRY_STALE_S is
+    NOT triaged suspected-dead — its `serve` op's tick thread keeps
+    refreshing the spool entry for as long as the daemon lives."""
+    tmp_path = peer_env
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    with knobs.override_fleet_telemetry(
+        spool
+    ), knobs.override_fleet_telemetry_interval_s(
+        0.15
+    ), knobs.override_fleet_telemetry_stale_s(0.6):
+        with _daemon(str(tmp_path / "cacheA")):
+            time.sleep(2.0)  # daemon now outlives the stale bound 3x over
+            entries = tfleet.collect(spool)
+            serve = [d for d in entries if d.get("kind") == "serve"]
+            assert serve, entries
+            assert not any(d.get("_stale") for d in serve)
+            view = tfleet.aggregate(entries)
+            rows = [w for w in view["workers"] if w["kind"] == "serve"]
+            assert rows
+            assert all(w["state"] != "suspected-dead" for w in rows)
+        # Clean close folds the entry terminal.
+        entries = tfleet.collect(spool, stale_s=1e9)
+        serve = [d for d in entries if d.get("kind") == "serve"]
+        assert serve and all(
+            (d.get("op") or {}).get("done") for d in serve
+        )
+
+
+def test_rollout_progress_surfaces_in_top(peer_env):
+    """An in-flight rollout op's wave doc reaches the aggregated view and
+    renders as the `top` banner; the terminal fold clears it."""
+    tmp_path = peer_env
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    with knobs.override_fleet_telemetry(spool):
+        mon = tmonitor.op_started("rollout", "r" * 32, 0, watchdog=False)
+        try:
+            mon.fleet_extra = {
+                "rollout": {
+                    "root": "mem://ckpts",
+                    "step": 7,
+                    "wave": "fleet",
+                    "completed": 2,
+                    "total": 4,
+                    "peer_bytes": 1 << 20,
+                    "origin_bytes": 1024,
+                    "eta_s": 3.5,
+                }
+            }
+            tfleet.publish(mon)
+            view = tfleet.aggregate(tfleet.collect(spool, stale_s=1e9))
+            assert view["rollout"] is not None
+            assert view["rollout"]["wave"] == "fleet"
+            assert view["rollout"]["completed"] == 2
+            out = tfleet.render(view, spool)
+            assert "ROLLOUT in flight" in out
+            assert "wave fleet" in out
+        finally:
+            tmonitor.op_finished(mon, success=True)
+        view = tfleet.aggregate(tfleet.collect(spool, stale_s=1e9))
+        assert view["rollout"] is None
+
+
+def test_rollout_fleet_emits_wave_events_and_progress(peer_env):
+    """A real two-daemon rollout emits rollout.wave events for every wave
+    transition and leaves a terminal rollout spool entry carrying the
+    final wave doc."""
+    from torchsnapshot_tpu.manager import SnapshotManager
+
+    tmp_path = peer_env
+    root = str(tmp_path / "ckpts")
+    with knobs.override_cas(True):
+        mgr = SnapshotManager(root)
+        mgr.save(1, _state(seed=0, leaves=2))
+        state2 = _state(seed=0, leaves=2)
+        state2["m"]["w0"] = np.frombuffer(
+            np.random.RandomState(777).bytes(1 << 20), np.uint8
+        ).copy()
+        mgr.save(2, state2)
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    events = []
+    handler = events.append
+    register_event_handler(handler)
+    try:
+        with knobs.override_peer_fetch(True), knobs.override_fleet_telemetry(
+            spool
+        ):
+            with _daemon(str(tmp_path / "cacheA"), root=root), _daemon(
+                str(tmp_path / "cacheB"), root=root
+            ):
+                out = peerd_mod.rollout_fleet(root, None, canary=1)
+    finally:
+        unregister_event_handler(handler)
+    assert out["ok"], out
+    waves = [
+        e.metadata["wave"] for e in events if e.name == "rollout.wave"
+    ]
+    assert waves == ["canary", "verify", "fleet"]
+    entries = tfleet.collect(spool, stale_s=1e9)
+    rollout_entries = [d for d in entries if d.get("kind") == "rollout"]
+    assert rollout_entries
+    final = rollout_entries[-1]
+    doc = (final.get("extra") or {}).get("rollout")
+    assert doc and doc["wave"] == "fleet"
+    assert doc["completed"] == doc["total"] == 1
+    assert doc["peer_bytes"] > 0  # the fleet host pulled from the canary
+
+
+# --------------------------------------------------- daemon HTTP additions
+
+
+def test_daemon_metrics_endpoint_exposes_fetch_histogram(peer_env):
+    """GET /metrics serves the process registry, including the explicit-
+    bucket peer-fetch histogram once the process has fetched from a
+    peer."""
+    tmp_path = peer_env
+    state = _state(leaves=1)
+    snap_path = str(tmp_path / "root" / "step_1")
+    with knobs.override_cas(True):
+        snap = Snapshot.take(snap_path, state)
+    _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    with knobs.override_metrics(True):
+        with _daemon(str(tmp_path / "cacheA")) as d:
+            # An in-process peer-first restore populates the shared
+            # registry with the fetch histogram the endpoint must expose.
+            with knobs.override_cache_dir(
+                str(tmp_path / "cacheB")
+            ), knobs.override_peer_fetch(True):
+                snap.restore(_zeros_like(state))
+            resp = urllib.request.urlopen(f"http://{d.addr}/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode("utf-8")
+    assert "tpusnap_peerd_requests_total" in body
+    assert "tpusnap_peer_fetch_seconds_bucket" in body
+    # The explicit sub-10ms buckets exist (default duration buckets
+    # would start at 0.01 and blur every LAN fetch into one bin).
+    assert 'le="0.001"' in body
+
+
+def test_inventory_reports_total_past_cap(peer_env, monkeypatch):
+    """A truncated inventory still says how many chunks exist in total."""
+    tmp_path = peer_env
+    # Four distinct snapshots -> four distinct CAS entries in the cache
+    # (one snapshot would pack into a single slab = a single entry).
+    for seed in range(4):
+        state = _state(nbytes_per_leaf=1 << 16, leaves=1, seed=seed)
+        snap_path = str(tmp_path / "root" / f"step_{seed + 1}")
+        with knobs.override_cas(True):
+            snap = Snapshot.take(snap_path, state)
+        _warm_into(snap_path, snap.metadata, str(tmp_path / "cacheA"))
+    monkeypatch.setattr(peerd_mod, "_INVENTORY_CAP", 2)
+    with _daemon(str(tmp_path / "cacheA")) as d:
+        inv = json.loads(
+            urllib.request.urlopen(f"http://{d.addr}/inventory").read()
+        )
+    assert inv["truncated"]
+    assert len(inv["chunks"]) == 2
+    assert inv["chunks_total"] > len(inv["chunks"])
+    assert inv["chunks_total"] == inv["entries"]
+
+
+# ------------------------------------------------------ calibrated costs
+
+
+def test_calibrated_span_and_scoreboard_costs(peer_env):
+    span_cost = ttrace.calibrated_span_cost_s(samples=50)
+    assert span_cost["per_span_s"] >= 0.0
+    assert span_cost["per_span_s"] < 1e-3  # a span is microseconds, not ms
+    assert span_cost["estimated_s"] == pytest.approx(
+        span_cost["per_span_s"] * span_cost["spans"]
+    )
+    board_cost = peer_mod.calibrated_scoreboard_cost_s(samples=50)
+    assert board_cost["per_update_s"] >= 0.0
+    assert board_cost["per_update_s"] < 1e-3
+    # The probe must not leave its synthetic peer in the scoreboard.
+    assert "calibration.invalid:0" not in peer_mod.peer_scoreboard()

@@ -80,6 +80,16 @@ timeout -k 10 300 python -m pytest \
   tests/test_peer.py::test_two_daemon_peer_first_restore_fast -q \
   -p no:cacheprovider || fail=1
 
+# Serving-plane tracing smoke: the end-to-end distributed-trace proof —
+# a 2-daemon peer-first restore under TPUSNAP_TRACE_DIR must yield ONE
+# trace id spanning client peer_fetch spans and both daemons'
+# peerd_handle spans, `trace --fleet` must merge them into a schema-valid
+# timeline, and daemon access logs must validate.  The same file covers
+# fault-injected span status, the peer scoreboard, and analyze --peer.
+step "serving-plane tracing smoke (trace/access-log schema + fleet stitch)"
+timeout -k 10 600 python -m pytest tests/test_peer_trace.py -q \
+  -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

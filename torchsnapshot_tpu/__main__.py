@@ -602,7 +602,11 @@ def cmd_stats(args: argparse.Namespace) -> int:
 
 def cmd_trace(args: argparse.Namespace) -> int:
     """Validate and merge per-rank/per-op trace files from a
-    TPUSNAP_TRACE_DIR into one Perfetto-loadable JSON."""
+    TPUSNAP_TRACE_DIR into one Perfetto-loadable JSON.  ``--fleet``
+    stitches a fleet's worth of files (client ranks + peer daemons) into
+    one distributed timeline: clock skew is corrected per host from the
+    fleet spool's publish stamps, and spans group by the trace id
+    propagated in ``traceparent`` headers."""
     import glob
     import json
     import os as _os
@@ -616,7 +620,13 @@ def cmd_trace(args: argparse.Namespace) -> int:
         print(f"no *{trace.TRACE_FILE_SUFFIX} files under {args.trace_dir}")
         return 2
     try:
-        merged = trace.merge_trace_files(paths)
+        if args.fleet:
+            from . import knobs as _knobs
+
+            spool = args.spool or _knobs.get_fleet_telemetry_dir()
+            merged = trace.merge_fleet_traces(paths, spool=spool)
+        else:
+            merged = trace.merge_trace_files(paths)
     except ValueError as e:
         print(f"invalid trace input: {e}")
         return 1
@@ -632,6 +642,19 @@ def cmd_trace(args: argparse.Namespace) -> int:
         + ", ".join(f"{n}x {k}" for k, n in sorted(ops.items()))
         + f", {n_spans} spans"
     )
+    if args.fleet:
+        trace_ids = merged["otherData"].get("trace_ids", {})
+        for tid, count in trace_ids.items():
+            print(f"  trace {tid}: {count} span(s)")
+        skews = {
+            src.get("skew_s", 0.0)
+            for src in merged["otherData"]["merged_from"]
+        }
+        if any(abs(s) > 0.0005 for s in skews):
+            print(
+                f"  clock skew corrected: up to "
+                f"{max(abs(s) for s in skews) * 1e3:.1f}ms across hosts"
+            )
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(merged, f)
@@ -648,7 +671,10 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     switches to the cross-rank commit-barrier blame report (skew, last
     arriver, and its dominant pre-barrier phase) computed from the
     per-rank barrier stamps the sidecars carry — the positional argument
-    is then the snapshot URL itself."""
+    is then the snapshot URL itself.  ``--peer`` switches to the
+    serving-plane report: per-peer fetch latency (p50/p99), hit / reject
+    / fallback rates, and the TTFB-vs-transfer split from ``peer_fetch``
+    and ``peerd_handle`` spans."""
     import json
 
     from .telemetry import analyze, trace
@@ -671,6 +697,13 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     if not docs:
         print(f"no *{trace.TRACE_FILE_SUFFIX} files under {args.trace_dir}")
         return 2
+    if args.peer:
+        report = analyze.peer_report(docs)
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(analyze.render_peer(report))
+        return 0 if report.get("peers") else 2
     sidecars = None
     if args.snapshot:
         sidecars = analyze.load_sidecars(args.snapshot)
@@ -1245,6 +1278,19 @@ def main(argv=None) -> int:
     p.add_argument(
         "--out", default=None, help="write the merged trace-event JSON here"
     )
+    p.add_argument(
+        "--fleet",
+        action="store_true",
+        help="stitch client + peer-daemon trace files into one "
+        "distributed timeline grouped by propagated trace id "
+        "(clock-skew corrected per host)",
+    )
+    p.add_argument(
+        "--spool",
+        default=None,
+        help="fleet telemetry spool used for clock-skew correction "
+        "(default: $TPUSNAP_FLEET_TELEMETRY; only with --fleet)",
+    )
     p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
@@ -1262,6 +1308,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="cross-rank commit-barrier blame report from the snapshot's "
         "sidecars (the positional argument is the snapshot URL)",
+    )
+    p.add_argument(
+        "--peer",
+        action="store_true",
+        help="serving-plane report from peer_fetch/peerd_handle spans: "
+        "per-peer p50/p99 latency, hit/reject/fallback rates, "
+        "TTFB-vs-transfer split",
     )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_analyze)

@@ -90,6 +90,11 @@ PEER_TIMEOUT_S_ENV_VAR = _ENV_PREFIX + "PEER_TIMEOUT_S"
 PEER_RETRIES_ENV_VAR = _ENV_PREFIX + "PEER_RETRIES"
 PEER_GRACE_S_ENV_VAR = _ENV_PREFIX + "PEER_GRACE_S"
 PEER_BAD_TTL_S_ENV_VAR = _ENV_PREFIX + "PEER_BAD_TTL_S"
+PEER_TRACE_MAX_SPANS_ENV_VAR = _ENV_PREFIX + "PEER_TRACE_MAX_SPANS"
+PEER_TRACE_FLUSH_S_ENV_VAR = _ENV_PREFIX + "PEER_TRACE_FLUSH_S"
+PEER_DEMOTE_FACTOR_ENV_VAR = _ENV_PREFIX + "PEER_DEMOTE_FACTOR"
+PEERD_ACCESS_LOG_ENV_VAR = _ENV_PREFIX + "PEERD_ACCESS_LOG"
+PEERD_ACCESS_LOG_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "PEERD_ACCESS_LOG_MAX_BYTES"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -1139,6 +1144,17 @@ _DEFAULT_PEER_TIMEOUT_S = 5.0
 _DEFAULT_PEER_RETRIES = 1
 _DEFAULT_PEER_BAD_TTL_S = 60.0
 
+# Serving-plane tracing defaults.  A daemon is long-lived, so its tracer
+# keeps a bounded in-memory span buffer (oldest dropped, drop count kept —
+# never a silent cap) and flushes it to the trace dir on a timer; the
+# access log rotates at a byte cap for the same reason.  The demote factor
+# feeds the peer scoreboard back into fetch policy: a peer whose latency
+# EWMA exceeds factor x the fleet median is tried last, not first.
+_DEFAULT_PEER_TRACE_MAX_SPANS = 10000
+_DEFAULT_PEER_TRACE_FLUSH_S = 5.0
+_DEFAULT_PEER_DEMOTE_FACTOR = 3.0
+_DEFAULT_PEERD_ACCESS_LOG_MAX_BYTES = 16 * 1024 * 1024
+
 
 def peer_fetch_enabled() -> bool:
     """Whether restore/warm reads resolve cache misses peer-first
@@ -1198,6 +1214,52 @@ def get_peer_bad_ttl_s() -> float:
     return max(0.0, float(val)) if val is not None else _DEFAULT_PEER_BAD_TTL_S
 
 
+def get_peer_trace_max_spans() -> int:
+    """Cap on the in-memory span buffer a peer daemon's server tracer
+    keeps between flushes.  When full the oldest spans are dropped and the
+    drop count is recorded in the trace file's ``otherData`` (no silent
+    caps)."""
+    return max(
+        1, _get_int_env(PEER_TRACE_MAX_SPANS_ENV_VAR, _DEFAULT_PEER_TRACE_MAX_SPANS)
+    )
+
+
+def get_peer_trace_flush_s() -> float:
+    """Seconds between a peer daemon's server-tracer flushes of buffered
+    ``peerd_handle`` spans to its trace file under ``TPUSNAP_TRACE_DIR``."""
+    val = os.environ.get(PEER_TRACE_FLUSH_S_ENV_VAR)
+    return max(0.1, float(val)) if val is not None else _DEFAULT_PEER_TRACE_FLUSH_S
+
+
+def get_peer_demote_factor() -> float:
+    """Scoreboard demotion threshold: a peer whose latency EWMA exceeds
+    this multiple of the fleet-median EWMA (or whose error EWMA crosses
+    0.5) is moved to the back of the rendezvous order — still reachable,
+    never preferred.  0 disables demotion (quarantine still applies)."""
+    val = os.environ.get(PEER_DEMOTE_FACTOR_ENV_VAR)
+    return max(0.0, float(val)) if val is not None else _DEFAULT_PEER_DEMOTE_FACTOR
+
+
+def get_peerd_access_log() -> Optional[str]:
+    """Path of the peer daemon's structured JSONL access log.  Defaults to
+    ``<TPUSNAP_TRACE_DIR>/peerd-<pid>.access.jsonl`` when a trace dir is
+    configured, else disabled; set explicitly to log without tracing."""
+    val = os.environ.get(PEERD_ACCESS_LOG_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_peerd_access_log_max_bytes() -> int:
+    """Rotation threshold for the peer daemon access log — when the file
+    crosses this size it is renamed to ``<path>.1`` (one generation kept)
+    and a fresh file is started."""
+    return max(
+        4096,
+        _get_int_env(
+            PEERD_ACCESS_LOG_MAX_BYTES_ENV_VAR, _DEFAULT_PEERD_ACCESS_LOG_MAX_BYTES
+        ),
+    )
+
+
 @contextmanager
 def override_peer_fetch(enabled: bool) -> Generator[None, None, None]:
     with _override_env(PEER_FETCH_ENV_VAR, "1" if enabled else None):
@@ -1231,6 +1293,36 @@ def override_peer_grace_s(value: float) -> Generator[None, None, None]:
 @contextmanager
 def override_peer_bad_ttl_s(value: float) -> Generator[None, None, None]:
     with _override_env(PEER_BAD_TTL_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_trace_max_spans(value: int) -> Generator[None, None, None]:
+    with _override_env(PEER_TRACE_MAX_SPANS_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_trace_flush_s(value: float) -> Generator[None, None, None]:
+    with _override_env(PEER_TRACE_FLUSH_S_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peer_demote_factor(value: float) -> Generator[None, None, None]:
+    with _override_env(PEER_DEMOTE_FACTOR_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_peerd_access_log(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(PEERD_ACCESS_LOG_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_peerd_access_log_max_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(PEERD_ACCESS_LOG_MAX_BYTES_ENV_VAR, str(value)):
         yield
 
 

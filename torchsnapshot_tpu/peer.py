@@ -69,6 +69,10 @@ __all__ = [
     "reader_stats",
     "process_stats",
     "reset_process_stats",
+    "peer_scoreboard",
+    "reset_peer_scoreboard",
+    "record_fetch_outcome",
+    "calibrated_scoreboard_cost_s",
 ]
 
 # ------------------------------------------------------------ process stats
@@ -94,12 +98,179 @@ def reset_process_stats() -> None:
     with _TOTALS_LOCK:
         for k in _TOTALS:
             _TOTALS[k] = 0
+    reset_peer_scoreboard()
 
 
 def _add_totals(**deltas: int) -> None:
     with _TOTALS_LOCK:
         for k, v in deltas.items():
             _TOTALS[k] = _TOTALS.get(k, 0) + v
+
+
+# ------------------------------------------------------------- scoreboard
+#
+# Per-peer serving health, fed by the same timings the peer_fetch spans
+# record: latency and error EWMAs, a bounded latency ring for percentiles,
+# byte/outcome counters, and the quarantine stamp.  Published through the
+# fleet spool (telemetry/fleet.py folds it into the PEERS table) and fed
+# BACK into fetch policy: a peer whose latency EWMA exceeds
+# TPUSNAP_PEER_DEMOTE_FACTOR x the fleet median (or whose error EWMA
+# crosses 0.5) is demoted — moved to the back of the rendezvous order, so
+# it stops dominating tail latency without being unreachable.
+
+_SCORE_LOCK = threading.Lock()
+_SCORE_ALPHA = 0.2
+_SCORE_RING = 128
+_SCOREBOARD: Dict[str, Dict[str, Any]] = {}
+_SCORE_UPDATES = 0
+
+_OUTCOME_COUNTER = {
+    "hit": "hits",
+    "miss": "misses",
+    "error": "errors",
+    "reject": "rejects",
+}
+
+
+def _score_entry_locked(addr: str) -> Dict[str, Any]:
+    entry = _SCOREBOARD.get(addr)
+    if entry is None:
+        entry = {
+            "ewma_latency_s": 0.0,
+            "ewma_error": 0.0,
+            "latencies": [],
+            "hits": 0,
+            "misses": 0,
+            "errors": 0,
+            "rejects": 0,
+            "bytes": 0,
+            "quarantined_until": 0.0,
+            "demoted": False,
+        }
+        _SCOREBOARD[addr] = entry
+    return entry
+
+
+def record_fetch_outcome(
+    addr: str, wall_s: float, status: str, nbytes: int = 0
+) -> bool:
+    """Fold one fetch's outcome into the peer's scoreboard row.  Returns
+    True when this update newly demoted the peer (the caller owns the
+    event/metric emission — never under the lock)."""
+    global _SCORE_UPDATES
+    from . import knobs
+
+    factor = knobs.get_peer_demote_factor()
+    with _SCORE_LOCK:
+        _SCORE_UPDATES += 1
+        entry = _score_entry_locked(addr)
+        total = (
+            entry["hits"] + entry["misses"] + entry["errors"] + entry["rejects"]
+        )
+        if total == 0:
+            entry["ewma_latency_s"] = wall_s
+        else:
+            entry["ewma_latency_s"] = (
+                (1.0 - _SCORE_ALPHA) * entry["ewma_latency_s"]
+                + _SCORE_ALPHA * wall_s
+            )
+        err = 0.0 if status in ("hit", "miss") else 1.0
+        entry["ewma_error"] = (
+            (1.0 - _SCORE_ALPHA) * entry["ewma_error"] + _SCORE_ALPHA * err
+        )
+        entry["latencies"].append(wall_s)
+        if len(entry["latencies"]) > _SCORE_RING:
+            del entry["latencies"][: len(entry["latencies"]) - _SCORE_RING]
+        entry[_OUTCOME_COUNTER.get(status, "errors")] += 1
+        entry["bytes"] += nbytes
+        was_demoted = entry["demoted"]
+        # Demotion is relative health: compare against the fleet median of
+        # latency EWMAs so one uniformly slow network never demotes anyone.
+        ewmas = sorted(
+            e["ewma_latency_s"]
+            for e in _SCOREBOARD.values()
+            if e["hits"] + e["misses"] + e["errors"] + e["rejects"] > 0
+        )
+        median = ewmas[len(ewmas) // 2] if ewmas else 0.0
+        slow = (
+            factor > 0.0
+            and len(ewmas) >= 2
+            and median > 0.0
+            and entry["ewma_latency_s"] > factor * median
+        )
+        flaky = entry["ewma_error"] > 0.5
+        entry["demoted"] = slow or flaky
+        return entry["demoted"] and not was_demoted
+
+
+def record_quarantine(addr: str, ttl_s: float) -> None:
+    with _SCORE_LOCK:
+        entry = _score_entry_locked(addr)
+        entry["quarantined_until"] = max(
+            entry["quarantined_until"], time.time() + ttl_s
+        )
+
+
+def _demoted_addrs() -> set:
+    with _SCORE_LOCK:
+        return {a for a, e in _SCOREBOARD.items() if e["demoted"]}
+
+
+def _percentile_locked(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))]
+
+
+def peer_scoreboard() -> Dict[str, Dict[str, Any]]:
+    """Snapshot for publication: per-peer EWMAs, ring percentiles, and
+    counters (the raw latency ring stays private — bounded spool docs)."""
+    with _SCORE_LOCK:
+        out: Dict[str, Dict[str, Any]] = {}
+        for addr, entry in _SCOREBOARD.items():
+            lats = sorted(entry["latencies"])
+            out[addr] = {
+                "ewma_latency_s": entry["ewma_latency_s"],
+                "ewma_error": entry["ewma_error"],
+                "p50_s": _percentile_locked(lats, 0.50),
+                "p99_s": _percentile_locked(lats, 0.99),
+                "hits": entry["hits"],
+                "misses": entry["misses"],
+                "errors": entry["errors"],
+                "rejects": entry["rejects"],
+                "bytes": entry["bytes"],
+                "quarantined_until": entry["quarantined_until"],
+                "demoted": entry["demoted"],
+            }
+        return out
+
+
+def reset_peer_scoreboard() -> None:
+    global _SCORE_UPDATES
+    with _SCORE_LOCK:
+        _SCOREBOARD.clear()
+        _SCORE_UPDATES = 0
+
+
+def calibrated_scoreboard_cost_s(samples: int = 200) -> Dict[str, Any]:
+    """Isolated per-update scoreboard cost x updates this process — the
+    scoreboard half of the serve bench's overhead proof (same shape as
+    trace.calibrated_span_cost_s / fleet.calibrated_overhead_s)."""
+    global _SCORE_UPDATES
+    updates = _SCORE_UPDATES
+    probe_addr = "calibration.invalid:0"
+    t0 = time.perf_counter()
+    for _ in range(max(1, samples)):
+        record_fetch_outcome(probe_addr, 0.001, "hit", 1)
+    per_update = (time.perf_counter() - t0) / max(1, samples)
+    with _SCORE_LOCK:
+        _SCOREBOARD.pop(probe_addr, None)
+        _SCORE_UPDATES = max(0, _SCORE_UPDATES - max(1, samples))
+    return {
+        "per_update_s": per_update,
+        "updates": updates,
+        "estimated_s": per_update * updates,
+    }
 
 
 # ------------------------------------------------------------ the registry
@@ -293,23 +464,36 @@ class PeerClient:
         now = time.monotonic()
         with self._lock:
             healthy = [p for p in peers if self._bad.get(p.addr, 0.0) <= now]
-        return rendezvous_order(chunk_key, healthy)
+        ranked = rendezvous_order(chunk_key, healthy)
+        # Scoreboard feedback: demoted peers stay reachable (they may be
+        # the only holder) but are tried last, so a persistently slow peer
+        # stops setting the fleet's tail latency.
+        demoted = _demoted_addrs()
+        if demoted:
+            ranked = [p for p in ranked if p.addr not in demoted] + [
+                p for p in ranked if p.addr in demoted
+            ]
+        return ranked
 
     def mark_bad(self, addr: str) -> None:
         with self._lock:
             self._bad[addr] = time.monotonic() + self._bad_ttl_s
+        record_quarantine(addr, self._bad_ttl_s)
 
     def _record_reject(self, addr: str, reason: str) -> None:
         from .event import Event
         from .event_handlers import log_event
         from .telemetry import metrics as tmetrics
+        from .telemetry import trace as ttrace
 
         with self._lock:
             self.rejects += 1
         tmetrics.record_peer_reject(reason)
-        log_event(
-            Event(name="peer.reject", metadata={"peer": addr, "reason": reason})
-        )
+        metadata: Dict[str, Any] = {"peer": addr, "reason": reason}
+        trace_id = ttrace.current_trace_id()
+        if trace_id is not None:
+            metadata["trace"] = trace_id
+        log_event(Event(name="peer.reject", metadata=metadata))
         logger.warning("rejecting peer %s: %s", addr, reason)
 
     # ------------------------------------------------------------ fetch
@@ -329,40 +513,78 @@ class PeerClient:
         from urllib import error as urlerror
 
         from . import integrity, retry
+        from .event import Event
+        from .event_handlers import log_event
+        from .telemetry import metrics as tmetrics
+        from .telemetry import trace as ttrace
 
         path = f"/chunk/{algo}/{hexdigest}"
-        attempt = 0
-        while True:
-            try:
-                data = self._http_get(addr, path)
-            except urlerror.HTTPError as e:
-                if e.code == 404:
-                    return None  # not resident there: a miss, not a fault
-                if (
-                    e.code in retry.TRANSIENT_HTTP_STATUS
-                    and attempt < self._retries
-                ):
-                    attempt += 1
-                    retry.sleep_backoff(attempt, base_s=0.1)
-                    continue
-                self.mark_bad(addr)
-                return None
-            except Exception as e:  # noqa: BLE001
-                if self._transportish(e) and attempt < self._retries:
-                    attempt += 1
-                    retry.sleep_backoff(attempt, base_s=0.1)
-                    continue
-                self.mark_bad(addr)
-                return None
-            expect = f"{algo}:{hexdigest}"
-            if integrity.digest_as(data, expect) != expect:
-                # Unverifiable bytes are never trusted — a digest mismatch
-                # AND a missing hash backend both land here (fail closed;
-                # origin still serves the read).
-                self._record_reject(addr, "digest_mismatch")
-                self.mark_bad(addr)
-                return None
-            return data
+        begin = time.monotonic()
+        status = "error"
+        ttfb_s = 0.0
+        result: Optional[bytes] = None
+        with ttrace.span(
+            "peer_fetch", cat="phase", peer=addr, digest=f"{algo}:{hexdigest}"
+        ) as sp:
+            attempt = 0
+            while True:
+                try:
+                    data, ttfb_s = self._http_get(addr, path)
+                except urlerror.HTTPError as e:
+                    if e.code == 404:
+                        status = "miss"  # not resident there, not a fault
+                        break
+                    if (
+                        e.code in retry.TRANSIENT_HTTP_STATUS
+                        and attempt < self._retries
+                    ):
+                        attempt += 1
+                        retry.sleep_backoff(attempt, base_s=0.1)
+                        continue
+                    self.mark_bad(addr)
+                    status = "error"
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if self._transportish(e) and attempt < self._retries:
+                        attempt += 1
+                        retry.sleep_backoff(attempt, base_s=0.1)
+                        continue
+                    self.mark_bad(addr)
+                    status = "error"
+                    break
+                expect = f"{algo}:{hexdigest}"
+                if integrity.digest_as(data, expect) != expect:
+                    # Unverifiable bytes are never trusted — a digest
+                    # mismatch AND a missing hash backend both land here
+                    # (fail closed; origin still serves the read).
+                    self._record_reject(addr, "digest_mismatch")
+                    self.mark_bad(addr)
+                    status = "reject"
+                    break
+                status = "hit"
+                result = data
+                break
+            wall_s = time.monotonic() - begin
+            sp.set(
+                status=status,
+                attempts=attempt + 1,
+                ttfb_s=ttfb_s,
+                transfer_s=max(0.0, wall_s - ttfb_s),
+                bytes=len(result) if result is not None else 0,
+            )
+        tmetrics.record_peer_fetch_seconds(wall_s)
+        newly_demoted = record_fetch_outcome(
+            addr, wall_s, status, len(result) if result is not None else 0
+        )
+        if newly_demoted:
+            tmetrics.record_peer_demoted()
+            metadata: Dict[str, Any] = {"peer": addr, "status": status}
+            trace_id = ttrace.current_trace_id()
+            if trace_id is not None:
+                metadata["trace"] = trace_id
+            log_event(Event(name="peer.demoted", metadata=metadata))
+            logger.warning("demoting slow/flaky peer %s", addr)
+        return result
 
     @staticmethod
     def _transportish(exc: BaseException) -> bool:
@@ -381,10 +603,15 @@ class PeerClient:
 
     def _http_get(
         self, addr: str, path: str, byte_range: Optional[Tuple[int, int]] = None
-    ) -> bytes:
+    ) -> Tuple[bytes, float]:
+        """One HTTP GET against a peer.  Returns ``(body, ttfb_s)`` — the
+        time-to-first-byte (connect + request + response headers) split
+        from the body transfer, so the peer_fetch span can tell a slow
+        network from a slow disk."""
         from urllib import request as urlrequest
 
         from . import phase_stats, retry
+        from .telemetry import trace as ttrace
 
         rule = self._injector.fire(path) if self._injector is not None else None
         if rule is not None:
@@ -394,9 +621,17 @@ class PeerClient:
                 time.sleep(rule.param if rule.param is not None else 0.25)
         begin = time.monotonic()
         req = urlrequest.Request(f"http://{addr}{path}")
+        traceparent = ttrace.current_traceparent()
+        if traceparent is not None:
+            req.add_header("traceparent", traceparent)
+        if path.startswith("/chunk/"):
+            req.add_header(
+                "tpusnap-chunk", path[len("/chunk/"):].replace("/", ":", 1)
+            )
         if byte_range is not None:
             req.add_header("Range", f"bytes={byte_range[0]}-{byte_range[1] - 1}")
         with urlrequest.urlopen(req, timeout=self._timeout_s) as resp:
+            ttfb_s = time.monotonic() - begin  # headers in hand, body pending
             body = resp.read()
             clen = resp.headers.get("Content-Length")
         if rule is not None and rule.kind == "peer_truncated":
@@ -409,7 +644,7 @@ class PeerClient:
                 f"{len(body)} != {clen}"
             )
         phase_stats.add("peer_read", time.monotonic() - begin, len(body))
-        return body
+        return body, ttfb_s
 
 
 # ------------------------------------------------------------- the plugin

@@ -167,13 +167,38 @@ class PeerDaemon:
         self._rollout_lock = threading.Lock()
         self.started_at = time.time()
         self.addr: Optional[str] = None
+        import uuid as _uuid
+
+        self.ident = _uuid.uuid4().hex
+        self.tracer = None
+        self.access_log = None
+        self._serve_mon = None
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> str:
         """Bind, register, serve in a background thread; returns the
         advertised ``host:port``."""
+        from . import knobs
+        from .telemetry import monitor as tmonitor
+        from .telemetry import trace as ttrace
+
         daemon = self
+        # Server-side tracing + structured access log: gated on the same
+        # TPUSNAP_TRACE_DIR the rest of the pipeline uses, so a fleet that
+        # traces restores automatically gets daemon-side spans to stitch.
+        trace_dir = knobs.get_trace_dir()
+        if trace_dir:
+            self.tracer = ttrace.ServerTracer(trace_dir, self.ident)
+        log_path = knobs.get_peerd_access_log()
+        if log_path is None and trace_dir:
+            log_path = os.path.join(
+                trace_dir, f"peerd-{os.getpid()}{ttrace.ACCESS_LOG_SUFFIX}"
+            )
+        if log_path:
+            self.access_log = ttrace.AccessLog(
+                log_path, max_bytes=knobs.get_peerd_access_log_max_bytes()
+            )
         handler = type(
             "_BoundHandler", (_ChunkRequestHandler,), {"daemon": daemon}
         )
@@ -199,6 +224,14 @@ class PeerDaemon:
                     "coordination store configured",
                     self.addr,
                 )
+        # A long-lived monitored `serve` op: its tick thread refreshes the
+        # fleet-spool entry every telemetry interval, so `tpusnap top`
+        # lists the daemon as alive for its whole lifetime instead of
+        # triaging it suspected-dead once it outlives the stale window.
+        # The terminal fold happens only on clean close().
+        self._serve_mon = tmonitor.op_started(
+            "serve", self.ident, 0, watchdog=False
+        )
         logger.info("peerd serving %s on %s", self.cache_dir, self.addr)
         return self.addr
 
@@ -212,6 +245,8 @@ class PeerDaemon:
     def close(self) -> None:
         """Deregister (tombstone — peers drop this host immediately) and
         stop serving."""
+        from .telemetry import monitor as tmonitor
+
         if self._registration is not None:
             self._registration.close()
             self._registration = None
@@ -222,6 +257,11 @@ class PeerDaemon:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self._serve_mon is not None:
+            tmonitor.op_finished(self._serve_mon, success=True)
+            self._serve_mon = None
+        if self.tracer is not None:
+            self.tracer.close()  # final flush; AccessLog appends per line
 
     # ----------------------------------------------------------- endpoints
 
@@ -247,14 +287,19 @@ class PeerDaemon:
     def inventory(self) -> Dict[str, Any]:
         """What this host can serve: cache totals plus a bounded chunk
         listing (key + size) — enough for an operator to answer "does the
-        fleet hold step N" without a full spool scan."""
+        fleet hold step N" without a full spool scan.  A truncated listing
+        still reports ``chunks_total`` (counting is cheap — only the
+        listed entries pay a meta-file read), so the response says how
+        much it elided, not just that it did."""
         totals = self.store.stats()
         chunks: List[Dict[str, Any]] = []
+        chunks_total = 0
         truncated = False
         for _, nbytes, _, meta_path in self.store._walk_entries():
+            chunks_total += 1
             if len(chunks) >= _INVENTORY_CAP:
                 truncated = True
-                break
+                continue
             try:
                 with open(meta_path, "r", encoding="utf-8") as f:
                     meta = json.load(f)
@@ -266,8 +311,65 @@ class PeerDaemon:
             "bytes": totals["bytes"],
             "max_bytes": totals["max_bytes"],
             "chunks": chunks,
+            "chunks_total": chunks_total,
             "truncated": truncated,
         }
+
+    # -------------------------------------------------------- observability
+
+    def observe_request(
+        self,
+        *,
+        path: str,
+        begin_us: float,
+        wall_s: float,
+        status: int,
+        nbytes: int,
+        kind: str,
+        traceparent: Optional[str],
+        chunk_header: Optional[str],
+        byte_range: Optional[str],
+        client: str,
+    ) -> None:
+        """Record one served request: a ``peerd_handle`` span in the
+        daemon's own trace file (child of the client's span when the
+        request carried a ``traceparent``) plus one access-log line.
+        Never raises — observability must not break serving."""
+        from .telemetry import trace as ttrace
+
+        digest = chunk_header
+        if digest is None and path.startswith("/chunk/"):
+            digest = path[len("/chunk/") :].replace("/", ":", 1)
+        parsed = (
+            ttrace.parse_traceparent(traceparent) if traceparent else None
+        )
+        if self.tracer is not None:
+            args: Dict[str, Any] = {
+                "path": path,
+                "kind": kind,
+                "status": status,
+                "bytes": nbytes,
+                "client": client,
+            }
+            if digest:
+                args["digest"] = digest
+            if parsed is not None:
+                args["trace"] = parsed[0]
+                args["parent"] = f"{parsed[1]:016x}"
+            self.tracer.record_span(
+                "peerd_handle", begin_us, wall_s * 1e6, args
+            )
+        if self.access_log is not None:
+            self.access_log.log(
+                ts=round(time.time(), 6),
+                trace=parsed[0] if parsed is not None else None,
+                digest=digest,
+                range=byte_range,
+                status=status,
+                bytes=nbytes,
+                wall_s=round(wall_s, 6),
+                client=client,
+            )
 
     def rollout(self, step: Optional[int], concurrency: int = 8) -> Dict[str, Any]:
         """Warm ``step``'s delta into the local cache and report the
@@ -341,10 +443,46 @@ class _ChunkRequestHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     daemon: PeerDaemon  # bound via subclassing in PeerDaemon.start
 
-    # Route table kept flat and explicit — this is a 4-endpoint server,
+    # Route table kept flat and explicit — this is a 5-endpoint server,
     # not a framework.
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
+        self._observed(self._route_get)
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        self._observed(self._route_post)
+
+    def _observed(self, route) -> None:
+        """Run one route with request observability around it: stamps the
+        wall interval, lets ``_begin`` capture the response outcome, and
+        hands the request to the daemon's tracer + access log."""
+        from .telemetry import trace as ttrace
+
+        self._resp_status = 0
+        self._resp_bytes = 0
+        self._resp_kind = "other"
+        begin_us = ttrace._now_us()
+        t0 = time.monotonic()
+        try:
+            route()
+        finally:
+            try:
+                self.daemon.observe_request(
+                    path=self.path.split("?", 1)[0],
+                    begin_us=begin_us,
+                    wall_s=time.monotonic() - t0,
+                    status=self._resp_status,
+                    nbytes=self._resp_bytes,
+                    kind=self._resp_kind,
+                    traceparent=self.headers.get("traceparent"),
+                    chunk_header=self.headers.get("tpusnap-chunk"),
+                    byte_range=self.headers.get("Range"),
+                    client=self.client_address[0],
+                )
+            except Exception:  # noqa: BLE001 - never let tracing kill serving
+                logger.debug("peerd observe_request failed", exc_info=True)
+
+    def _route_get(self) -> None:
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
             self._send_json(200, self.daemon.healthz(), kind="healthz")
@@ -352,12 +490,34 @@ class _ChunkRequestHandler(BaseHTTPRequestHandler):
         if path == "/inventory":
             self._send_json(200, self.daemon.inventory(), kind="inventory")
             return
+        if path == "/metrics":
+            self._serve_metrics()
+            return
         if path.startswith("/chunk/"):
             self._serve_chunk(path)
             return
         self._send_json(404, {"error": f"no such endpoint: {path}"}, kind="other")
 
-    def do_POST(self) -> None:  # noqa: N802 - http.server API
+    def _serve_metrics(self) -> None:
+        """The process's Prometheus registry in text exposition format —
+        what the daemon has actually counted (requests served, peer fetch
+        latency histograms from its own rollout warms, …)."""
+        from .telemetry import metrics as tmetrics
+
+        body = tmetrics.render_prometheus().encode("utf-8")
+        self._begin(
+            200,
+            "text/plain; version=0.0.4; charset=utf-8",
+            len(body),
+            kind="metrics",
+        )
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _route_post(self) -> None:
         from urllib.parse import parse_qs, urlparse
 
         parsed = urlparse(self.path)
@@ -464,6 +624,11 @@ class _ChunkRequestHandler(BaseHTTPRequestHandler):
     def _begin(self, status: int, ctype: str, nbytes: int, kind: str) -> None:
         from .telemetry import metrics as tmetrics
 
+        # Stash the outcome for _observed's span + access-log line (the
+        # last _begin wins — e.g. a 416 after a parsed-but-bad Range).
+        self._resp_status = status
+        self._resp_bytes = nbytes
+        self._resp_kind = kind
         tmetrics.record_peerd_request(kind, status, nbytes)
         self.send_response(status)
         self.send_header("Content-Type", ctype)
@@ -503,15 +668,25 @@ def rollout_fleet(
     whose bytes hash to their names does the rest of the fleet go.  Fleet
     hosts warm peer-first (TPUSNAP_PEER_FETCH in the daemon's
     environment), so the delta leaves origin ~once and fans out
-    peer-to-peer.  Watch it live via ``tpusnap top`` on the fleet spool.
+    peer-to-peer.
+
+    Watch it live via ``tpusnap top``: the rollout runs as a monitored
+    ``rollout`` op whose fleet-spool entry carries a ``rollout`` doc
+    (current wave, hosts completed, delta bytes moved peer-vs-origin,
+    ETA), refreshed after every host completion — ``top`` renders it as
+    an in-flight banner and ``--json`` carries the doc verbatim.
     """
-    from concurrent.futures import ThreadPoolExecutor
+    import uuid as _uuid
+    from concurrent.futures import ThreadPoolExecutor, as_completed
     from urllib import request as urlrequest
 
     from . import cas, integrity
     from . import peer as peer_mod
     from .event import Event
     from .event_handlers import log_event
+    from .telemetry import fleet as tfleet
+    from .telemetry import metrics as tmetrics
+    from .telemetry import monitor as tmonitor
 
     kv = peer_mod.resolve_kv_store()
     if kv is None:
@@ -573,70 +748,159 @@ def rollout_fleet(
             checked += 1
         return {"peer": p.addr, "ok": True, "chunks_verified": checked}
 
+    # The rollout runs as a monitored op: its tick thread refreshes the
+    # fleet-spool entry, and `progress` (attached as fleet_extra) rides
+    # every published entry so `top` can render the in-flight banner.
+    mon = tmonitor.op_started("rollout", _uuid.uuid4().hex, 0, watchdog=False)
+    progress: Dict[str, Any] = {
+        "root": root,
+        "step": step,
+        "wave": "canary",
+        "completed": 0,
+        "total": len(canaries),
+        "peer_bytes": 0,
+        "origin_bytes": 0,
+        "eta_s": None,
+    }
+    mon.fleet_extra = {"rollout": progress}
+
+    def _publish() -> None:
+        try:
+            tfleet.publish(mon)
+        except Exception:  # noqa: BLE001 - progress publishing is best effort
+            pass
+
+    def _enter_wave(wave: str, total: int) -> None:
+        progress["wave"] = wave
+        progress["completed"] = 0
+        progress["total"] = total
+        progress["eta_s"] = None
+        tmetrics.record_rollout_wave(wave)
+        log_event(
+            Event(
+                name="rollout.wave",
+                metadata={
+                    "root": root,
+                    "step": progress["step"],
+                    "wave": wave,
+                    "hosts": total,
+                },
+            )
+        )
+        _publish()
+
+    def _run_wave(pool, fn, targets):
+        """Order-preserving fan-out that publishes progress (hosts done,
+        delta bytes peer-vs-origin, ETA from observed per-host pace) after
+        EVERY host completion, not just at wave boundaries."""
+        begin = time.monotonic()
+        out: Dict[int, Dict[str, Any]] = {}
+        futures = {pool.submit(fn, p): i for i, p in enumerate(targets)}
+        for fut in as_completed(futures):
+            r = fut.result()
+            out[futures[fut]] = r
+            progress["completed"] += 1
+            peer_split = (r.get("warm") or {}).get("peer") or {}
+            progress["peer_bytes"] += int(peer_split.get("hit_bytes", 0) or 0)
+            progress["origin_bytes"] += int(
+                peer_split.get("miss_bytes", 0) or 0
+            )
+            remaining = len(targets) - progress["completed"]
+            progress["eta_s"] = (
+                round(
+                    (time.monotonic() - begin)
+                    / progress["completed"]
+                    * remaining,
+                    1,
+                )
+                if remaining
+                else 0.0
+            )
+            _publish()
+        return [out[i] for i in range(len(targets))]
+
     result: Dict[str, Any] = {
         "root": root,
         "step": step,
         "canaries": [p.addr for p in canaries],
         "fleet": [p.addr for p in fleet],
     }
-    with ThreadPoolExecutor(
-        max_workers=max(1, len(peers)), thread_name_prefix="tpusnap_rollout"
-    ) as pool:
-        canary_out = list(pool.map(_roll_one, canaries))
-        result["canary_results"] = canary_out
-        failed = [r for r in canary_out if not r.get("ok")]
-        if failed:
-            result["ok"] = False
-            result["aborted"] = "canary warm failed"
-            log_event(
-                Event(
-                    name="rollout.end",
-                    metadata={"root": root, "step": step, "success": False},
+    ok = False
+    try:
+        with ThreadPoolExecutor(
+            max_workers=max(1, len(peers)),
+            thread_name_prefix="tpusnap_rollout",
+        ) as pool:
+            _enter_wave("canary", len(canaries))
+            canary_out = _run_wave(pool, _roll_one, canaries)
+            result["canary_results"] = canary_out
+            failed = [r for r in canary_out if not r.get("ok")]
+            if failed:
+                result["ok"] = False
+                result["aborted"] = "canary warm failed"
+                log_event(
+                    Event(
+                        name="rollout.end",
+                        metadata={
+                            "root": root, "step": step, "success": False,
+                        },
+                    )
                 )
+                return result
+            # Digest spot-check against each canary, on a sample of the
+            # delta the canary itself reported warming.
+            resolved_step, _, metadata, prev_md = resolve_rollout_target(
+                root, step
             )
-            return result
-        # Digest spot-check against each canary, on a sample of the delta
-        # the canary itself reported warming.
-        resolved_step, _, metadata, prev_md = resolve_rollout_target(root, step)
-        result["step"] = resolved_step
-        sample: List[Tuple[str, str]] = []
-        for loc, _ in delta_locations(metadata, prev_md):
-            if cas.is_cas_location(loc):
-                sample.append(cas.parse_cas_location(loc))
-            elif cas.is_casx_location(loc):
-                sample.extend(
-                    (algo, hexd)
-                    for algo, hexd, _ in cas.parse_casx_location(loc)
-                )
-            if len(sample) >= verify_chunks:
-                break
-        sample = sample[:verify_chunks]
-        verify_out = list(
-            pool.map(lambda p: _verify_one(p, sample), canaries)
-        )
-        result["canary_verify"] = verify_out
-        failed = [r for r in verify_out if not r.get("ok")]
-        if failed:
-            result["ok"] = False
-            result["aborted"] = "canary digest verification failed"
-            log_event(
-                Event(
-                    name="rollout.end",
-                    metadata={"root": root, "step": step, "success": False},
-                )
+            result["step"] = resolved_step
+            progress["step"] = resolved_step
+            sample: List[Tuple[str, str]] = []
+            for loc, _ in delta_locations(metadata, prev_md):
+                if cas.is_cas_location(loc):
+                    sample.append(cas.parse_cas_location(loc))
+                elif cas.is_casx_location(loc):
+                    sample.extend(
+                        (algo, hexd)
+                        for algo, hexd, _ in cas.parse_casx_location(loc)
+                    )
+                if len(sample) >= verify_chunks:
+                    break
+            sample = sample[:verify_chunks]
+            _enter_wave("verify", len(canaries))
+            verify_out = _run_wave(
+                pool, lambda p: _verify_one(p, sample), canaries
             )
-            return result
-        fleet_out = list(pool.map(_roll_one, fleet))
-        result["fleet_results"] = fleet_out
-        result["ok"] = all(r.get("ok") for r in fleet_out)
-    log_event(
-        Event(
-            name="rollout.end",
-            metadata={
-                "root": root,
-                "step": resolved_step,
-                "success": result["ok"],
-            },
+            result["canary_verify"] = verify_out
+            failed = [r for r in verify_out if not r.get("ok")]
+            if failed:
+                result["ok"] = False
+                result["aborted"] = "canary digest verification failed"
+                log_event(
+                    Event(
+                        name="rollout.end",
+                        metadata={
+                            "root": root, "step": step, "success": False,
+                        },
+                    )
+                )
+                return result
+            _enter_wave("fleet", len(fleet))
+            fleet_out = _run_wave(pool, _roll_one, fleet)
+            result["fleet_results"] = fleet_out
+            result["ok"] = all(r.get("ok") for r in fleet_out)
+        ok = bool(result["ok"])
+        log_event(
+            Event(
+                name="rollout.end",
+                metadata={
+                    "root": root,
+                    "step": resolved_step,
+                    "success": result["ok"],
+                },
+            )
         )
-    )
-    return result
+        return result
+    finally:
+        # Terminal fold: the spool entry flips to done (success mirrors
+        # the rollout outcome — aborts and exceptions fold as failed).
+        tmonitor.op_finished(mon, success=ok)

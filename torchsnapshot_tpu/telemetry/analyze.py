@@ -84,6 +84,13 @@ PHASE_GROUPS: Dict[str, frozenset] = {
         {"native_write_hash", "native_read", "cache_read", "cache_populate",
          "peer_read"}
     ),
+    # Serving-plane spans: peer_fetch is the client side of a peer chunk
+    # fetch (peer.py, includes rendezvous retries + digest verify),
+    # peerd_handle is the daemon side of one HTTP request (peerd.py,
+    # recorded with a remote parent span from the traceparent header).
+    # A distinct group so the peer report can aggregate them without
+    # muddying the storage_io attribution of the restore pipeline.
+    "peer": frozenset({"peer_fetch", "peerd_handle"}),
 }
 _STORAGE_SUFFIXES = ("_write", "_read")
 # Groups that are time spent WAITING on a resource rather than doing
@@ -480,6 +487,159 @@ def render_barrier(reports: List[Dict[str, Any]]) -> str:
             )
         lines.append("")
     return "\n".join(lines).rstrip()
+
+
+# -------------------------------------------------------------- peer report
+
+
+def peer_report(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Serving-plane report from ``peer_fetch`` / ``peerd_handle`` spans.
+
+    Client side (``peer_fetch``, recorded by peer.py): per-peer p50/p99
+    fetch latency, hit / reject / fallback rates, and the TTFB-vs-transfer
+    split — was the slow peer slow to *answer* or slow to *stream*.
+    Server side (``peerd_handle``, recorded by each daemon's
+    ServerTracer): per-daemon request counts and latency, keyed by the
+    daemon trace file's host.  ``slowest_peer`` names the peer with the
+    worst p99 fetch latency."""
+    peers: Dict[str, Dict[str, Any]] = {}
+    daemons: Dict[str, Dict[str, Any]] = {}
+    for doc in docs:
+        other = doc.get("otherData", {})
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            args = ev.get("args") or {}
+            dur_s = float(ev.get("dur", 0.0)) / 1e6
+            if ev.get("name") == "peer_fetch":
+                addr = str(args.get("peer", "?"))
+                row = peers.setdefault(
+                    addr,
+                    {
+                        "latencies": [],
+                        "ttfb_s": 0.0,
+                        "transfer_s": 0.0,
+                        "bytes": 0,
+                        "statuses": {},
+                    },
+                )
+                row["latencies"].append(dur_s)
+                row["ttfb_s"] += float(args.get("ttfb_s", 0.0) or 0.0)
+                row["transfer_s"] += float(
+                    args.get("transfer_s", 0.0) or 0.0
+                )
+                nbytes = args.get("bytes")
+                if isinstance(nbytes, (int, float)):
+                    row["bytes"] += int(nbytes)
+                status = str(args.get("status", "?"))
+                row["statuses"][status] = row["statuses"].get(status, 0) + 1
+            elif ev.get("name") == "peerd_handle":
+                ident = str(
+                    other.get("host", "?")
+                ) + "/" + str(other.get("op", "?"))[:8]
+                row = daemons.setdefault(
+                    ident, {"latencies": [], "bytes": 0, "requests": 0}
+                )
+                row["requests"] += 1
+                row["latencies"].append(dur_s)
+                nbytes = args.get("bytes")
+                if isinstance(nbytes, (int, float)):
+                    row["bytes"] += int(nbytes)
+
+    peer_rows: Dict[str, Any] = {}
+    for addr, row in peers.items():
+        lat = sorted(row["latencies"])
+        n = len(lat)
+        statuses = row["statuses"]
+        hits = statuses.get("hit", 0)
+        rejects = statuses.get("reject", 0)
+        # Fallback-to-origin: the fetch ended without peer bytes (clean
+        # miss or transport error) — rejects also fall back but are
+        # counted separately because they indicate a corrupt peer.
+        fallbacks = statuses.get("miss", 0) + statuses.get("error", 0)
+        peer_rows[addr] = {
+            "fetches": n,
+            "p50_s": round(_percentile(lat, 0.5), 6),
+            "p99_s": round(_percentile(lat, 0.99), 6),
+            "max_s": round(lat[-1], 6) if lat else 0.0,
+            "hit_rate": round(hits / n, 4) if n else 0.0,
+            "reject_rate": round(rejects / n, 4) if n else 0.0,
+            "fallback_rate": round(fallbacks / n, 4) if n else 0.0,
+            "ttfb_mean_s": round(row["ttfb_s"] / n, 6) if n else 0.0,
+            "transfer_mean_s": (
+                round(row["transfer_s"] / n, 6) if n else 0.0
+            ),
+            "bytes": row["bytes"],
+            "statuses": dict(sorted(statuses.items())),
+        }
+    daemon_rows = {
+        ident: {
+            "requests": row["requests"],
+            "p50_s": round(
+                _percentile(sorted(row["latencies"]), 0.5), 6
+            ),
+            "p99_s": round(
+                _percentile(sorted(row["latencies"]), 0.99), 6
+            ),
+            "bytes": row["bytes"],
+        }
+        for ident, row in daemons.items()
+    }
+    slowest = (
+        max(peer_rows, key=lambda a: peer_rows[a]["p99_s"])
+        if peer_rows
+        else None
+    )
+    return {
+        "peers": dict(sorted(peer_rows.items())),
+        "daemons": dict(sorted(daemon_rows.items())),
+        "slowest_peer": slowest,
+    }
+
+
+def render_peer(report: Dict[str, Any]) -> str:
+    """Human-readable per-peer serving report."""
+    peers = report.get("peers", {})
+    if not peers:
+        return (
+            "no peer_fetch spans in trace input (serving plane idle, or "
+            "traces predate serving-plane tracing)"
+        )
+    lines: List[str] = [
+        f"  {'peer':<22} {'fetch':>6} {'hit%':>5} {'rej%':>5} "
+        f"{'fall%':>6} {'p50':>9} {'p99':>9} {'ttfb':>8} {'xfer':>8} "
+        f"{'bytes':>10}"
+    ]
+    for addr, row in peers.items():
+        lines.append(
+            f"  {addr:<22} {row['fetches']:>6} "
+            f"{row['hit_rate'] * 100:>4.0f}% {row['reject_rate'] * 100:>4.0f}% "
+            f"{row['fallback_rate'] * 100:>5.0f}% "
+            f"{row['p50_s'] * 1e3:>7.1f}ms {row['p99_s'] * 1e3:>7.1f}ms "
+            f"{row['ttfb_mean_s'] * 1e3:>6.1f}ms "
+            f"{row['transfer_mean_s'] * 1e3:>6.1f}ms "
+            f"{_fmt_bytes(row['bytes']):>10}"
+        )
+    if report.get("slowest_peer"):
+        slow = report["slowest_peer"]
+        lines.append(
+            f"  slowest peer: {slow} "
+            f"(p99 {peers[slow]['p99_s'] * 1e3:.1f}ms)"
+        )
+    daemons = report.get("daemons", {})
+    if daemons:
+        lines.append(
+            f"  {'daemon':<31} {'reqs':>6} {'p50':>9} {'p99':>9} "
+            f"{'bytes':>10}"
+        )
+        for ident, row in daemons.items():
+            lines.append(
+                f"  {ident:<31} {row['requests']:>6} "
+                f"{row['p50_s'] * 1e3:>7.1f}ms "
+                f"{row['p99_s'] * 1e3:>7.1f}ms "
+                f"{_fmt_bytes(row['bytes']):>10}"
+            )
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------- rendering

@@ -160,8 +160,18 @@ def build_entry(mon: Any) -> Dict[str, Any]:
         from .. import peer as peer_mod
 
         doc["peer"] = peer_mod.process_stats()
+        # Per-peer serving health (bounded: one small row per peer addr);
+        # omitted while empty so non-serving ops' entries don't grow.
+        scoreboard = peer_mod.peer_scoreboard()
+        if scoreboard:
+            doc["peer_scoreboard"] = scoreboard
     except Exception:  # peer layer must never fail telemetry
         doc["peer"] = {}
+    # Op-specific extension doc (rollout_fleet publishes its per-wave
+    # progress here) — duck-typed off the monitor like fleet_overhead_s.
+    extra = getattr(mon, "fleet_extra", None)
+    if isinstance(extra, dict) and extra:
+        doc["extra"] = extra
     return doc
 
 
@@ -558,6 +568,57 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         }
         for w in sorted(live, key=_straggle_key)
     ]
+    # Per-peer scoreboard, merged across processes by peer addr (newest
+    # entry per process, like the other cumulative counters).  Counters
+    # sum; health estimates take the WORST observed view (max EWMA/p99,
+    # any quarantine/demotion) — `top` is a triage surface, not an
+    # average-smoothing one.
+    per_proc_docs: Dict[str, Dict[str, Any]] = {}
+    for d in entries:
+        per_proc_docs[f"{d.get('host', '?')}:{d.get('pid', '?')}"] = d
+    scoreboard: Dict[str, Dict[str, Any]] = {}
+    for d in per_proc_docs.values():
+        for addr, row in (d.get("peer_scoreboard") or {}).items():
+            if not isinstance(row, dict):
+                continue
+            slot = scoreboard.get(addr)
+            if slot is None:
+                scoreboard[addr] = dict(row)
+                continue
+            for k in ("hits", "misses", "errors", "rejects", "bytes"):
+                slot[k] = int(slot.get(k, 0) or 0) + int(row.get(k, 0) or 0)
+            for k in ("ewma_latency_s", "ewma_error", "p50_s", "p99_s",
+                      "quarantined_until"):
+                slot[k] = max(
+                    float(slot.get(k, 0.0) or 0.0), float(row.get(k, 0.0) or 0.0)
+                )
+            slot["demoted"] = bool(slot.get("demoted")) or bool(
+                row.get("demoted")
+            )
+    for row in scoreboard.values():
+        fetches = (
+            int(row.get("hits", 0))
+            + int(row.get("misses", 0))
+            + int(row.get("errors", 0))
+            + int(row.get("rejects", 0))
+        )
+        row["fetches"] = fetches
+        row["hit_ratio"] = (
+            round(int(row.get("hits", 0)) / fetches, 4) if fetches else None
+        )
+    # In-flight rollout (newest wins: entries arrive oldest-first): the
+    # wave doc rollout_fleet publishes through its monitor's fleet_extra.
+    rollout_doc: Optional[Dict[str, Any]] = None
+    for d in entries:
+        if d.get("kind") != "rollout" or bool((d.get("op") or {}).get("done")):
+            continue
+        wave = (d.get("extra") or {}).get("rollout")
+        if isinstance(wave, dict):
+            rollout_doc = {
+                **wave,
+                "worker": f"{d.get('host', '?')}:{d.get('pid', '?')}",
+                "age_s": d.get("_age_s", 0.0),
+            }
     return {
         "schema": SCHEMA_VERSION,
         "time": time.time(),
@@ -580,6 +641,8 @@ def aggregate(entries: List[Dict[str, Any]]) -> Dict[str, Any]:
         "proc_totals": proc_totals,
         "cache": cache_view,
         "peer": peer_view,
+        "peer_scoreboard": scoreboard,
+        "rollout": rollout_doc,
         "stragglers": stragglers,
         "straggler": stragglers[0] if stragglers else None,
     }
@@ -623,6 +686,17 @@ def render(view: Dict[str, Any], spool: str) -> str:
             f"{peer.get('misses', 0)} origin fallbacks, "
             f"{peer.get('rejects', 0)} rejected"
         )
+    rollout = view.get("rollout")
+    if rollout:
+        eta = rollout.get("eta_s")
+        lines.append(
+            f"ROLLOUT in flight ({rollout.get('worker', '?')}): "
+            f"step {rollout.get('step')} wave {rollout.get('wave', '?')} — "
+            f"{rollout.get('completed', 0)}/{rollout.get('total', 0)} hosts, "
+            f"{_fmt_bytes(rollout.get('peer_bytes', 0))} via peers / "
+            f"{_fmt_bytes(rollout.get('origin_bytes', 0))} from origin"
+            + (f", eta {eta:.0f}s" if isinstance(eta, (int, float)) else "")
+        )
     for dead in view.get("suspected_dead") or ():
         lines.append(
             f"SUSPECTED DEAD: {dead['worker']} rank {dead['rank']} "
@@ -653,6 +727,27 @@ def render(view: Dict[str, Any], spool: str) -> str:
         )
     if not view["workers"]:
         lines.append("  (no live entries — fleet idle, or the spool is stale)")
+    scoreboard = view.get("peer_scoreboard") or {}
+    if scoreboard:
+        lines.append(
+            f"  PEERS {'addr':<22} {'fetch':>6} {'hit%':>5} {'p99':>9} "
+            f"{'served':>9} {'quarantined':>12} {'state':>8}"
+        )
+        now = time.time()
+        for addr in sorted(scoreboard):
+            row = scoreboard[addr]
+            ratio = row.get("hit_ratio")
+            quar_until = float(row.get("quarantined_until", 0.0) or 0.0)
+            quar = (
+                f"{quar_until - now:.0f}s left" if quar_until > now else "-"
+            )
+            state = "demoted" if row.get("demoted") else "ok"
+            lines.append(
+                f"        {addr:<22} {row.get('fetches', 0):>6} "
+                f"{('-' if ratio is None else f'{ratio:.0%}'):>5} "
+                f"{row.get('p99_s', 0.0) * 1e3:>7.1f}ms "
+                f"{_fmt_bytes(row.get('bytes', 0)):>9} {quar:>12} {state:>8}"
+            )
     return "\n".join(lines)
 
 

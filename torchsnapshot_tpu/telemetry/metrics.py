@@ -527,6 +527,50 @@ def record_peer_reject(reason: str) -> None:
     ).inc(reason=reason)
 
 
+# Peer fetches are LAN round-trips, not object-store I/O: the default
+# duration buckets start at 10 ms, which would flatten a healthy 1-5 ms
+# fleet into one bucket.  Explicit sub-ms..10 s ladder instead.
+_PEER_FETCH_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def record_peer_fetch_seconds(seconds: float) -> None:
+    """One peer chunk fetch's wall (success or failure) — the latency
+    distribution behind the per-peer scoreboard EWMAs, scrapeable from
+    `top --prometheus` and the daemon's /metrics endpoint."""
+    if not enabled():
+        return
+    histogram(
+        "tpusnap_peer_fetch_seconds",
+        "Wall seconds per peer chunk fetch attempt sequence",
+        buckets=_PEER_FETCH_BUCKETS,
+    ).observe(max(0.0, float(seconds)))
+
+
+def record_peer_demoted() -> None:
+    """A peer crossed the scoreboard's demotion threshold (latency EWMA
+    past TPUSNAP_PEER_DEMOTE_FACTOR x fleet median, or error EWMA > 0.5)
+    and moved to the back of the rendezvous order."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_peer_demotions_total",
+        "Peers demoted to last-resort candidates by the serving scoreboard",
+    ).inc()
+
+
+def record_rollout_wave(wave: str) -> None:
+    """One rollout wave transition (canary / verify / fleet) published by
+    rollout_fleet's live progress."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_rollout_waves_total",
+        "Rollout wave transitions (canary warm, canary verify, fleet fan-out)",
+    ).inc(wave=wave)
+
+
 def record_peerd_request(kind: str, status: int, nbytes: int = 0) -> None:
     """One request served by this host's peer daemon (peerd.py)."""
     if not enabled():
@@ -714,6 +758,8 @@ DIRECT_METRIC_EVENTS = frozenset(
         "peer.hit",  # record_peer
         "peer.miss",  # record_peer
         "peer.reject",  # record_peer_reject
+        "peer.demoted",  # record_peer_demoted
+        "rollout.wave",  # record_rollout_wave
     }
 )
 

@@ -26,19 +26,22 @@ one branch per call site.
 from __future__ import annotations
 
 import contextvars
+import hashlib
 import itertools
 import json
 import logging
 import os
+import socket
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import knobs, phase_stats
 
 logger = logging.getLogger(__name__)
 
 TRACE_FILE_SUFFIX = ".trace.json"
+ACCESS_LOG_SUFFIX = ".access.jsonl"
 
 # Maps time.monotonic() stamps (what phase_stats records) onto the epoch
 # clock so per-rank trace files from different processes line up when
@@ -54,6 +57,29 @@ _ACTIVE: List["_TraceOp"] = []
 _parent_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
     "tpusnap_parent_span", default=None
 )
+
+# Process-lifetime span count: the calibration meter the serve bench
+# multiplies by the isolated per-span cost (same estimate-by-parts shape as
+# fleet.calibrated_overhead_s).
+_SPAN_TOTALS_LOCK = threading.Lock()
+_SPANS_RECORDED = 0
+
+
+def _count_span() -> None:
+    global _SPANS_RECORDED
+    with _SPAN_TOTALS_LOCK:
+        _SPANS_RECORDED += 1
+
+
+def spans_recorded() -> int:
+    return _SPANS_RECORDED
+
+
+def trace_id_for(op_id: str) -> str:
+    """Deterministic 32-hex W3C trace id for an operation: every rank of a
+    fleet-wide op derives the same id from the shared op id, so cross-host
+    stitching needs no extra coordination."""
+    return hashlib.sha256(op_id.encode("utf-8")).hexdigest()[:32]
 
 
 def enabled() -> bool:
@@ -72,6 +98,10 @@ class _TraceOp:
         self.op_id = op_id
         self.rank = rank
         self.trace_dir = trace_dir
+        self.trace_id = trace_id_for(op_id)
+        # Reserved up front: spans with no in-context parent (and outbound
+        # traceparent headers sent outside any span) hang off the op root.
+        self.root_span_id = next(_ids)
         self.begin_us = _now_us()
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
@@ -108,6 +138,7 @@ class _TraceOp:
         args = dict(args)
         args["op"] = self.op_id
         args["span_id"] = span_id
+        _count_span()
         with self._lock:
             self._events.append(
                 {
@@ -142,7 +173,13 @@ class _TraceOp:
 
     def finish(self, success: bool, extra: Dict[str, Any]) -> Optional[str]:
         end_us = _now_us()
-        args = {"op": self.op_id, "success": success, **extra}
+        args = {
+            "op": self.op_id,
+            "success": success,
+            "span_id": self.root_span_id,
+            "trace": self.trace_id,
+            **extra,
+        }
         with self._lock:
             self._events.append(
                 {
@@ -174,6 +211,8 @@ class _TraceOp:
                 "kind": self.kind,
                 "rank": self.rank,
                 "success": success,
+                "trace_id": self.trace_id,
+                "host": socket.gethostname(),
             },
         }
         fname = f"{self.kind}-{self.op_id[:8]}-rank{self.rank}{TRACE_FILE_SUFFIX}"
@@ -239,6 +278,9 @@ class _NoopSpan:
     def __exit__(self, *exc: Any) -> None:
         return None
 
+    def set(self, **args: Any) -> None:
+        return None
+
 
 _NOOP = _NoopSpan()
 
@@ -261,11 +303,17 @@ class _Span:
         self._token = _parent_span.set(span_id)
         return self
 
+    def set(self, **args: Any) -> None:
+        """Attach outcome args (status, byte counts) discovered after the
+        span opened; recorded at exit."""
+        self._args.update(args)
+
     def __exit__(self, exc_type: Any, *exc: Any) -> None:
         _parent_span.reset(self._token)
         if exc_type is not None:
             self._args["error"] = getattr(exc_type, "__name__", str(exc_type))
         end_us = _now_us()
+        _count_span()
         with self._op._lock:
             self._op._events.append(
                 {
@@ -314,6 +362,252 @@ def record_phase(phase: str, begin_mono: float, end_mono: float, nbytes: int) ->
         cat="phase",
         args=args,
     )
+
+
+# ------------------------------------------------- context propagation
+
+
+def current_trace_id() -> Optional[str]:
+    """The active op's trace id, or None when nothing is collecting —
+    stamped into events (peer.reject, peer.demoted) so a quarantine can be
+    joined back to the request that triggered it."""
+    op = _current()
+    return op.trace_id if op is not None else None
+
+
+def current_traceparent() -> Optional[str]:
+    """W3C ``traceparent`` header for the active op's current span context
+    (``00-<trace>-<span>-01``), or None when nothing is collecting.  Sent
+    on every outbound peer fetch so the serving daemon's handler span joins
+    the caller's trace."""
+    op = _current()
+    if op is None:
+        return None
+    parent = _parent_span.get() or op.root_span_id
+    return f"00-{op.trace_id}-{parent:016x}-01"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, int]]:
+    """Parse a ``traceparent`` header into ``(trace_id, parent_span_id)``.
+    Tolerant of unknown versions, strict about shape — a malformed header
+    yields None (the handler span simply starts a fresh trace)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    trace_hex, span_hex = parts[1], parts[2]
+    if len(trace_hex) != 32 or len(span_hex) != 16:
+        return None
+    try:
+        span_id = int(span_hex, 16)
+        int(trace_hex, 16)
+    except ValueError:
+        return None
+    if span_id == 0 or trace_hex == "0" * 32:
+        return None
+    return trace_hex, span_id
+
+
+# ------------------------------------------------- serving-plane tracing
+
+
+class ServerTracer:
+    """Span collector for a long-lived peer daemon.
+
+    Unlike :class:`_TraceOp` (one op, one file at finish), a daemon serves
+    requests indefinitely: spans land in a bounded in-memory buffer (oldest
+    dropped when ``TPUSNAP_PEER_TRACE_MAX_SPANS`` is exceeded — the drop
+    count is carried in ``otherData.dropped_spans``, never silently) and
+    the buffer is rewritten to one trace file at most every
+    ``TPUSNAP_PEER_TRACE_FLUSH_S`` seconds (piggybacked on span recording;
+    no flush thread) plus once at :meth:`close`.  Each span carries its own
+    ``args.trace`` id parsed from the request's ``traceparent`` header, so
+    one daemon file contributes to many stitched client traces.
+    """
+
+    def __init__(self, trace_dir: str, ident: str, kind: str = "peerd") -> None:
+        self.trace_dir = trace_dir
+        self.ident = ident
+        self.kind = kind
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._max_spans = knobs.get_peer_trace_max_spans()
+        self._flush_s = knobs.get_peer_trace_flush_s()
+        self._last_flush = time.monotonic()
+        self.path = os.path.join(
+            trace_dir, f"{kind}-{ident[:8]}-rank0{TRACE_FILE_SUFFIX}"
+        )
+
+    def record_span(
+        self,
+        name: str,
+        begin_us: float,
+        dur_us: float,
+        args: Dict[str, Any],
+    ) -> None:
+        span_id = next(_ids)
+        args = dict(args)
+        args["span_id"] = span_id
+        _count_span()
+        flush_due = False
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": "phase",
+                    "ph": "X",
+                    "ts": begin_us,
+                    "dur": max(dur_us, 0.0),
+                    "pid": 0,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+            if len(self._events) > self._max_spans:
+                overflow = len(self._events) - self._max_spans
+                del self._events[:overflow]
+                self._dropped += overflow
+            now = time.monotonic()
+            if now - self._last_flush >= self._flush_s:
+                self._last_flush = now
+                flush_due = True
+        if flush_due:
+            self.flush()
+
+    def flush(self) -> Optional[str]:
+        """Rewrite the daemon's trace file from the current buffer."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        payload = {
+            "traceEvents": events
+            + [
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": 0,
+                    "args": {"name": f"{self.kind} {self.ident[:8]}"},
+                }
+            ],
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "op": self.ident,
+                "kind": self.kind,
+                "rank": 0,
+                "success": True,
+                "host": socket.gethostname(),
+                "dropped_spans": dropped,
+            },
+        }
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            # Same best-effort stance as _TraceOp.finish: rename-atomicity
+            # protects concurrent readers, durability is not the point.
+            os.replace(tmp, self.path)  # tpusnap-lint: disable=durability-flow
+            return self.path
+        except OSError:
+            logger.warning(
+                "failed to write server trace file %s", self.path, exc_info=True
+            )
+            return None
+
+    def close(self) -> Optional[str]:
+        return self.flush()
+
+
+class AccessLog:
+    """Structured JSONL access log with size-capped rotation.
+
+    One line per served request: ``{ts, trace, digest, range, status,
+    bytes, wall_s, client}``.  When the file crosses ``max_bytes`` it is
+    renamed to ``<path>.1`` (one generation kept) and a fresh file is
+    started — bounded disk, no silent truncation of in-flight lines.
+    """
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None) -> None:
+        self.path = path
+        self.max_bytes = (
+            max_bytes
+            if max_bytes is not None
+            else knobs.get_peerd_access_log_max_bytes()
+        )
+        self._lock = threading.Lock()
+
+    def log(self, **fields: Any) -> None:
+        line = json.dumps(fields, separators=(",", ":")) + "\n"
+        with self._lock:
+            try:
+                parent = os.path.dirname(self.path)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                try:
+                    if os.path.getsize(self.path) >= self.max_bytes:
+                        os.replace(self.path, self.path + ".1")
+                except OSError:
+                    pass  # no file yet — nothing to rotate
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line)
+            except OSError:
+                logger.warning(
+                    "failed to append access log %s", self.path, exc_info=True
+                )
+
+
+def validate_access_log(path: str) -> List[str]:
+    """Schema check for a peer daemon access log: every line must be a
+    JSON object with the documented fields.  Returns problems; empty means
+    valid."""
+    required = ("ts", "trace", "digest", "status", "bytes", "wall_s", "client")
+    problems: List[str] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"unreadable: {e}"]
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            problems.append(f"line {i}: not JSON")
+            continue
+        if not isinstance(doc, dict):
+            problems.append(f"line {i}: not an object")
+            continue
+        for field in required:
+            if field not in doc:
+                problems.append(f"line {i}: missing {field}")
+        if not isinstance(doc.get("status"), int):
+            problems.append(f"line {i}: status must be int")
+        if not isinstance(doc.get("ts"), (int, float)):
+            problems.append(f"line {i}: ts must be numeric")
+    return problems
+
+
+def calibrated_span_cost_s(samples: int = 200) -> Dict[str, Any]:
+    """Isolated per-span recording cost x spans recorded this process —
+    the tracing half of the serve bench's <1%-of-wall overhead proof
+    (same estimate-by-parts shape as ``fleet.calibrated_overhead_s``)."""
+    spans = spans_recorded()  # snapshot first: probe spans are not workload
+    probe = _TraceOp("calibration", "calibration", 0, trace_dir="")
+    t0 = time.perf_counter()
+    for _ in range(max(1, samples)):
+        with _Span(probe, "calibration_span", "phase", {"bytes": 1}):
+            pass
+    per_span = (time.perf_counter() - t0) / max(1, samples)
+    return {
+        "per_span_s": per_span,
+        "spans": spans,
+        "estimated_s": per_span * spans,
+    }
 
 
 # --------------------------------------------------------------- tooling
@@ -375,4 +669,96 @@ def merge_trace_files(paths: List[str]) -> Dict[str, Any]:
         "traceEvents": merged,
         "displayTimeUnit": "ms",
         "otherData": {"merged_from": sources},
+    }
+
+
+def host_skew_from_spool(spool: str) -> Dict[str, float]:
+    """Per-host clock-skew estimate (seconds) from fleet-spool stamps.
+
+    Every spool entry carries ``publish_time`` stamped by the writing
+    host's wall clock, while the entry file's mtime comes from the shared
+    filesystem's clock — their difference, medianed per host, is that
+    host's offset against the common reference.  Offsets are returned
+    relative to the smallest (so a single-host fleet, or the write latency
+    every host shares, maps to 0.0)."""
+    diffs: Dict[str, List[float]] = {}
+    try:
+        names = os.listdir(spool)
+    except OSError:
+        return {}
+    for name in names:
+        if not name.endswith(".fleet.json"):
+            continue
+        path = os.path.join(spool, name)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            mtime = os.path.getmtime(path)
+        except (OSError, ValueError):
+            continue
+        host = doc.get("host")
+        publish = doc.get("publish_time")
+        if not isinstance(host, str) or not isinstance(publish, (int, float)):
+            continue
+        diffs.setdefault(host, []).append(mtime - publish)
+    skew: Dict[str, float] = {}
+    for host, vals in diffs.items():
+        vals.sort()
+        skew[host] = vals[len(vals) // 2]
+    if skew:
+        base = min(skew.values())
+        skew = {host: off - base for host, off in skew.items()}
+    return skew
+
+
+def merge_fleet_traces(
+    paths: List[str], spool: Optional[str] = None
+) -> Dict[str, Any]:
+    """Stitch per-host client and daemon trace files into one timeline.
+
+    Beyond :func:`merge_trace_files`, every event is annotated with the
+    trace id it belongs to (``args.trace`` — daemon spans already carry
+    their own per-request id; client events inherit the file-level id), a
+    per-host clock-skew correction from the fleet spool's stamps is
+    applied, and ``otherData.trace_ids`` lists every distinct trace so the
+    caller can see which requests cross which files."""
+    skew = host_skew_from_spool(spool) if spool else {}
+    merged: List[Dict[str, Any]] = []
+    sources: List[Dict[str, Any]] = []
+    trace_ids: Dict[str, int] = {}
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        problems = validate_trace(doc)
+        if problems:
+            raise ValueError(f"{path}: invalid trace: {problems[:3]}")
+        other = doc.get("otherData", {})
+        file_trace = other.get("trace_id")
+        host = other.get("host")
+        shift_us = skew.get(host, 0.0) * 1e6 if isinstance(host, str) else 0.0
+        for ev in doc.get("traceEvents", []):
+            if shift_us and isinstance(ev.get("ts"), (int, float)):
+                ev = dict(ev)
+                ev["ts"] = ev["ts"] + shift_us
+            args = ev.get("args")
+            trace = args.get("trace") if isinstance(args, dict) else None
+            if trace is None and isinstance(file_trace, str) and ev.get("ph") != "M":
+                ev = dict(ev)
+                ev["args"] = {**(args or {}), "trace": file_trace}
+                trace = file_trace
+            if isinstance(trace, str):
+                trace_ids[trace] = trace_ids.get(trace, 0) + 1
+            merged.append(ev)
+        sources.append(
+            {"file": os.path.basename(path), "skew_s": skew.get(host, 0.0), **other}
+        )
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": sources,
+            "trace_ids": {
+                t: n for t, n in sorted(trace_ids.items(), key=lambda kv: -kv[1])
+            },
+        },
     }
