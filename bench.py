@@ -998,6 +998,99 @@ def main() -> None:
         )
         _PARTIAL["banked"]["sync"]["cas_probe"] = cas_probe
 
+    # --- shared-store probe (--store): multi-tenant CAS economics ---
+    # Two tenants (two manager roots) fine-tuning from the SAME frozen
+    # backbone into one shared store (store.py): the backbone should land
+    # physically ONCE store-wide while each tenant's churning head lands
+    # per-tenant — physical ≈ 1× backbone + per-tenant deltas.  The
+    # cross-tenant dedup ratio is the number the multi-tenant store
+    # exists for; banked as a gated trajectory series.  Same slab-
+    # threshold note as the cas probe: dedup granularity is the chunk,
+    # so the scaled-down backbone must exceed the slab threshold.
+    store_probe = None
+    if "--store" in argv:
+        _PARTIAL["phase"] = "store_probe"
+        from torchsnapshot_tpu import store as _store_mod
+        from torchsnapshot_tpu.manager import SnapshotManager as _Manager
+
+        store_dir = os.path.join(workdir, "store_shared")
+        shutil.rmtree(store_dir, ignore_errors=True)
+        tenant_roots = [
+            os.path.join(workdir, f"store_tenant_{i}") for i in (0, 1)
+        ]
+        for r in tenant_roots:
+            shutil.rmtree(r, ignore_errors=True)
+        backbone_mb = int(os.environ.get("BENCH_STORE_BACKBONE_MB", "64"))
+        backbone = np.random.RandomState(11).bytes(backbone_mb << 20)
+        backbone = np.frombuffer(backbone, np.uint8).reshape(-1)
+        head_nbytes = max(backbone.nbytes // 8, 1 << 20)
+        step_s = []
+        with _knobs.override_slab_size_threshold_bytes(4 << 20):
+            mgrs = [
+                _Manager(r, store=store_dir) for r in tenant_roots
+            ]
+            for step in (1, 2):
+                for ti, mgr in enumerate(mgrs):
+                    head = np.random.RandomState(100 * ti + step).bytes(
+                        head_nbytes
+                    )
+                    head = np.frombuffer(head, np.uint8).reshape(-1)
+                    _drain_writeback()
+                    t0 = time.monotonic()
+                    mgr.save(
+                        step,
+                        {
+                            "ft": StateDict(
+                                {"backbone": backbone, "head": head}
+                            )
+                        },
+                    )
+                    step_s.append(round(time.monotonic() - t0, 2))
+        physical_bytes = _dir_bytes(os.path.join(store_dir, "cas"))
+        usage = _store_mod.tenant_usage(store_dir)
+        logical_bytes = usage["logical_bytes"]
+        # Prove both tenants restore through the shared store.
+        for ti, mgr in enumerate(mgrs):
+            dst = {
+                "ft": StateDict(
+                    {
+                        "backbone": np.zeros_like(backbone),
+                        "head": np.zeros(head_nbytes, np.uint8),
+                    }
+                )
+            }
+            mgr.restore_latest(dst)
+            np.testing.assert_array_equal(
+                np.asarray(dst["ft"]["backbone"][:64]), backbone[:64]
+            )
+        shutil.rmtree(store_dir, ignore_errors=True)
+        for r in tenant_roots:
+            shutil.rmtree(r, ignore_errors=True)
+        store_probe = {
+            "tenants": 2,
+            "steps_per_tenant": 2,
+            "backbone_bytes": backbone.nbytes,
+            "head_bytes": head_nbytes,
+            "logical_bytes": logical_bytes,
+            "physical_bytes": physical_bytes,
+            "dedup_ratio": round(logical_bytes / physical_bytes, 3)
+            if physical_bytes
+            else None,
+            "step_save_s": step_s,
+            # The shared backbone must be stored exactly once STORE-WIDE:
+            # physical ≈ 1× backbone + 4 tenant heads (2 tenants × 2
+            # steps), not 2× backbone.
+            "backbone_stored_once": physical_bytes
+            < backbone.nbytes + 4 * head_nbytes + (1 << 20),
+        }
+        log(
+            f"store probe: {physical_bytes / 1e9:.3f} GB physical for "
+            f"{logical_bytes / 1e9:.3f} GB logical across 2 tenants "
+            f"(dedup {store_probe['dedup_ratio']}x, "
+            f"backbone_stored_once={store_probe['backbone_stored_once']})"
+        )
+        _PARTIAL["banked"]["sync"]["store_probe"] = store_probe
+
     # --- journal probe (--journal): high-frequency delta-save economics ---
     # N steps of a 10%-churn workload (20 equal leaves, 2 mutated per
     # step) saved twice: full async_take baseline vs journal mode
@@ -2153,6 +2246,7 @@ def main() -> None:
             "compression_probe": compression_probe,
             "compress_scale_probe": compress_scale_probe,
             "cas_probe": cas_probe,
+            "store_probe": store_probe,
             "journal_probe": journal_probe,
             "native_ab_probe": native_ab_probe,
             "serve_probe": serve_probe,

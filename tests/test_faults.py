@@ -50,6 +50,17 @@ def test_parse_rules():
     assert crash.path_glob == "cas/*"
 
 
+def test_parse_ledger_and_delete_rules():
+    """The shared-store chaos vocabulary: ``delete`` targets chunk
+    removals, ``ledger`` targets any verb on a store control path."""
+    rules = parse_fault_spec("ledger:1:transient@ledger/*; ledger:2:crash")
+    assert [r.op for r in rules] == ["ledger", "ledger"]
+    assert rules[0].path_glob == "ledger/*" and rules[0].kind == "transient"
+    assert rules[1].kind == "crash" and rules[1].first == 2
+    d = parse_fault_spec("delete:2+:terminal@cas/*")[0]
+    assert d.op == "delete" and d.open_ended and d.path_glob == "cas/*"
+
+
 @pytest.mark.parametrize(
     "bad",
     [
@@ -62,6 +73,7 @@ def test_parse_rules():
         "write:1:latency:-1",  # negative latency
         "write:1:transient:0:extra",  # too many fields
         "write:1:crash:1",  # crash takes no param
+        "ledger:1:torn",  # torn is write-only, ledger matches any verb
     ],
 )
 def test_parse_rejects(bad):
@@ -98,6 +110,40 @@ def test_path_glob_scopes_counter():
     plugin.sync_write(WriteIO(path="normal", buf=b"1"))  # glob miss: no count
     with pytest.raises(InjectedTransientError):
         plugin.sync_write(WriteIO(path="special/x", buf=b"2"))
+
+
+def test_ledger_op_matches_control_paths_not_data():
+    """An ``op=ledger`` rule keys on the PATH namespace: chunk/data paths
+    never count toward it, any store control path does."""
+    plugin = _mem("ledger:1:transient")
+    plugin.sync_write(WriteIO(path="cas/xxh64/ab/abcd", buf=b"1"))  # no count
+    with pytest.raises(InjectedTransientError):
+        plugin.sync_write(WriteIO(path="tenants/t1.json", buf=b"{}"))
+    plugin.sync_write(WriteIO(path="sweep/epoch.json", buf=b"{}"))  # spent
+
+
+def test_ledger_op_counts_every_verb():
+    """The ledger counter advances across verbs — a read of a ref journal
+    is the 2nd match after its write, so ``ledger:2`` fires on the read."""
+    plugin = _mem("ledger:2:terminal@ledger/*")
+    plugin.sync_write(WriteIO(path="ledger/t1/refs_1.json", buf=b"{}"))
+    with pytest.raises(FaultInjectionError):
+        plugin.sync_read(ReadIO(path="ledger/t1/refs_1.json"))
+
+
+def test_delete_fault_scoped_to_chunks():
+    """``delete:N:transient@cas/*`` models a flaky chunk removal during a
+    sweep's delete phase: control-path deletes pass, the chunk delete
+    fails once and succeeds on retry."""
+    plugin = _mem("delete:1:transient@cas/*")
+    plugin.sync_write(WriteIO(path="cas/xxh64/ab/abcd", buf=b"x"))
+    plugin.sync_write(WriteIO(path="leases/writer_t1_1.json", buf=b"{}"))
+    plugin.sync_delete("leases/writer_t1_1.json")  # glob miss: passes
+    with pytest.raises(InjectedTransientError):
+        plugin.sync_delete("cas/xxh64/ab/abcd")
+    assert plugin.sync_exists("cas/xxh64/ab/abcd")  # fault fired pre-op
+    plugin.sync_delete("cas/xxh64/ab/abcd")  # retry passes
+    assert not plugin.sync_exists("cas/xxh64/ab/abcd")
 
 
 def test_torn_write_persists_prefix():

@@ -1,0 +1,609 @@
+"""Shared multi-tenant chunk store (store.py): cross-root CAS + ledger GC.
+
+Covers tenant identity/registration, the ``.store`` pointer, reference
+journals and their protection window, the epoch-fenced two-phase sweep
+(condemn → grace quarantine → delete, with resurrection and the writer
+fence), the StoreResolver's quarantine fallback, the persisted-index
+staleness path under foreign sweeps, stamp-based in-flight marker
+liveness, per-tenant quota accounting, and ``repack --into-store``
+migration.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import StateDict, knobs
+from torchsnapshot_tpu import store as store_mod
+from torchsnapshot_tpu.io_types import ReadIO, WriteIO
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
+
+
+def _state(v, n=512):
+    return {
+        "m": StateDict(
+            {"w": np.full((n,), float(v), np.float32), "step": v}
+        )
+    }
+
+
+def _zeros(n=512):
+    return {
+        "m": StateDict({"w": np.zeros((n,), np.float32), "step": 0})
+    }
+
+
+def _mgr(root, store=None, max_to_keep=10):
+    return SnapshotManager(
+        str(root), max_to_keep=max_to_keep, store=str(store) if store else None
+    )
+
+
+def _store_plugin(store):
+    return url_to_storage_plugin(str(store))
+
+
+def _chunks(store):
+    from torchsnapshot_tpu import cas as cas_mod
+
+    storage = _store_plugin(store)
+    try:
+        return cas_mod.list_chunk_relpaths(storage)
+    finally:
+        storage.sync_close()
+
+
+# ----------------------------------------------------------------- identity
+
+
+def test_tenant_identity_canonical(tmp_path):
+    bare = str(tmp_path / "root")
+    assert store_mod.canonical_root_url(bare) == f"fs://{bare}"
+    assert store_mod.canonical_root_url(bare + "/") == f"fs://{bare}"
+    assert store_mod.tenant_id(bare) == store_mod.tenant_id(f"fs://{bare}/")
+
+
+def test_register_tenant_idempotent_across_spellings(tmp_path):
+    storage = _store_plugin(tmp_path / "store")
+    try:
+        bare = str(tmp_path / "root")
+        tid1 = store_mod.register_tenant(storage, bare)
+        tid2 = store_mod.register_tenant(storage, f"fs://{bare}/")
+        assert tid1 == tid2
+        tenants = store_mod.registered_tenants(storage)
+        assert list(tenants) == [tid1]
+    finally:
+        storage.sync_close()
+
+
+def test_store_pointer_roundtrip(tmp_path):
+    storage = url_to_storage_plugin(str(tmp_path / "root"))
+    try:
+        assert store_mod.read_store_pointer(storage) is None
+        store_mod.write_store_pointer(storage, "/some/store")
+        assert store_mod.read_store_pointer(storage) == "/some/store"
+    finally:
+        storage.sync_close()
+
+
+# ------------------------------------------------------------- two tenants
+
+
+def test_two_tenants_share_one_store(tmp_path):
+    store = tmp_path / "store"
+    ra, rb = tmp_path / "ra", tmp_path / "rb"
+    ma, mb = _mgr(ra, store), _mgr(rb, store)
+    ma.save(1, _state(1))
+    mb.save(1, _state(1))  # identical content: must share chunks
+    # Chunks live ONLY under the store; roots carry the pointer.
+    assert _chunks(store)
+    assert not (ra / "cas").exists()
+    assert not (rb / "cas").exists()
+    assert (ra / store_mod.STORE_POINTER_FNAME).exists()
+    # Identical states dedup cross-tenant: both tenants' references are
+    # the same chunk set, so classification sees no orphans.
+    cls = store_mod.chunk_classification(str(store))
+    assert cls["orphan"] == [] and cls["condemned"] == []
+    assert sorted(cls["referenced"]) == sorted(_chunks(store))
+    for mgr, root in ((ma, ra), (mb, rb)):
+        dst = _zeros()
+        mgr.restore_latest(dst)
+        assert float(dst["m"]["w"][0]) == 1.0
+
+
+def test_classification_accounts_for_every_present_chunk(tmp_path):
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    storage = _store_plugin(store)
+    try:
+        storage.sync_write(
+            WriteIO(path="cas/xxh64/de/deadbeef", buf=b"junk", durable=True)
+        )
+    finally:
+        storage.sync_close()
+    cls = store_mod.chunk_classification(str(store))
+    present = _chunks(store)
+    assert sorted(cls["referenced"] + cls["orphan"]) == sorted(present)
+    assert "cas/xxh64/de/deadbeef" in cls["orphan"]
+
+
+# ------------------------------------------------------------------- sweep
+
+
+def test_sweep_two_phase_condemn_then_delete(tmp_path):
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    orphan = "cas/xxh64/de/deadbeef"
+    storage = _store_plugin(store)
+    try:
+        storage.sync_write(WriteIO(path=orphan, buf=b"junk", durable=True))
+    finally:
+        storage.sync_close()
+    # Phase 1 under a long grace: condemned (moved to quarantine), NOT
+    # deleted — and absent from the live cas/ listing.
+    with knobs.override_store_quarantine_s(3600.0):
+        report = store_mod.sweep(str(store))
+    assert orphan in report["condemned"] and report["deleted"] == []
+    assert orphan not in _chunks(store)
+    storage = _store_plugin(store)
+    try:
+        assert orphan in store_mod.quarantined_chunk_relpaths(storage)
+    finally:
+        storage.sync_close()
+    # Phase 2 after the grace: deleted from quarantine.
+    with knobs.override_store_quarantine_s(0.0):
+        report = store_mod.sweep(str(store))
+    assert orphan in report["deleted"]
+    storage = _store_plugin(store)
+    try:
+        assert orphan not in store_mod.quarantined_chunk_relpaths(storage)
+    finally:
+        storage.sync_close()
+    # Referenced chunks survived both phases; both restore.
+    cls = store_mod.chunk_classification(str(store))
+    assert cls["orphan"] == [] and cls["condemned"] == []
+    dst = _zeros()
+    ma.restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 1.0
+
+
+def test_delete_phase_restores_rereferenced_chunk(tmp_path):
+    """A chunk condemned mid-take whose journal/commit now references it
+    must be RESTORED by the delete phase, not deleted."""
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    chunk = _chunks(store)[0]
+    storage = _store_plugin(store)
+    try:
+        # Simulate a condemnation that raced a committing take: the chunk
+        # sits in quarantine (old stamp: grace passed) while a committed
+        # manifest references it.
+        read_io = ReadIO(path=chunk)
+        storage.sync_read(read_io)
+        store_mod._write_json(
+            storage,
+            f"{store_mod.QUARANTINE_DIR}/7/{store_mod.CONDEMNED_FNAME}",
+            {"epoch": 7, "stamp": time.time() - 9999},
+        )
+        storage.sync_write(
+            WriteIO(
+                path=store_mod.quarantine_relpath(7, chunk),
+                buf=read_io.buf,
+                durable=True,
+            )
+        )
+        storage.sync_delete(chunk)
+    finally:
+        storage.sync_close()
+    with knobs.override_store_quarantine_s(0.0):
+        report = store_mod.sweep(str(store))
+    assert chunk in report["restored"] and chunk not in report["deleted"]
+    assert chunk in _chunks(store)
+    dst = _zeros()
+    ma.restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 1.0
+
+
+def test_sweep_busy_on_fresh_foreign_lease_and_adoption(tmp_path):
+    store = tmp_path / "store"
+    _mgr(tmp_path / "ra", store).save(1, _state(1))
+    storage = _store_plugin(store)
+    try:
+        store_mod._write_json(
+            storage,
+            store_mod.SWEEP_LEASE_FNAME,
+            {
+                "host": "elsewhere",
+                "pid": 1,
+                "phase": "condemn",
+                "epoch": 1,
+                "stamp": time.time(),
+            },
+        )
+    finally:
+        storage.sync_close()
+    with pytest.raises(store_mod.StoreSweepBusyError):
+        store_mod.sweep(str(store))
+    # force adopts even a fresh foreign lease (operator knows it's dead).
+    report = store_mod.sweep(str(store), force=True)
+    assert report["adopted_lease"]
+    # A STALE foreign lease is adopted without force.
+    storage = _store_plugin(store)
+    try:
+        store_mod._write_json(
+            storage,
+            store_mod.SWEEP_LEASE_FNAME,
+            {
+                "host": "elsewhere",
+                "pid": 1,
+                "phase": "delete",
+                "epoch": 1,
+                "stamp": time.time() - 9999,
+            },
+        )
+    finally:
+        storage.sync_close()
+    report = store_mod.sweep(str(store))
+    assert report["adopted_lease"]
+
+
+def test_writer_fence_defers_delete_phase(tmp_path):
+    """No quarantine epoch E is deleted while a fresh writer lease has
+    observed_epoch <= E: that writer may hold pre-condemn dedup decisions
+    no journal records yet."""
+    store = tmp_path / "store"
+    _mgr(tmp_path / "ra", store).save(1, _state(1))
+    orphan = "cas/xxh64/de/deadbeef"
+    storage = _store_plugin(store)
+    try:
+        storage.sync_write(WriteIO(path=orphan, buf=b"junk", durable=True))
+        store_mod._write_json(
+            storage,
+            store_mod.writer_lease_relpath("feedc0de00000000", 1),
+            {
+                "tenant": "feedc0de00000000",
+                "root": "/nowhere",
+                "host": "elsewhere",
+                "pid": 1,
+                "epoch": 0,
+                "stamp": time.time(),
+            },
+        )
+    finally:
+        storage.sync_close()
+    with knobs.override_store_quarantine_s(0.0):
+        report = store_mod.sweep(str(store))
+    assert orphan in report["condemned"]
+    assert report["deferred_epochs"] and orphan not in report["deleted"]
+    # Writer finishes (lease gone) → the next sweep's delete phase runs.
+    storage = _store_plugin(store)
+    try:
+        storage.sync_delete(store_mod.writer_lease_relpath("feedc0de00000000", 1))
+    finally:
+        storage.sync_close()
+    with knobs.override_store_quarantine_s(0.0):
+        report = store_mod.sweep(str(store))
+    assert orphan in report["deleted"]
+
+
+def test_ledger_protects_until_reaped(tmp_path):
+    """A reference journal protects its chunks while its writer's lease is
+    fresh or the entry is young; once both lapse the journal is reaped and
+    the chunks (uncommitted debris) become sweepable."""
+    store = tmp_path / "store"
+    _mgr(tmp_path / "ra", store).save(1, _state(1))
+    debris = "cas/xxh64/ab/abad1dea"
+    storage = _store_plugin(store)
+    try:
+        storage.sync_write(WriteIO(path=debris, buf=b"junk", durable=True))
+        # A crashed writer's journal: entry present, no lease, old stamp.
+        tid = "feedc0de00000000"
+        store_mod._write_json(
+            storage,
+            f"{store_mod.LEDGER_DIR}/{tid}/refs_1_1_1.json",
+            {
+                "tenant": tid,
+                "pid": 1,
+                "host": "elsewhere",
+                "epoch": 0,
+                "stamp": time.time(),
+                "chunks": [debris],
+            },
+        )
+        # Young entry → protected even without a lease.
+        assert debris in store_mod.ledger_protected_chunks(storage)
+        store_mod._write_json(
+            storage,
+            f"{store_mod.LEDGER_DIR}/{tid}/refs_1_1_1.json",
+            {
+                "tenant": tid,
+                "pid": 1,
+                "host": "elsewhere",
+                "epoch": 0,
+                "stamp": time.time() - 99999,
+                "chunks": [debris],
+            },
+        )
+        assert debris not in store_mod.ledger_protected_chunks(storage)
+    finally:
+        storage.sync_close()
+    with knobs.override_store_quarantine_s(0.0):
+        report = store_mod.sweep(str(store))
+    assert debris in report["condemned"]
+    assert report["ledgers_reaped"] >= 1
+
+
+# ---------------------------------------------------------------- resolver
+
+
+def _quarantine_chunk(store, chunk, epoch=3):
+    """Manually condemn one chunk into a quarantine epoch."""
+    storage = _store_plugin(store)
+    try:
+        read_io = ReadIO(path=chunk)
+        storage.sync_read(read_io)
+        store_mod._write_json(
+            storage,
+            f"{store_mod.QUARANTINE_DIR}/{epoch}/{store_mod.CONDEMNED_FNAME}",
+            {"epoch": epoch, "stamp": time.time()},
+        )
+        storage.sync_write(
+            WriteIO(
+                path=store_mod.quarantine_relpath(epoch, chunk),
+                buf=read_io.buf,
+                durable=True,
+            )
+        )
+        storage.sync_delete(chunk)
+    finally:
+        storage.sync_close()
+
+
+def test_resolver_resurrects_quarantined_chunk_on_read(tmp_path):
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    chunk = _chunks(store)[0]
+    _quarantine_chunk(store, chunk)
+    assert chunk not in _chunks(store)
+    # A fresh manager (fresh reader stack) restores through the resolver's
+    # quarantine fallback — and the hit durably resurrects the chunk.
+    dst = _zeros()
+    _mgr(tmp_path / "ra", store).restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 1.0
+    assert chunk in _chunks(store)
+
+
+def test_resolver_reports_quarantined_chunk_absent_to_writers(tmp_path):
+    """Writers must see a quarantined chunk as ABSENT so their dedup
+    re-writes it durably into cas/ (the condemnation may proceed to
+    deletion; an exists-hit would leave a dangling reference)."""
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    chunk = _chunks(store)[0]
+    _quarantine_chunk(store, chunk)
+    resolver = store_mod.StoreResolver(_store_plugin(store))
+    try:
+        assert not resolver.sync_exists(chunk)
+    finally:
+        resolver.sync_close()
+
+
+def test_persisted_index_stale_after_foreign_sweep(tmp_path):
+    """Satellite: a persisted ``.digest_index.json`` entry whose chunk a
+    foreign sweep removed must fail self-validation — the next take
+    re-writes the chunk instead of referencing a ghost."""
+    store = tmp_path / "store"
+    root = tmp_path / "ra"
+    _mgr(root, store).save(1, _state(1))
+    before = set(_chunks(store))
+    # Foreign sweep deletes every chunk outright (no quarantine copy —
+    # the worst case for a stale index).
+    storage = _store_plugin(store)
+    try:
+        for chunk in before:
+            storage.sync_delete(chunk)
+    finally:
+        storage.sync_close()
+    assert _chunks(store) == []
+    # A NEW manager (re-loads the persisted index from the root) saves the
+    # same content: every index hit must fail the existence probe and
+    # re-write durably.
+    mb = _mgr(root, store)
+    mb.save(2, _state(1))
+    assert _chunks(store)
+    dst = _zeros()
+    mb.restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 1.0
+
+
+# ------------------------------------------------------- in-flight markers
+
+
+def test_marker_staleness_is_stamp_based(tmp_path):
+    mgr = _mgr(tmp_path / "ra")
+    storage = url_to_storage_plugin(str(tmp_path / "ra"))
+    try:
+        base = {"name": ".inflight_step_1.json", "step": 1, "kind": "step"}
+        # Foreign-host marker with a FRESH stamp: live (pid means nothing
+        # cross-host — only the stamp age may condemn it).
+        doc = dict(base, host="elsewhere", pid=1, stamp=time.time())
+        assert not mgr._marker_stale(storage, doc)
+        # Same marker, stamp past the liveness grace: stale.
+        doc["stamp"] = time.time() - 99999
+        assert mgr._marker_stale(storage, doc)
+        # Stamp-less foreign marker (pre-upgrade writer): conservatively
+        # live — force exists for those.
+        assert not mgr._marker_stale(
+            storage, dict(base, host="elsewhere", pid=1)
+        )
+        # Local marker with a dead pid: stale regardless of stamp.
+        import socket
+
+        assert mgr._marker_stale(
+            storage,
+            dict(
+                base,
+                host=socket.gethostname(),
+                pid=2**22 + 1,
+                stamp=time.time(),
+            ),
+        )
+    finally:
+        storage.sync_close()
+
+
+def test_inflight_marker_refreshes_stamp(tmp_path):
+    """The save-time marker is a refreshed lease now: its stamp advances
+    while the save runs, so a hung-but-alive writer stays protected."""
+    mgr = _mgr(tmp_path / "ra")
+    marker = tmp_path / "ra" / ".inflight_step_1.json"
+    with knobs.override_lease_interval_s(0.05):
+        mgr._write_inflight_marker(1, "step")
+        doc1 = json.loads(marker.read_text())
+        deadline = time.time() + 5.0
+        doc2 = doc1
+        while doc2["stamp"] <= doc1["stamp"] and time.time() < deadline:
+            time.sleep(0.1)
+            doc2 = json.loads(marker.read_text())
+        mgr._remove_inflight_marker(1, "step")
+    assert doc2["stamp"] > doc1["stamp"]
+    assert not marker.exists()
+
+
+# -------------------------------------------------------------------- quota
+
+
+def test_tenant_usage_logical_vs_physical(tmp_path):
+    store = tmp_path / "store"
+    ra, rb = tmp_path / "ra", tmp_path / "rb"
+    backbone = np.frombuffer(
+        np.random.RandomState(5).bytes(1 << 20), np.uint8
+    )
+    with knobs.override_slab_size_threshold_bytes(1 << 18):
+        ma, mb = _mgr(ra, store), _mgr(rb, store)
+        for ti, mgr in enumerate((ma, mb)):
+            head = np.frombuffer(
+                np.random.RandomState(100 + ti).bytes(1 << 18), np.uint8
+            )
+            mgr.save(
+                1,
+                {"ft": StateDict({"backbone": backbone, "head": head})},
+            )
+    usage = store_mod.tenant_usage(str(store))
+    assert len(usage["tenants"]) == 2
+    # The shared backbone is stored once: physical < sum of logicals, and
+    # each tenant's exclusive (its head) is well below its logical.
+    assert usage["physical_bytes"] < usage["logical_bytes"]
+    assert usage["dedup_ratio"] and usage["dedup_ratio"] > 1.2
+    for doc in usage["tenants"].values():
+        assert 0 < doc["exclusive_bytes"] < doc["logical_bytes"]
+    # The gauges surface per tenant + _total.
+    from torchsnapshot_tpu.telemetry import metrics
+
+    with knobs.override_metrics(True):
+        store_mod.publish_usage_metrics(usage)
+        text = metrics.render_prometheus()
+    assert "tpusnap_store_logical_bytes" in text
+    assert "tpusnap_store_physical_bytes" in text
+    assert 'tenant="_total"' in text
+
+
+# ---------------------------------------------------------------- migration
+
+
+def test_repack_into_store_migrates_and_restores(tmp_path):
+    root = tmp_path / "legacy"
+    with knobs.override_cas(True):
+        mgr = SnapshotManager(str(root), max_to_keep=10)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+    assert (root / "cas").exists()
+    store = tmp_path / "store"
+    stats = store_mod.repack_into_store(str(root), str(store))
+    assert stats["steps"] == 2 and stats["chunks_copied"] >= 1
+    assert stats["local_chunks_removed"] >= 1
+    # Commit point: pointer durably written, local chunks gone, chunks in
+    # the store, restore resolves store-first.
+    storage = url_to_storage_plugin(str(root))
+    try:
+        assert store_mod.read_store_pointer(storage) == str(store)
+    finally:
+        storage.sync_close()
+    assert _chunks(store)
+    dst = _zeros()
+    SnapshotManager(str(root), max_to_keep=10).restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 2.0
+    # Migrated roots participate in the sweep's referenced set.
+    cls = store_mod.chunk_classification(str(store))
+    assert cls["orphan"] == []
+
+
+def test_repack_into_store_refuses_foreign_sweep(tmp_path):
+    root = tmp_path / "legacy"
+    with knobs.override_cas(True):
+        SnapshotManager(str(root), max_to_keep=10).save(1, _state(1))
+    store = tmp_path / "store"
+    storage = _store_plugin(store)
+    try:
+        store_mod._write_json(
+            storage,
+            store_mod.SWEEP_LEASE_FNAME,
+            {
+                "host": "elsewhere",
+                "pid": 1,
+                "phase": "condemn",
+                "epoch": 1,
+                "stamp": time.time(),
+            },
+        )
+    finally:
+        storage.sync_close()
+    with pytest.raises(store_mod.StoreSweepBusyError):
+        store_mod.repack_into_store(str(root), str(store))
+    # Migration never reached the commit point: root still fully local.
+    storage = url_to_storage_plugin(str(root))
+    try:
+        assert store_mod.read_store_pointer(storage) is None
+    finally:
+        storage.sync_close()
+    dst = _zeros()
+    SnapshotManager(str(root), max_to_keep=10).restore_latest(dst)
+    assert float(dst["m"]["w"][0]) == 1.0
+
+
+# -------------------------------------------------------------- manager gc
+
+
+def test_manager_gc_routes_store_sweep(tmp_path):
+    store = tmp_path / "store"
+    ma = _mgr(tmp_path / "ra", store)
+    ma.save(1, _state(1))
+    orphan = "cas/xxh64/de/deadbeef"
+    storage = _store_plugin(store)
+    try:
+        storage.sync_write(WriteIO(path=orphan, buf=b"junk", durable=True))
+    finally:
+        storage.sync_close()
+    # Dry run surfaces the store-side orphan as a chunk candidate.
+    _, chunks, _ = ma.gc_detail(apply=False)
+    assert orphan in chunks
+    with knobs.override_store_quarantine_s(0.0):
+        _, swept, _ = ma.gc_detail(apply=True)
+        assert orphan in swept
+        # Condemned this apply; a second apply (grace 0) deletes it.
+        ma.gc_detail(apply=True)
+    assert orphan not in _chunks(store)
+    storage = _store_plugin(store)
+    try:
+        assert orphan not in store_mod.quarantined_chunk_relpaths(storage)
+    finally:
+        storage.sync_close()

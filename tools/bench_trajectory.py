@@ -195,6 +195,24 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Two-tenant shared-store dedup (logical bytes / physical bytes
+        # store-wide, >1 = cross-tenant sharing works): the multi-tenant
+        # store's acceptance number.  Its own gated series so a change
+        # that silently stops tenants from sharing backbone chunks
+        # (ratio → ~1.0) fails the trajectory gate.
+        store_probe = aux.get("store_probe") or {}
+        store_dedup = store_probe.get("dedup_ratio")
+        if isinstance(store_dedup, (int, float)):
+            records.append(
+                {
+                    "series": f"{bank}:store_two_tenant_dedup:{backend}",
+                    "round": rnd,
+                    "value": float(store_dedup),
+                    "unit": "logical/physical",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
     return records
 
 

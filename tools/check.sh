@@ -90,6 +90,16 @@ step "serving-plane tracing smoke (trace/access-log schema + fleet stitch)"
 timeout -k 10 600 python -m pytest tests/test_peer_trace.py -q \
   -p no:cacheprovider || fail=1
 
+# Shared-store chaos smoke: a writer SIGKILLed mid-take against the
+# multi-tenant store must leave only debris a surviving tenant's sweep
+# can reclaim — ledger/lease/quarantine invariants hold and the survivor
+# still restores.  Also part of tier-1 above; its own gate line so a
+# store-GC regression is visible by name.
+step "shared-store chaos smoke (kill mid-take, survivor sweeps debris)"
+timeout -k 10 300 python -m pytest \
+  tests/test_store_chaos.py::test_kill_mid_take_debris_swept_by_survivor -q \
+  -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

@@ -176,6 +176,38 @@ def _journal_line(md) -> str:
     )
 
 
+def _resolve_store_url(path: str):
+    """Shared-store URL a path participates in, if any: the
+    ``TPUSNAP_STORE`` knob wins, else the ``.store`` pointer at ``path``
+    (a manager root) or — for a snapshot/segment path — at its parent."""
+    from . import knobs
+    from . import store as store_mod
+    from .storage_plugin import url_to_storage_plugin
+
+    store_url = knobs.get_store_url()
+    if store_url is not None:
+        return store_url
+    candidates = [path]
+    stripped = path.rstrip("/")
+    parent, _, _ = stripped.rpartition("/")
+    if parent:
+        candidates.append(parent)
+    for candidate in candidates:
+        try:
+            storage = url_to_storage_plugin(candidate)
+        except Exception:
+            continue
+        try:
+            store_url = store_mod.read_store_pointer(storage)
+        except Exception:
+            store_url = None
+        finally:
+            storage.sync_close()
+        if store_url is not None:
+            return store_url
+    return None
+
+
 def cmd_info(args: argparse.Namespace) -> int:
     from .manifest import ShardedArrayEntry
     from .snapshot import Snapshot
@@ -209,6 +241,9 @@ def cmd_info(args: argparse.Namespace) -> int:
     journal_line = _journal_line(md)
     if journal_line:
         print(journal_line)
+    store_url = _resolve_store_url(args.path)
+    if store_url is not None:
+        print(f"store:       shared CAS at {store_url}")
     return 0
 
 
@@ -286,6 +321,9 @@ def cmd_steps(args: argparse.Namespace) -> int:
         else:
             print(f"seg_{step} (journal delta){when}")
     print(f"latest: {points[-1][0]}")
+    store_url = _resolve_store_url(args.path)
+    if store_url is not None:
+        print(f"store: shared CAS at {store_url}")
     return 0
 
 
@@ -359,6 +397,17 @@ def cmd_gc(args: argparse.Namespace) -> int:
             print(
                 f"in-flight marker {doc['name']} "
                 f"(pid {doc.get('pid')} on {doc.get('host')})"
+            )
+        store_url = _resolve_store_url(args.path)
+        if store_url is not None:
+            from . import store as store_mod
+
+            cls = store_mod.chunk_classification(store_url)
+            print(
+                f"shared store {store_url}: "
+                f"{len(cls['referenced'])} referenced, "
+                f"{len(cls['orphan'])} orphan, "
+                f"{len(cls['condemned'])} condemned chunk(s) store-wide"
             )
     return 0
 
@@ -515,8 +564,11 @@ def cmd_repack(args: argparse.Namespace) -> int:
     payload once under ``<root>/cas/`` and rewrites manifests to digest
     references (version 0.4.0); ``--export`` materializes chunks back into
     each step (``chunks/<digest>``) so steps are self-contained and
-    portable again (``cp``-able, readable by pre-CAS tooling).  Run only
-    when no save is in flight."""
+    portable again (``cp``-able, readable by pre-CAS tooling);
+    ``--into-store`` migrates a CAS root's chunks into a shared
+    multi-tenant store (store.py) — durable per-step commit before the
+    local originals are deleted, refusing while a foreign sweep looks
+    live.  Run only when no save is in flight."""
     from .cas import repack_root
     from .snapshot import SNAPSHOT_METADATA_FNAME
     from .storage_plugin import url_to_storage_plugin
@@ -531,6 +583,25 @@ def cmd_repack(args: argparse.Namespace) -> int:
             return 2
     finally:
         storage.sync_close()
+    if args.into_store:
+        if args.export:
+            print("--into-store and --export are mutually exclusive")
+            return 2
+        from . import store as store_mod
+
+        try:
+            stats = store_mod.repack_into_store(args.path, args.into_store)
+        except store_mod.StoreSweepBusyError as e:
+            print(str(e))
+            return 3
+        print(
+            f"migrated {stats['steps']} step(s) into shared store "
+            f"{args.into_store}: {stats['chunks_copied']} chunk(s) copied "
+            f"({_human(stats['bytes_copied'])}), "
+            f"{stats['chunks_deduped']} already present (deduped), "
+            f"{stats['local_chunks_removed']} local chunk(s) removed"
+        )
+        return 0
     stats = repack_root(args.path, to_cas=not args.export)
     if args.export:
         print(
@@ -593,6 +664,35 @@ def cmd_stats(args: argparse.Namespace) -> int:
         for doc in docs:
             print(sidecar.summarize(doc))
         print(f"{len(docs)} operation(s) recorded")
+    store_url = _resolve_store_url(args.path)
+    if store_url is not None:
+        from . import store as store_mod
+
+        try:
+            usage = store_mod.tenant_usage(store_url)
+        except Exception as e:
+            print(f"shared store {store_url}: usage unavailable ({e})")
+            usage = None
+        if usage is not None:
+            # Publishing makes the per-tenant gauges visible to the
+            # --metrics exposition below.
+            store_mod.publish_usage_metrics(usage)
+            if not args.json:
+                ratio = usage.get("dedup_ratio")
+                print(
+                    f"shared store {store_url}: "
+                    f"{_human(usage['physical_bytes'])} physical across "
+                    f"{usage['chunks']} chunk(s), "
+                    f"{_human(usage['logical_bytes'])} logical"
+                    + (f", dedup {ratio}x" if ratio else "")
+                )
+                for tid, t in sorted(usage.get("tenants", {}).items()):
+                    print(
+                        f"  tenant {tid} ({t['root']}): "
+                        f"{_human(t['logical_bytes'])} logical, "
+                        f"{_human(t['exclusive_bytes'])} exclusive, "
+                        f"{t['chunks']} chunk(s)"
+                    )
     if args.metrics:
         # The in-process registry (populated if this CLI run itself took
         # metrics-enabled operations); mostly useful for embedding checks.
@@ -761,14 +861,51 @@ def cmd_top(args: argparse.Namespace) -> int:
         entries = fleet.collect(spool, stale_s=args.stale, sweep=False)
         print(fleet.render_prometheus(entries), end="")
         return 0
+    from . import knobs as _knobs
+
+    store_url = _knobs.get_store_url()
+
+    def _store_usage_lines():
+        """Shared-store quota view (TPUSNAP_STORE): one line per tenant."""
+        if store_url is None:
+            return []
+        from . import store as store_mod
+
+        try:
+            usage = store_mod.tenant_usage(store_url)
+        except Exception as e:
+            return [f"store {store_url}: usage unavailable ({e})"]
+        ratio = usage.get("dedup_ratio")
+        lines = [
+            f"store {store_url}: {_human(usage['physical_bytes'])} physical"
+            f" / {_human(usage['logical_bytes'])} logical"
+            + (f" (dedup {ratio}x)" if ratio else "")
+        ]
+        for tid, t in sorted(usage.get("tenants", {}).items()):
+            lines.append(
+                f"  tenant {tid}: {_human(t['logical_bytes'])} logical, "
+                f"{_human(t['exclusive_bytes'])} exclusive"
+            )
+        return lines
+
     if args.json:
         entries = fleet.collect(spool, stale_s=args.stale, sweep=False)
-        print(json.dumps(fleet.aggregate(entries), indent=1))
+        doc = fleet.aggregate(entries)
+        if store_url is not None:
+            from . import store as store_mod
+
+            try:
+                doc["store"] = store_mod.tenant_usage(store_url)
+            except Exception as e:
+                doc["store"] = {"error": str(e)}
+        print(json.dumps(doc, indent=1))
         return 0
     try:
         while True:
             entries = fleet.collect(spool, stale_s=args.stale)
             print(fleet.render(fleet.aggregate(entries), spool))
+            for line in _store_usage_lines():
+                print(line)
             if args.once:
                 return 0
             print()
@@ -1236,6 +1373,14 @@ def main(argv=None) -> int:
         action="store_true",
         help="materialize CAS chunks back into each step (self-contained, "
         "cp-able steps) instead of packing into cas/",
+    )
+    p.add_argument(
+        "--into-store",
+        default=None,
+        metavar="STORE_URL",
+        help="migrate the root's CAS chunks into a shared multi-tenant "
+        "store (durable per-step commit before local originals are "
+        "deleted; refuses while a foreign sweep looks live)",
     )
     p.set_defaults(fn=cmd_repack)
 

@@ -728,11 +728,19 @@ class CASWriterPlugin(StoragePlugin):
         root: StoragePlugin,
         index: DigestIndex,
         algo: str,
+        store_ctx: Optional[Any] = None,
     ) -> None:
         self._inner = inner
         self._root = root
         self._index = index
         self._algo = algo
+        # Shared-store mode (store.py): per-writer liveness lease + the
+        # pre-commit reference-journal append ride this context; index
+        # hits additionally existence-probe (a FOREIGN root's sweep can
+        # invalidate keys this index still trusts).  ``_verified`` caches
+        # keys probed present this take — one probe per key per take.
+        self._store_ctx = store_ctx
+        self._verified: Set[str] = set()
         self._lock = threading.Lock()
         # path written this take → "cas://<algo>/<hex>" or "casx://..."
         self.relocations: Dict[str, str] = {}
@@ -957,7 +965,7 @@ class CASWriterPlugin(StoragePlugin):
         relocations/payloads themselves."""
         key = _digest_key(algo, hexdigest)
         relpath = chunk_relpath(algo, hexdigest)
-        if key in self._index:
+        if key in self._index and await self._index_hit_valid(key, relpath):
             # Referenced by a committed manifest (or written earlier this
             # take): the chunk is durable and immutable — pure dedup.
             with self._lock:
@@ -974,6 +982,7 @@ class CASWriterPlugin(StoragePlugin):
             # write.
             self._index.add(key)
             with self._lock:
+                self._verified.add(key)
                 self.adopted_chunks += 1
                 self.adopted_bytes += nbytes
                 self.dedup_hits += 1
@@ -1005,8 +1014,35 @@ class CASWriterPlugin(StoragePlugin):
             raise
         self._index.add(key)
         with self._lock:
+            self._verified.add(key)
             self.chunks_written += 1
             self.bytes_written += nbytes
+
+    async def _index_hit_valid(self, key: str, relpath: str) -> bool:
+        """Whether an index hit may be trusted without I/O.
+
+        Per-root mode: always — only this manager sweeps this root, and
+        its sweeps discard the keys they remove.  Shared-store mode: a
+        FOREIGN root's sweep can condemn a chunk this index still lists
+        (the persisted sidecar survives across processes), so the first
+        hit per key existence-probes the store; a miss discards the key
+        and the caller falls through to the verified-probe/write ladder,
+        re-writing durably instead of minting a dangling reference."""
+        if self._store_ctx is None:
+            return True
+        with self._lock:
+            if key in self._verified:
+                return True
+        try:
+            present = await self._root.exists(relpath)
+        except Exception:
+            present = False
+        if present:
+            with self._lock:
+                self._verified.add(key)
+            return True
+        self._index.discard(key)
+        return False
 
     async def _delete_if_mismatched(
         self, relpath: str, digest: str, executor
@@ -1093,6 +1129,11 @@ class CASWriterPlugin(StoragePlugin):
 
     async def close(self) -> None:
         self._emit_summary()
+        if self._store_ctx is not None:
+            # Ends the refreshed writer lease: from here the sweep's
+            # writer fence no longer waits on this take (its references
+            # are journaled/committed or it never committed at all).
+            self._store_ctx.close()
         try:
             await self._inner.close()
         finally:
@@ -1179,6 +1220,45 @@ def maybe_wrap_cas_writes(
             path,
         )
         return storage
+    store_url = knobs.get_store_url()
+    store_ctx = None
+    if store_url is not None:
+        from . import store as store_mod
+
+        # Shared multi-tenant store: chunks live under <store>/cas/, not
+        # the root.  The resolver deliberately has NO read fallback on
+        # the write side — a legacy per-root chunk that isn't in the
+        # store reads as a miss, so the writer re-writes it durably INTO
+        # the store (migration-by-rewrite).  Index hits are existence-
+        # revalidated (`_index_hit_valid`) because a foreign sweep may
+        # have removed a chunk this tenant's persisted sidecar still
+        # remembers.
+        root = store_mod.StoreResolver(
+            url_to_storage_plugin(store_url, storage_options)
+        )
+        if index is None:
+            tenant_root = url_to_storage_plugin(root_url, storage_options)
+            try:
+                index = load_or_seed_index(root_url, tenant_root, algo)
+            finally:
+                tenant_root.sync_close()
+        store_ctx = store_mod.StoreWriterContext(root, store_url, root_url)
+        store_ctx.start()
+        logger.debug(
+            "CAS writes enabled for %s (shared store %s, tenant root %s, "
+            "%d indexed digests)",
+            path,
+            store_url,
+            root_url,
+            len(index),
+        )
+        return CASWriterPlugin(
+            inner=storage,
+            root=root,
+            index=index,
+            algo=algo,
+            store_ctx=store_ctx,
+        )
     root = url_to_storage_plugin(root_url, storage_options)
     if index is None:
         # Resolve through the writer's own root plugin: one plugin (one
@@ -1204,6 +1284,7 @@ def maybe_wrap_cas_reads(
     store.  Knob-independent: reading a CAS snapshot must always work."""
     if not manifest_uses_cas(metadata.manifest):
         return storage
+    from . import knobs
     from .storage_plugin import url_to_storage_plugin
 
     root_url = parent_root_url(snapshot_path)
@@ -1215,6 +1296,23 @@ def maybe_wrap_cas_reads(
             "chunks (use 'tpusnap repack --export' before relocating one)"
         )
     root = url_to_storage_plugin(root_url, storage_options)
+    # Shared-store resolution ladder: explicit knob, else the root's
+    # durable `.store` pointer (written at first store-mode save /
+    # repack --into-store).  Chunks then resolve against the store with
+    # the tenant root as read fallback — a root mid-migration still
+    # serves its not-yet-repacked legacy chunks.
+    store_url = knobs.get_store_url()
+    if store_url is None:
+        from . import store as store_mod
+
+        store_url = store_mod.read_store_pointer(root)
+    if store_url is not None:
+        from . import store as store_mod
+
+        resolver = store_mod.StoreResolver(
+            url_to_storage_plugin(store_url, storage_options), fallback=root
+        )
+        return CASReaderPlugin(inner=storage, root=resolver)
     return CASReaderPlugin(inner=storage, root=root)
 
 
@@ -1237,33 +1335,43 @@ def apply_relocations(storage: StoragePlugin, entries: Dict[str, Any]) -> None:
     (every relocation recorded) and before the manifest is gathered /
     committed.  No-op when the storage stack has no CAS writer."""
     writer = find_writer(storage)
-    if writer is None or not writer.relocations:
+    if writer is None:
         return
-    from .manifest import iter_payload_entries
+    if writer.relocations or writer._store_ctx is not None:
+        from .manifest import iter_payload_entries
 
-    with writer._lock:
-        relocations = dict(writer.relocations)
-    rewritten = 0
-    for _, entry in iter_payload_entries(entries):
-        new_location = relocations.get(entry.location)
-        if new_location is not None:
-            entry.location = new_location
-            rewritten += 1
-        # Feed the streaming-delta map with every entry-level digest —
-        # including SLAB MEMBERS (location + byte_range + the member's
-        # own checksum, annotated by the write-time hash sinks).  This is
-        # what lets the next save's prestage pass resolve an unchanged
-        # small leaf to its committed slab sub-range without the manager
-        # ever re-seeding from manifests.
-        checksum = getattr(entry, "checksum", None)
-        if checksum and is_chunk_location(entry.location):
-            byte_range = getattr(entry, "byte_range", None)
-            writer._index.record_payload(
-                checksum,
-                entry.location,
-                tuple(byte_range) if byte_range else None,
-            )
-    logger.debug("CAS: rewrote %d manifest entry locations", rewritten)
+        with writer._lock:
+            relocations = dict(writer.relocations)
+        rewritten = 0
+        for _, entry in iter_payload_entries(entries):
+            new_location = relocations.get(entry.location)
+            if new_location is not None:
+                entry.location = new_location
+                rewritten += 1
+            # Feed the streaming-delta map with every entry-level digest —
+            # including SLAB MEMBERS (location + byte_range + the member's
+            # own checksum, annotated by the write-time hash sinks).  This
+            # is what lets the next save's prestage pass resolve an
+            # unchanged small leaf to its committed slab sub-range without
+            # the manager ever re-seeding from manifests.
+            checksum = getattr(entry, "checksum", None)
+            if checksum and is_chunk_location(entry.location):
+                byte_range = getattr(entry, "byte_range", None)
+                writer._index.record_payload(
+                    checksum,
+                    entry.location,
+                    tuple(byte_range) if byte_range else None,
+                )
+        logger.debug("CAS: rewrote %d manifest entry locations", rewritten)
+    if writer._store_ctx is not None:
+        # Journal every chunk this take's manifest will reference BEFORE
+        # the commit marker lands.  The append must cover prestage-only
+        # takes too (zero relocations, every leaf resolved to an already-
+        # committed chunk) — those dedup decisions are exactly what the
+        # sweep's ledger check protects through the commit window.
+        refs = referenced_chunk_relpaths(entries)
+        if refs:
+            writer._store_ctx.append_refs(refs)
 
 
 def writer_stats(storage: StoragePlugin) -> Optional[Dict[str, int]]:
@@ -1355,6 +1463,30 @@ def prestage_delta_skip(
     probed = 0
     record_checksums = integrity.save_checksums_enabled()
 
+    def _store_hit_valid(location: str) -> bool:
+        # Foreign-sweep guard (shared-store mode only): a payload-map hit
+        # may reference chunks another tenant's sweep removed since this
+        # index was persisted.  Existence-probe each chunk once per take
+        # (the writer's _verified cache); a miss discards the stale keys
+        # so the leaf re-enters the write pipeline and lands durable.
+        for rel in chunk_relpaths_of_location(location):
+            key = key_for_relpath(rel)
+            if key is None:
+                continue
+            with writer._lock:
+                if key in writer._verified:
+                    continue
+            try:
+                present = writer._root.sync_exists(rel)
+            except Exception:
+                present = False
+            if not present:
+                index.discard(key)
+                return False
+            with writer._lock:
+                writer._verified.add(key)
+        return True
+
     def _apply(wr, res) -> None:
         nonlocal hits, hit_bytes, probed
         if res is None:
@@ -1363,6 +1495,9 @@ def prestage_delta_skip(
         entry, digest, nbytes = res
         probed += 1
         hit = index.lookup_payload(digest)
+        if hit is not None and writer._store_ctx is not None:
+            if not _store_hit_valid(hit[0]):
+                hit = index.lookup_payload(digest)  # keys gone -> None
         if hit is None:
             writer.note_prestaged(wr.path, digest, nbytes)
             kept.append(wr)

@@ -14,6 +14,11 @@ the inner plugin sees it).  Spec grammar::
     spec  := rule (";" rule)*             # "none" = no rules (wrapper only)
     rule  := op ":" when ":" kind [":" param] ["@" glob]
     op    := write | read | delete | delete_dir | list | exists | any | peer
+           | ledger   any storage op on a shared-store control path
+                      (ledger/, sweep/, tenants/, leases/, quarantine/) —
+                      the reference-journal appends, lease stamps, epoch
+                      bumps, condemn markers, and quarantine moves of
+                      store.py, regardless of the underlying verb
     when  := N        fire on the Nth matching call only (1-based)
            | N+       fire on the Nth matching call and every one after
            | *        alias for 1+
@@ -46,6 +51,10 @@ Examples::
     TPUSNAP_FAULTS="write:1+:transient"          # every write fails
     TPUSNAP_FAULTS="write:1:torn:0.25@*.data"    # torn first payload write
     TPUSNAP_FAULTS="read:1:latency:0.2;read:3:terminal"
+    TPUSNAP_FAULTS="delete:1:transient@cas/*"    # 1st chunk removal fails
+    TPUSNAP_FAULTS="ledger:1:terminal@ledger/*"  # 1st ref-journal append
+    TPUSNAP_FAULTS="ledger:2:crash"              # die at the 2nd store
+                                                 # control-plane op
     TPUSNAP_FAULTS="none"                        # wrapper installed, no
                                                  # faults (overhead probe)
 """
@@ -66,9 +75,30 @@ from .telemetry import metrics as tmetrics
 logger = logging.getLogger(__name__)
 
 _OPS = frozenset(
-    {"write", "read", "delete", "delete_dir", "list", "exists", "any", "peer"}
+    {
+        "write",
+        "read",
+        "delete",
+        "delete_dir",
+        "list",
+        "exists",
+        "any",
+        "peer",
+        "ledger",
+    }
 )
 _KINDS = frozenset({"transient", "terminal", "latency", "torn", "crash"})
+# Shared-store (store.py) control-plane namespaces: a rule with op=ledger
+# matches ANY storage verb whose path lives under one of these — the
+# reference-journal appends, writer/sweep lease stamps, epoch bumps,
+# condemn markers, and quarantine moves a sweep crash window lives in.
+_LEDGER_PREFIXES = (
+    "ledger/",
+    "sweep/",
+    "tenants/",
+    "leases/",
+    "quarantine/",
+)
 # Peer-side kinds fire in the peer HTTP *client* (peer.PeerClient builds
 # its own injector from the same spec), never in the storage wrapper: a
 # peer fault's blast radius is one candidate fetch, and the observable
@@ -184,6 +214,17 @@ class FaultRule:
 
     def matches_path(self, path: str) -> bool:
         return self.path_glob is None or fnmatch.fnmatch(path, self.path_glob)
+
+    def matches(self, op: str, path: str) -> bool:
+        """Whether this rule applies to a (storage verb, path) call.  An
+        ``op=ledger`` rule matches on the PATH — any verb touching a
+        shared-store control namespace — composing with the glob as a
+        further restriction."""
+        if self.op == "ledger":
+            return path.startswith(_LEDGER_PREFIXES) and self.matches_path(
+                path
+            )
+        return self.matches_op(op) and self.matches_path(path)
 
 
 def parse_fault_spec(spec: str) -> List[FaultRule]:
@@ -303,7 +344,7 @@ class FaultyStoragePlugin(StoragePlugin):
         fired: Optional[FaultRule] = None
         with self._lock:
             for i, rule in enumerate(self._rules):
-                if not (rule.matches_op(op) and rule.matches_path(path)):
+                if not rule.matches(op, path):
                     continue
                 self._counts[i] += 1
                 n = self._counts[i]

@@ -17,6 +17,13 @@ Layout (all under the ``SnapshotManager`` root, siblings of ``step_N``):
       seg_N/.snapshot_metadata       # delta segment for training step N
       seg_N/telemetry/...            # per-op sidecars, as for steps
 
+In shared-store mode (``TPUSNAP_STORE`` / ``SnapshotManager(store=...)``,
+store.py) the ``cas/`` tree lives under the store instead and the root
+carries a durable ``.store`` pointer; segment manifests are unchanged —
+``cas://`` references are location-independent — and chunk reclamation
+for folded segments routes through the store's ledger-fenced two-phase
+sweep rather than the per-root refcount sweep.
+
 A segment is produced by a normal (CAS-mode) take whose manifest is
 filtered down at commit time to the entries whose serialized form changed
 since the prior merged view (``compute_delta``), plus a ``journal`` block

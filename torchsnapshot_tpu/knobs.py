@@ -95,6 +95,11 @@ PEER_TRACE_FLUSH_S_ENV_VAR = _ENV_PREFIX + "PEER_TRACE_FLUSH_S"
 PEER_DEMOTE_FACTOR_ENV_VAR = _ENV_PREFIX + "PEER_DEMOTE_FACTOR"
 PEERD_ACCESS_LOG_ENV_VAR = _ENV_PREFIX + "PEERD_ACCESS_LOG"
 PEERD_ACCESS_LOG_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "PEERD_ACCESS_LOG_MAX_BYTES"
+# Shared multi-tenant chunk store (store.py) — distinct from STORE_ADDR /
+# STORE_PATH above, which bootstrap the KV *coordination* store
+# (dist_store.py).  TPUSNAP_STORE points at chunk storage shared by roots.
+STORE_ENV_VAR = _ENV_PREFIX + "STORE"
+STORE_QUARANTINE_S_ENV_VAR = _ENV_PREFIX + "STORE_QUARANTINE_S"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -1000,6 +1005,48 @@ def override_lease_grace_s(value: float) -> Generator[None, None, None]:
 @contextmanager
 def override_save_deadline_s(value: float) -> Generator[None, None, None]:
     with _override_env(SAVE_DEADLINE_S_ENV_VAR, str(value)):
+        yield
+
+
+# Shared multi-tenant chunk store (store.py).  The quarantine grace is the
+# window between a sweep's condemn phase (orphan chunks moved into
+# <store>/quarantine/<epoch>/) and its delete phase: long enough that a
+# concurrent take which deduped against a chunk mid-condemnation has
+# committed (making the chunk re-referenced, so the delete phase restores
+# it) or has re-written the chunk durably via the normal miss path.
+_DEFAULT_STORE_QUARANTINE_S = 60.0
+
+
+def get_store_url() -> Optional[str]:
+    """Shared chunk-store root (TPUSNAP_STORE): when set, CAS-mode saves
+    write chunks to ``<store>/cas/<algo>/<digest[:2]>/<digest>`` instead of
+    the manager root's own ``cas/``, and GC becomes the ledger-fenced
+    two-phase store sweep (store.py).  None = per-root CAS (the default)."""
+    val = os.environ.get(STORE_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_store_quarantine_s() -> float:
+    """Seconds a condemned chunk sits in ``<store>/quarantine/<epoch>/``
+    before the sweep's delete phase may remove it (after re-checking the
+    store-wide referenced set).  0 = delete eligible immediately, which is
+    only safe when no concurrent writers exist (tests, single-tenant
+    migration)."""
+    val = os.environ.get(STORE_QUARANTINE_S_ENV_VAR)
+    return (
+        max(0.0, float(val)) if val is not None else _DEFAULT_STORE_QUARANTINE_S
+    )
+
+
+@contextmanager
+def override_store(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(STORE_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_store_quarantine_s(value: float) -> Generator[None, None, None]:
+    with _override_env(STORE_QUARANTINE_S_ENV_VAR, str(value)):
         yield
 
 

@@ -32,6 +32,7 @@ from . import cas as cas_mod
 from . import journal as journal_mod
 from . import knobs
 from . import retry
+from . import store as store_mod
 from .event import Event
 from .event_handlers import log_event
 from .io_types import WriteIO
@@ -69,12 +70,20 @@ class SnapshotManager:
         max_to_keep: Optional[int] = None,
         pg: Optional[PGWrapper] = None,
         journal: Optional[bool] = None,
+        store: Optional[str] = None,
     ) -> None:
         """``journal``: delta-journal mode (journal.py) — each save appends
         a segment of only the changed entries, compacted into full steps in
         the background.  ``None`` (default) follows ``TPUSNAP_JOURNAL``.
         Requires the native xxh64 library (change detection is digest-
-        based); without it saves degrade to full snapshots with a warning."""
+        based); without it saves degrade to full snapshots with a warning.
+
+        ``store``: shared multi-tenant chunk store URL (store.py) — saves
+        force content addressing on and land chunks under
+        ``<store>/cas/`` instead of ``<root>/cas/``, deduplicating across
+        every root sharing the store.  ``None`` (default) follows
+        ``TPUSNAP_STORE``, then the root's durable ``.store`` pointer (a
+        root that once joined a store keeps resolving against it)."""
         if max_to_keep is not None and max_to_keep < 1:
             raise ValueError("max_to_keep must be >= 1")
         self.root = root.rstrip("/")
@@ -82,6 +91,17 @@ class SnapshotManager:
         self._pg = pg or PGWrapper.from_jax()
         self._journal = journal
         self._journal_warned = False
+        self._store = store.rstrip("/") if store else None
+        self._store_resolved = store is not None
+        self._store_joined = False
+        # Per-save in-flight marker refresher threads (satellite: store-
+        # side lease stamps).  Keyed by (step, kind); each rewrites its
+        # marker's "stamp" at the lease interval so a reader anywhere can
+        # age-test liveness instead of host-local pid probing.
+        self._marker_lock = threading.Lock()
+        self._marker_threads: Dict[
+            Tuple[int, str], Tuple[threading.Event, threading.Thread]
+        ] = {}
         # Rank 0's journal bookkeeping (journal.JournalState), loaded
         # lazily from storage and maintained across saves/compactions.
         # _journal_lock serializes state capture (a save snapshotting the
@@ -142,6 +162,51 @@ class SnapshotManager:
 
     # ----------------------------------------------------------------- save
 
+    def _resolve_store_url(self) -> Optional[str]:
+        """The shared store URL this root saves into, or None: the
+        constructor param, else the ``TPUSNAP_STORE`` knob, else the
+        root's durable ``.store`` pointer.  Resolved once and cached."""
+        if not self._store_resolved:
+            self._store_resolved = True
+            url = knobs.get_store_url()
+            if url is None:
+                try:
+                    storage = url_to_storage_plugin(self.root)
+                    try:
+                        url = store_mod.read_store_pointer(storage)
+                    finally:
+                        storage.sync_close()
+                except Exception:
+                    url = None
+            self._store = url.rstrip("/") if url else None
+        return self._store
+
+    def _ensure_store_joined(self, store_url: str) -> None:
+        """Rank 0, once per manager: durably point the root at its store
+        (readers resolve chunks through the pointer with no knob set) and
+        register the tenant (what makes this root's manifests part of the
+        sweep's referenced set).  Best-effort — the take's writer context
+        re-registers, so a transient failure here costs nothing."""
+        if self._store_joined or self._pg.get_rank() != 0:
+            return
+        self._store_joined = True
+        try:
+            root_storage = url_to_storage_plugin(self.root)
+            try:
+                if store_mod.read_store_pointer(root_storage) != store_url:
+                    store_mod.write_store_pointer(root_storage, store_url)
+            finally:
+                root_storage.sync_close()
+            store_storage = url_to_storage_plugin(store_url)
+            try:
+                store_mod.register_tenant(store_storage, self.root)
+            finally:
+                store_storage.sync_close()
+        except Exception:
+            logger.warning(
+                "failed to join shared store %s", store_url, exc_info=True
+            )
+
     def save(
         self,
         step: int,
@@ -154,6 +219,19 @@ class SnapshotManager:
         latest committed snapshot instead of rewriting them (hard links on
         fs, server-side copies on object stores).  In journal mode the flag
         is moot — content addressing already dedups every unchanged byte."""
+        store_url = self._resolve_store_url()
+        if store_url is not None:
+            # Store mode forces content addressing on (chunks ARE the
+            # shared currency) and pins the store knob for the take's
+            # write-path wrapping — same pattern journal mode uses for
+            # override_cas.
+            self._ensure_store_joined(store_url)
+            with knobs.override_store(store_url), knobs.override_cas(True):
+                if self._journal_mode_active():
+                    return self._save_journal(step, app_state, replicated, async_)
+                return self._save_full(
+                    step, app_state, replicated, async_, incremental
+                )
         if self._journal_mode_active():
             return self._save_journal(step, app_state, replicated, async_)
         return self._save_full(step, app_state, replicated, async_, incremental)
@@ -751,35 +829,71 @@ class SnapshotManager:
         """Advisory in-flight marker for the gc/prune guard.  Rank 0,
         best-effort on BOTH ends: a save must never fail (or fault-retry)
         over its marker, so failures are swallowed — a missing marker just
-        means no guard for that save."""
+        means no guard for that save.
+
+        The marker carries a ``stamp`` a refresher thread rewrites at the
+        lease interval while the save runs — store-side liveness a reader
+        on ANY host can age-test.  The legacy pid/host fields stay for
+        same-host fast-path classification and stamp-less back-compat."""
         if self._pg.get_rank() != 0:
             return
         import json
 
-        try:
+        name = self._inflight_marker_name(step, kind)
+        doc = {
+            "step": step,
+            "kind": kind,
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "started": time.time(),
+            "stamp": time.time(),
+        }
+
+        def _write_once() -> None:
             storage = url_to_storage_plugin(self.root)
             try:
-                doc = {
-                    "step": step,
-                    "kind": kind,
-                    "pid": os.getpid(),
-                    "host": socket.gethostname(),
-                    "started": time.time(),
-                }
+                doc["stamp"] = time.time()
                 storage.sync_write(
-                    WriteIO(
-                        path=self._inflight_marker_name(step, kind),
-                        buf=json.dumps(doc).encode("utf-8"),
-                    )
+                    WriteIO(path=name, buf=json.dumps(doc).encode("utf-8"))
                 )
             finally:
                 storage.sync_close()
+
+        try:
+            _write_once()
         except Exception:
             logger.debug("in-flight marker write failed", exc_info=True)
+            return
+        stop = threading.Event()
+
+        def _refresh_loop() -> None:
+            interval = max(0.05, knobs.get_lease_interval_s())
+            while not stop.wait(interval):
+                try:
+                    _write_once()
+                except Exception:
+                    logger.debug(
+                        "in-flight marker refresh failed", exc_info=True
+                    )
+
+        thread = threading.Thread(
+            target=_refresh_loop,
+            daemon=True,
+            name=f"snap_inflight_{kind}_{step}",
+        )
+        with self._marker_lock:
+            self._marker_threads[(step, kind)] = (stop, thread)
+        thread.start()
 
     def _remove_inflight_marker(self, step: int, kind: str) -> None:
         if self._pg.get_rank() != 0:
             return
+        with self._marker_lock:
+            entry = self._marker_threads.pop((step, kind), None)
+        if entry is not None:
+            stop, thread = entry
+            stop.set()
+            thread.join(timeout=5.0)
         try:
             storage = url_to_storage_plugin(self.root)
             try:
@@ -830,26 +944,44 @@ class SnapshotManager:
             if own:
                 storage.sync_close()
 
+    def _marker_stale(self, storage, doc: Dict[str, Any]) -> bool:
+        """Whether an in-flight marker provably belongs to no live save.
+        Primary signal (cross-host correct): the refreshed ``stamp`` —
+        expired means the writer stopped refreshing, wherever it ran, and
+        pid-number recycling can't fake liveness.  Fast paths: the target
+        committed, or the recorded pid is dead on THIS host (a dead pid
+        cannot be mid-save, no need to wait out the grace).  Markers
+        without a stamp (pre-stamp writers) keep only the legacy
+        heuristics — a remote one stays live forever, which is exactly
+        the conservatism ``force`` exists for."""
+        dirname = (
+            f"step_{doc['step']}"
+            if doc["kind"] == "step"
+            else journal_mod.segment_dirname(doc["step"])
+        )
+        try:
+            if storage.sync_exists(f"{dirname}/{SNAPSHOT_METADATA_FNAME}"):
+                return True
+        except Exception:
+            pass
+        if doc.get("host") == socket.gethostname() and not _pid_alive(
+            doc.get("pid")
+        ):
+            return True
+        stamp = doc.get("stamp")
+        if isinstance(stamp, (int, float)):
+            return time.time() - float(stamp) > store_mod._liveness_grace()
+        return False
+
     def _enforce_inflight_guard(self, storage, force: bool) -> None:
         """The gc-side half of the advisory lock: refuse destructive GC
         while a marker plausibly belongs to a live save.  Stale markers —
-        target already committed, or pid provably dead on this host — are
-        cleaned and ignored; anything else raises unless ``force``."""
+        target committed, refresher stamp expired, or pid provably dead
+        on this host — are cleaned and ignored; anything else raises
+        unless ``force``."""
         blocking: List[str] = []
         for doc in self.inflight_markers(storage=storage):
-            dirname = (
-                f"step_{doc['step']}"
-                if doc["kind"] == "step"
-                else journal_mod.segment_dirname(doc["step"])
-            )
-            try:
-                committed = storage.sync_exists(
-                    f"{dirname}/{SNAPSHOT_METADATA_FNAME}"
-                )
-            except Exception:
-                committed = False
-            local = doc.get("host") == socket.gethostname()
-            if committed or (local and not _pid_alive(doc.get("pid"))):
+            if self._marker_stale(storage, doc):
                 try:
                     storage.sync_delete(doc["name"])
                 except Exception:
@@ -1249,6 +1381,7 @@ class SnapshotManager:
                     chunks = []
             finally:
                 storage.sync_close()
+            chunks = chunks + self._store_sweep(apply=False, force=force)
             return orphans, chunks, sorted(orphan_segs)
         storage = url_to_storage_plugin(self.root)
         try:
@@ -1322,9 +1455,43 @@ class SnapshotManager:
             # an index (without one, staleness self-detects on load).
             if removed_segs and self._digest_index is not None:
                 self._persist_digest_index(storage)
+            # Shared-store half: the fleet-level two-phase sweep (condemn
+            # unreferenced chunks into quarantine, delete past-grace
+            # epochs).  The per-root sweep above only ever sees
+            # <root>/cas/ — legacy chunks of a partially-migrated root.
+            store_swept = self._store_sweep(apply=True, force=force)
+            if store_swept:
+                self._sync_index_after_sweep(storage, store_swept)
+                swept = swept + store_swept
         finally:
             storage.sync_close()
         return orphans, swept, sorted(removed_segs)
+
+    def _store_sweep(self, apply: bool, force: bool) -> List[str]:
+        """Run the shared store's two-phase sweep when this root is
+        store-backed; returns the chunk relpaths condemned/deleted (or,
+        dry-run, condemnable).  A live foreign sweep makes this a no-op —
+        one sweeper at a time; the other tenant's sweep covers the store."""
+        store_url = self._resolve_store_url()
+        if store_url is None:
+            return []
+        try:
+            report = store_mod.sweep(store_url, apply=apply, force=force)
+        except store_mod.StoreSweepBusyError:
+            logger.info(
+                "store sweep skipped: another tenant's sweep of %s looks "
+                "live",
+                store_url,
+            )
+            return []
+        except Exception:
+            logger.warning(
+                "shared-store sweep of %s failed; chunks remain gc-able",
+                store_url,
+                exc_info=True,
+            )
+            return []
+        return sorted(set(report["condemned"]) | set(report["deleted"]))
 
     # -------------------------------------------------------------- chunk gc
 
@@ -1403,6 +1570,45 @@ class SnapshotManager:
         in-flight marker from ANOTHER process defers the sweep entirely
         (its uncommitted take may have dedup-hit a candidate); the
         requeued candidates sweep at the next trigger."""
+        store_url = self._resolve_store_url()
+        if store_url is not None:
+            # Store-backed root: candidates live under <store>/cas/, and
+            # reclamation is the fleet-level two-phase sweep restricted to
+            # them — condemnation quarantines rather than deletes, so a
+            # sibling tenant's in-flight dedup hit is resurrectable.  A
+            # busy store (foreign sweep live) re-queues the candidates.
+            try:
+                report = store_mod.sweep(store_url, candidates=candidates)
+                swept_keys = sorted(
+                    set(report["condemned"]) | set(report["deleted"])
+                )
+                if swept_keys:
+                    try:
+                        storage = url_to_storage_plugin(self.root)
+                        try:
+                            self._sync_index_after_sweep(storage, swept_keys)
+                        finally:
+                            storage.sync_close()
+                    except Exception:
+                        logger.debug(
+                            "index sync after store sweep failed",
+                            exc_info=True,
+                        )
+            except store_mod.StoreSweepBusyError:
+                logger.info(
+                    "store chunk sweep deferred: another tenant's sweep of "
+                    "%s looks live",
+                    store_url,
+                )
+                with self._chunk_gc_lock:
+                    self._deferred_chunk_candidates |= candidates
+            except Exception:
+                logger.warning(
+                    "store chunk reclamation failed; orphan chunks remain "
+                    "GC-able (python -m torchsnapshot_tpu gc)",
+                    exc_info=True,
+                )
+            return
         try:
             storage = url_to_storage_plugin(self.root)
             try:
@@ -1444,24 +1650,15 @@ class SnapshotManager:
 
     def _foreign_inflight(self, storage) -> bool:
         """Whether a live-looking in-flight marker from ANOTHER process
-        exists: target uncommitted and not provably dead (different host,
-        or a live pid that isn't ours)."""
+        exists: target uncommitted and not provably stale (refresher
+        stamp fresh, or a stamp-less marker not provably dead on this
+        host)."""
         me = (socket.gethostname(), os.getpid())
         for doc in self.inflight_markers(storage=storage):
-            dirname = (
-                f"step_{doc['step']}"
-                if doc["kind"] == "step"
-                else journal_mod.segment_dirname(doc["step"])
-            )
-            try:
-                if storage.sync_exists(f"{dirname}/{SNAPSHOT_METADATA_FNAME}"):
-                    continue  # committed: stale marker
-            except Exception:
-                pass
             if (doc.get("host"), doc.get("pid")) == me:
                 continue  # our own save; the deferred-sweep counter covers it
-            if doc.get("host") == me[0] and not _pid_alive(doc.get("pid")):
-                continue  # same host, dead pid: a crashed save's leftover
+            if self._marker_stale(storage, doc):
+                continue
             return True
         return False
 

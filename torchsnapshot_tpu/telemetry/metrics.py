@@ -407,6 +407,37 @@ def record_fault(op: str, kind: str) -> None:
     ).inc(op=op, kind=kind)
 
 
+def record_store_usage(tenant: str, logical: int, exclusive: int) -> None:
+    """One tenant's quota accounting against the shared chunk store
+    (store.tenant_usage): logical bytes its manifests reference vs the
+    physical bytes only it references (what deleting it would reclaim)."""
+    if not enabled():
+        return
+    gauge(
+        "tpusnap_store_logical_bytes",
+        "Bytes a tenant's committed manifests reference in the shared store",
+    ).set(logical, tenant=tenant)
+    gauge(
+        "tpusnap_store_physical_bytes",
+        "Physical store bytes attributable exclusively to a tenant",
+    ).set(exclusive, tenant=tenant)
+
+
+def record_store_totals(logical: int, physical: int) -> None:
+    """Store-wide totals: the logical/physical gap IS the cross-tenant
+    dedup win."""
+    if not enabled():
+        return
+    gauge(
+        "tpusnap_store_logical_bytes",
+        "Bytes a tenant's committed manifests reference in the shared store",
+    ).set(logical, tenant="_total")
+    gauge(
+        "tpusnap_store_physical_bytes",
+        "Physical store bytes attributable exclusively to a tenant",
+    ).set(physical, tenant="_total")
+
+
 def record_cas_dedup(hits: int, bytes_saved: int) -> None:
     """Content-addressed dedup outcome of one take (cas.py): payload
     writes satisfied by an existing chunk, and the logical bytes those
@@ -760,6 +791,7 @@ DIRECT_METRIC_EVENTS = frozenset(
         "peer.reject",  # record_peer_reject
         "peer.demoted",  # record_peer_demoted
         "rollout.wave",  # record_rollout_wave
+        "store.sweep",  # record_gc("chunk_condemned"/"chunk_restored"/...)
     }
 )
 
