@@ -915,6 +915,59 @@ def main() -> None:
             )
         _PARTIAL["banked"]["sync"]["compress_scale_probe"] = compress_scale_probe
 
+    # --- blackbox flight-recorder probe (--blackbox): calibrated cost ---
+    # One extra save with TPUSNAP_BLACKBOX pointed at a scratch ring, then
+    # the recorder's own estimate-by-parts calibration (per-record pwrite
+    # cost on a scratch ring x records the save actually spilled) against
+    # that save's wall.  The acceptance bar is overhead_below_1pct — the
+    # always-on forensics budget from docs/observability.md — and
+    # records_per_s is banked as its own gated trajectory series so a
+    # change that makes the spill path slow (sync, fsync, lock contention)
+    # fails tools/bench_trajectory.py like any throughput loss.
+    blackbox_probe = None
+    if "--blackbox" in argv:
+        _PARTIAL["phase"] = "blackbox_probe"
+        if _watchdog_remaining_s() > save_s + 60:
+            from torchsnapshot_tpu.telemetry import blackbox as _blackbox
+
+            bb_dir = os.path.join(workdir, "blackbox")
+            bb_path = os.path.join(workdir, "snap_blackbox")
+            shutil.rmtree(bb_path, ignore_errors=True)
+            _drain_writeback()
+            with _knobs.override_blackbox_dir(bb_dir):
+                t0 = time.monotonic()
+                Snapshot.take(bb_path, app_state)
+                bb_wall_s = time.monotonic() - t0
+                cal = _blackbox.calibrated_overhead_s(samples=500)
+            shutil.rmtree(bb_path, ignore_errors=True)
+            bb_records = int(cal["records"])
+            bb_overhead_s = cal["estimated_s"]
+            blackbox_probe = {
+                "records": bb_records,
+                "per_record_s": round(cal["per_record_s"], 9),
+                "records_per_s": round(1.0 / cal["per_record_s"], 1)
+                if cal["per_record_s"] > 0
+                else None,
+                "overhead_s": round(bb_overhead_s, 6),
+                "op_wall_s": round(bb_wall_s, 3),
+                "overhead_frac_of_wall": round(bb_overhead_s / bb_wall_s, 6)
+                if bb_wall_s > 0
+                else 0.0,
+                # THE acceptance bar: always-on forensics must cost less
+                # than 1% of the op it is recording.
+                "overhead_below_1pct": bb_overhead_s < 0.01 * bb_wall_s,
+            }
+            log(
+                f"blackbox probe: {bb_records} records @ "
+                f"{cal['per_record_s'] * 1e6:.1f} us -> "
+                f"{bb_overhead_s * 1e3:.2f} ms of {bb_wall_s:.2f}s save "
+                f"({blackbox_probe['overhead_frac_of_wall'] * 100:.3f}%, "
+                f"below_1pct={blackbox_probe['overhead_below_1pct']})"
+            )
+        else:
+            log("blackbox probe skipped: insufficient watchdog budget")
+        _PARTIAL["banked"]["sync"]["blackbox_probe"] = blackbox_probe
+
     # --- CAS dedup probe (--cas): content-addressed store economics ---
     # A 3-step simulated fine-tune — frozen backbone + churning optimizer —
     # saved under TPUSNAP_CAS=1: physical chunk bytes written per step and
@@ -2245,6 +2298,7 @@ def main() -> None:
             "telemetry_sidecar": telemetry_sidecar,
             "compression_probe": compression_probe,
             "compress_scale_probe": compress_scale_probe,
+            "blackbox_probe": blackbox_probe,
             "cas_probe": cas_probe,
             "store_probe": store_probe,
             "journal_probe": journal_probe,

@@ -192,12 +192,13 @@ def test_sigkill_mid_take_fast(tmp_path):
     _native_or_skip()
     root = str(tmp_path / "ckpts")
     os.makedirs(root)
+    bb = str(tmp_path / "blackbox")
 
     # --- attempt 1: rank 1 is killed at its 5th chunk write -------------
     results = _launch(
         2,
         _take_body_factory(root),
-        env_common=_FAST_ENV,
+        env_common=dict(_FAST_ENV, TPUSNAP_BLACKBOX=bb),
         env_per_rank={1: {"TPUSNAP_FAULTS": "write:5:crash"}},
     )
     status0, survivor = results[0]
@@ -230,6 +231,30 @@ def test_sigkill_mid_take_fast(tmp_path):
     assert sorted(orphan) == present
     assert present, "the dead attempt should have left durable chunks"
 
+    # --- postmortem names the death exactly -----------------------------
+    from torchsnapshot_tpu.telemetry import postmortem
+
+    report = postmortem.analyze_root(root, blackbox_dir=bb)
+    assert report["classification"] == "killed_mid_take", report
+    fd = report["first_dead"]
+    assert fd is not None, report
+    assert fd["rank"] == 1, fd  # the victim, not the aborted survivor
+    assert fd["verdict"] == "crash_fault", fd
+    assert fd["op"] == "take", fd
+    # The fault record pins the injected kill point: the 5th chunk write.
+    assert fd["fault"]["op"] == "write", fd
+    assert fd["fault"]["path"].startswith("cas/"), fd
+    # Phase at death within one phase of the kill point (the chunk
+    # write itself, or the serialize-side phase right before it).
+    assert fd["phase_group"] in ("storage_io", "serialize"), fd
+    # The survivor's own conviction (peer_dead lease record) names the
+    # same rank postmortem found dead.
+    peer = report["implicated"]["peer"]
+    assert peer is not None and peer["rank"] == 1, report["implicated"]
+    assert any(
+        a["action"] == "gc" for a in report["remediation"]["actions"]
+    ), report["remediation"]
+
     # --- retry: adopt durable chunks, write only the missing bytes ------
     results = _launch(
         2,
@@ -252,6 +277,14 @@ def test_sigkill_mid_take_fast(tmp_path):
     mgr.gc(apply=True, force=True)
     assert mgr.orphan_steps() == []
     assert mgr.orphan_chunks() == []
+    # The prescribed remediation CONVERGED: a re-run postmortem finds no
+    # debris left and stops prescribing gc.
+    report = postmortem.analyze_root(root, blackbox_dir=bb)
+    assert report["debris"]["orphan_steps"] == [], report["debris"]
+    assert report["debris"]["orphan_chunks"] == [], report["debris"]
+    assert not any(
+        a["action"] == "gc" for a in report["remediation"]["actions"]
+    ), report["remediation"]
     dst = {
         k: type(v)({kk: np.zeros_like(vv) for kk, vv in v.items()})
         for k, v in _rank_state(0).items()
@@ -303,16 +336,21 @@ def test_sigkill_chaos_soak(tmp_path):
     from torchsnapshot_tpu.storage_plugin import url_to_storage_plugin
     import torchsnapshot_tpu.cas as cas_mod
 
+    from torchsnapshot_tpu.telemetry import postmortem
+
     for seed in range(3):
         root = str(tmp_path / f"ckpts_{seed}")
         os.makedirs(root)
         mgr = SnapshotManager(root)
-        for victim, spec, async_ in _kill_menu(seed):
-            # Fresh step dir per scenario so debris never aliases.
+        for scenario, (victim, spec, async_) in enumerate(_kill_menu(seed)):
+            # Fresh step dir per scenario so debris never aliases; fresh
+            # blackbox dir so the classifier judges THIS kill, not a
+            # previous scenario's rings.
+            bb = str(tmp_path / f"bb_{seed}_{scenario}")
             results = _launch(
                 2,
                 _take_body_factory(root, async_=async_),
-                env_common=_SOAK_ENV,
+                env_common=dict(_SOAK_ENV, TPUSNAP_BLACKBOX=bb),
                 env_per_rank={victim: {"TPUSNAP_FAULTS": spec}},
             )
             survivor_rank = 1 - victim
@@ -342,6 +380,18 @@ def test_sigkill_chaos_soak(tmp_path):
             finally:
                 storage.sync_close()
             assert sorted(referenced + orphan) == present, (seed, spec)
+            # Postmortem names every kill point in the menu correctly:
+            # the victim rank, by its pre-exit fault record.
+            report = postmortem.analyze_root(root, blackbox_dir=bb)
+            assert report["classification"] == "killed_mid_take", (
+                seed,
+                spec,
+                report["classification"],
+            )
+            fd = report["first_dead"]
+            assert fd is not None and fd["rank"] == victim, (seed, spec, fd)
+            assert fd["verdict"] == "crash_fault", (seed, spec, fd)
+            assert fd["op"] in ("take", "async_take"), (seed, spec, fd)
 
             # Clean retry: commits, adopts, restores bit-identical.
             results = _launch(

@@ -1,0 +1,356 @@
+"""Black-box flight recorder + ``tpusnap postmortem`` forensics.
+
+Covers the recorder's crash-survival contract (fixed-slot pwrite ring:
+bounded file, torn-slot tolerance, oversize truncation, fork/pid
+hygiene), the feeds (op start/end, phase transitions, event fan-out,
+pre-``os._exit`` fault records), the postmortem classifier end to end
+against a real injected kill (dead pid named, op and phase at death,
+remediation that converges when applied), the CLI surface, the
+calibrated-overhead bound, and the peer-daemon ServerTracer idle-flush
+regression.
+
+The check.sh postmortem smoke gate runs this file.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from torchsnapshot_tpu import StateDict, knobs
+from torchsnapshot_tpu.manager import SnapshotManager
+from torchsnapshot_tpu.telemetry import blackbox
+from torchsnapshot_tpu.telemetry import postmortem
+from torchsnapshot_tpu.telemetry import trace as ttrace
+
+
+def _native_or_skip():
+    from torchsnapshot_tpu._native.build import get_native_lib_path
+
+    if get_native_lib_path() is None:
+        pytest.skip("CAS digests require the native library")
+
+
+# ---------------------------------------------------------------- the ring
+
+
+def test_ring_write_read_roundtrip(tmp_path):
+    ring = blackbox.Ring(str(tmp_path / "bb"), slots=16, slot_bytes=256)
+    assert ring.record("event", "take.start", {"rank": 0})
+    assert ring.record("phase", "fs_write", {"nbytes": 123})
+    ring.close()
+    records = blackbox.read_ring(ring.path)
+    assert [r["name"] for r in records] == ["take.start", "fs_write"]
+    assert records[0]["data"] == {"rank": 0}
+    assert records[0]["pid"] == os.getpid()
+    assert records[1]["seq"] == 1
+
+
+def test_ring_wraps_bounded(tmp_path):
+    slots, slot_bytes = 8, 256
+    ring = blackbox.Ring(
+        str(tmp_path / "bb"), slots=slots, slot_bytes=slot_bytes
+    )
+    for i in range(50):
+        assert ring.record("event", f"e{i}")
+    ring.close()
+    # The file never grows past the ring; only the newest `slots` survive,
+    # in seq order.
+    assert os.path.getsize(ring.path) == slots * slot_bytes
+    records = blackbox.read_ring(ring.path)
+    assert len(records) == slots
+    assert [r["name"] for r in records] == [f"e{i}" for i in range(42, 50)]
+
+
+def test_ring_oversize_record_truncates_payload(tmp_path):
+    ring = blackbox.Ring(str(tmp_path / "bb"), slots=8, slot_bytes=256)
+    assert ring.record("event", "big", {"blob": "x" * 10_000})
+    ring.close()
+    (rec,) = blackbox.read_ring(ring.path)
+    # Envelope survives; the oversized payload is dropped, flagged.
+    assert rec["name"] == "big"
+    assert rec.get("trunc") is True
+    assert "blob" not in (rec.get("data") or {})
+
+
+def test_ring_tolerates_torn_slot(tmp_path):
+    ring = blackbox.Ring(str(tmp_path / "bb"), slots=8, slot_bytes=256)
+    for i in range(3):
+        ring.record("event", f"e{i}")
+    ring.close()
+    # Tear the middle slot the way a kill mid-pwrite would: garbage bytes,
+    # no valid JSON line.
+    with open(ring.path, "r+b") as f:
+        f.seek(1 * 256)
+        f.write(b"\x00garbage" + b" " * 100)
+    records = blackbox.read_ring(ring.path)
+    assert [r["name"] for r in records] == ["e0", "e2"]
+
+
+def test_ring_reader_skips_missing_dir(tmp_path):
+    assert blackbox.read_all(str(tmp_path / "nope")) == {}
+
+
+# ------------------------------------------------------------------- feeds
+
+
+def test_recorder_feeds_from_a_real_take(tmp_path):
+    bb = str(tmp_path / "bb")
+    root = str(tmp_path / "root")
+    state = {"m": StateDict({"w": np.arange(4096, dtype=np.float32)})}
+    with knobs.override_blackbox_dir(bb), knobs.override_sidecar(False):
+        SnapshotManager(root).save(0, state)
+    rings = blackbox.read_all(bb)
+    assert len(rings) == 1
+    (records,) = rings.values()
+    names = [(r["kind"], r["name"]) for r in records]
+    assert ("op", "take.start") in names
+    assert ("op", "take.end") in names
+    end = next(
+        r for r in records if r["kind"] == "op" and r["name"] == "take.end"
+    )
+    assert end["data"]["success"] is True
+    # Phase transitions ride along via the phase_stats observer hook.
+    assert any(k == "phase" for k, _ in names)
+
+
+def test_recorder_off_by_default(tmp_path):
+    root = str(tmp_path / "root")
+    state = {"m": StateDict({"w": np.arange(64, dtype=np.float32)})}
+    with knobs.override_sidecar(False):
+        SnapshotManager(root).save(0, state)
+    assert not glob.glob(os.path.join(root, "**", "*.ring"), recursive=True)
+
+
+def test_calibrated_overhead_is_tiny():
+    cal = blackbox.calibrated_overhead_s(samples=100)
+    # "records" is the LIVE process's record count (the scaling factor),
+    # not the calibration sample count.
+    assert cal["records"] >= 0.0
+    assert cal["estimated_s"] == pytest.approx(
+        cal["per_record_s"] * cal["records"]
+    )
+    # The acceptance budget is <1% of op wall; a single record costs
+    # microseconds, so anything near 1 ms/record means the hot path
+    # regressed to syncing or reopening.
+    assert cal["per_record_s"] < 1e-3
+
+
+# ----------------------------------------------------------- the classifier
+
+
+_CHILD_TAKE = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from torchsnapshot_tpu import StateDict
+from torchsnapshot_tpu.manager import SnapshotManager
+
+root = sys.argv[1]
+state = {"m": StateDict({"w": np.arange(1 << 18, dtype=np.float32)})}
+SnapshotManager(root).save(0, state)
+os._exit(7)  # never reached: the crash fault fires mid-take
+"""
+
+
+def _crash_child(root, bb, faults, extra_env=None):
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "TPUSNAP_FAULTS": faults,
+            "TPUSNAP_SIDECAR": "0",
+            "TPUSNAP_BLACKBOX": bb,
+            "TPUSNAP_CAS": "1",
+            "TPUSNAP_DISABLE_BATCHER": "1",
+        }
+    )
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD_TAKE, str(root)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, (
+        f"child should die on the crash fault, got {proc.returncode}: "
+        f"{proc.stderr[-2000:]}"
+    )
+
+
+def test_postmortem_names_mid_take_kill(tmp_path):
+    """The headline contract: a process killed mid-take is named — pid,
+    op, phase at death, the injected kill point — and the remediation
+    CONVERGES when applied."""
+    _native_or_skip()
+    root = str(tmp_path / "root")
+    bb = str(tmp_path / "bb")
+    _crash_child(root, bb, "write:1:crash@cas/*")
+
+    report = postmortem.analyze_root(root, blackbox_dir=bb)
+    assert report["classification"] == "killed_mid_take"
+    fd = report["first_dead"]
+    assert fd is not None
+    assert fd["verdict"] == "crash_fault"
+    assert fd["op"] == "take"
+    # The dead pid is the ring's pid — provably the crashed child, not us.
+    (ring_path,) = blackbox.read_all(bb).keys()
+    ring_pid = int(os.path.basename(ring_path).rsplit("-", 1)[1][: -len(".ring")])
+    assert fd["pid"] == ring_pid != os.getpid()
+    # Kill point: the fault record names the faulted write verbatim.
+    assert fd["fault"]["op"] == "write"
+    assert fd["fault"]["path"].startswith("cas/")
+    # Phase at death is within one phase of the kill point (the chunk
+    # write): the last completed interval is either the write itself or
+    # the serialize-side phase immediately before it.
+    assert fd["phase_group"] in ("storage_io", "serialize"), fd
+    # Debris + remediation: the crashed take left an in-flight marker (and
+    # possibly an orphan step dir); postmortem prescribes gc.
+    actions = {a["action"] for a in report["remediation"]["actions"]}
+    assert "gc" in actions
+
+    # Apply the prescription; the debris must converge to nothing.
+    mgr = SnapshotManager(root)
+    mgr.gc_detail(apply=True, force=True)
+    after = postmortem.analyze_root(root, blackbox_dir=bb)
+    assert after["debris"]["orphan_steps"] == []
+    assert after["debris"]["orphan_chunks"] == []
+    assert after["debris"]["inflight_markers"] == []
+    assert not any(
+        a["action"] == "gc" for a in after["remediation"]["actions"]
+    )
+
+
+def test_postmortem_clean_root_is_no_failure(tmp_path):
+    root = str(tmp_path / "root")
+    bb = str(tmp_path / "bb")
+    state = {"m": StateDict({"w": np.arange(256, dtype=np.float32)})}
+    with knobs.override_blackbox_dir(bb), knobs.override_sidecar(False):
+        SnapshotManager(root).save(0, state)
+    # Our own (live) ring shows a closed op: nothing died mid-work.
+    report = postmortem.analyze_root(root, blackbox_dir=bb)
+    assert report["classification"] == "no_failure"
+    assert report["first_dead"] is None
+    assert report["remediation"]["restore"]["committed_points"] == 1
+
+
+def test_postmortem_cli_json_and_perfetto(tmp_path):
+    _native_or_skip()
+    root = str(tmp_path / "root")
+    bb = str(tmp_path / "bb")
+    _crash_child(root, bb, "write:1:crash@cas/*")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "torchsnapshot_tpu",
+            "postmortem",
+            root,
+            "--blackbox",
+            bb,
+            "--json",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    doc = json.loads(out.stdout)
+    assert doc["classification"] == "killed_mid_take"
+    assert doc["first_dead"]["pid"] is not None
+    perfetto_path = str(tmp_path / "pm.json")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "torchsnapshot_tpu",
+            "postmortem",
+            root,
+            "--blackbox",
+            bb,
+            "--perfetto",
+            "--out",
+            perfetto_path,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    trace_doc = json.load(open(perfetto_path))
+    assert trace_doc["traceEvents"], "timeline must not be empty"
+    assert all("ts" in e for e in trace_doc["traceEvents"])
+
+
+def test_postmortem_heartbeat_enrichment(tmp_path):
+    """Satellite: the periodic heartbeat names the op kind, trace id, and
+    active phase — a frozen heartbeat alone places the death."""
+    from torchsnapshot_tpu.telemetry import monitor as tmonitor
+
+    hb = str(tmp_path / "hb.json")
+    with knobs.override_heartbeat_file(hb), knobs.override_sidecar(False):
+        mon = tmonitor.op_started("take", "feedbeef" * 4, rank=0)
+        try:
+            mon._write_heartbeat()
+        finally:
+            tmonitor.op_finished(mon, success=True)
+    doc = json.load(open(hb))
+    assert doc["op_kind"] == "take"
+    assert doc["phase"] is None or isinstance(doc["phase"], str)
+    assert "trace_id" in doc
+    # And postmortem folds it into the timeline.
+    report = postmortem.analyze_root(
+        str(tmp_path), heartbeat_path=hb, blackbox_dir=str(tmp_path / "bb")
+    )
+    assert any(
+        e["source"] == "heartbeat" for e in report["timeline"]
+    ), report["timeline"]
+
+
+# ------------------------------------------------- ServerTracer idle flush
+
+
+def test_server_tracer_flushes_while_idle(tmp_path):
+    """Regression: spans recorded after the last flush used to sit
+    invisible until the NEXT request arrived — a daemon that served one
+    burst and went idle never exposed it.  The background flusher must
+    land them within ~one flush interval with no further traffic."""
+    with knobs.override_peer_trace_flush_s(0.2):
+        tracer = ttrace.ServerTracer(str(tmp_path), "deadbeefcafe")
+        tracer.record_span("peerd_handle", 0.0, 1000.0, {"trace": "t1"})
+        # No further record_span calls: only the flusher can write this.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if os.path.exists(tracer.path):
+                break
+            time.sleep(0.05)
+        assert os.path.exists(tracer.path), (
+            "idle daemon never flushed its buffered span"
+        )
+        doc = json.load(open(tracer.path))
+        names = [e.get("name") for e in doc["traceEvents"]]
+        assert "peerd_handle" in names
+        tracer.close()
+        assert not tracer._flusher.is_alive()
+
+
+def test_server_tracer_flush_on_close(tmp_path):
+    with knobs.override_peer_trace_flush_s(3600.0):
+        tracer = ttrace.ServerTracer(str(tmp_path), "deadbeefcafe")
+        tracer.record_span("peerd_handle", 0.0, 1000.0, {"trace": "t1"})
+        # Interval far in the future: only close() can write it.
+        path = tracer.close()
+        assert path and os.path.exists(path)
+        doc = json.load(open(path))
+        assert any(
+            e.get("name") == "peerd_handle" for e in doc["traceEvents"]
+        )

@@ -184,7 +184,7 @@ os._exit(7)  # never reached: the crash fault fires mid-sweep
 """
 
 
-def _run_child(code, args, faults):
+def _run_child(code, args, faults, blackbox_dir=None):
     env = dict(os.environ)
     env.update(
         {
@@ -197,6 +197,8 @@ def _run_child(code, args, faults):
             "TPUSNAP_LEASE_INTERVAL_S": "9999",
         }
     )
+    if blackbox_dir is not None:
+        env["TPUSNAP_BLACKBOX"] = str(blackbox_dir)
     proc = subprocess.run(
         [sys.executable, "-c", code, *[str(a) for a in args]],
         env=env,
@@ -216,10 +218,37 @@ def test_kill_mid_take_debris_swept_by_survivor(tmp_path):
     the SURVIVING tenant's sweep condemns and deletes them."""
     store = tmp_path / "store"
     ra, rb = tmp_path / "ra", tmp_path / "rb"
+    bb = tmp_path / "bb"
     # Crash at the reference-journal append: every chunk is written (real
     # debris in the store) but neither the journal nor the commit marker
     # landed — the canonical crashed-writer window.
-    _run_child(_CHILD_TAKE, [ra, store], "ledger:1:crash@ledger/*")
+    _run_child(
+        _CHILD_TAKE, [ra, store], "ledger:1:crash@ledger/*", blackbox_dir=bb
+    )
+    # Postmortem names the dead writer from its flight-recorder ring: the
+    # kill lands mid-take (journal append), debited to the right tenant.
+    from torchsnapshot_tpu.telemetry import blackbox, postmortem
+
+    report = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert report["classification"] == "killed_mid_take", report
+    fd = report["first_dead"]
+    (ring_path,) = blackbox.read_all(str(bb)).keys()
+    ring_pid = int(
+        os.path.basename(ring_path).rsplit("-", 1)[1][: -len(".ring")]
+    )
+    assert fd["pid"] == ring_pid != os.getpid(), fd
+    assert fd["verdict"] == "crash_fault", fd
+    assert fd["fault"]["path"].startswith("ledger/"), fd
+    # The store plane pins the blast radius: the dead pid's writer lease
+    # (stale once the grace passes) and its orphan chunks, and the
+    # prescription is a store sweep.
+    assert report["store"]["chunks"]["orphan"] > 0, report["store"]
+    assert any(
+        a["action"] == "store_sweep"
+        for a in report["remediation"]["actions"]
+    ), report["remediation"]
     # Survivor saves normally against the same store.
     mb = SnapshotManager(str(rb), max_to_keep=10, store=str(store))
     mb.save(2, _state(2))
@@ -238,6 +267,14 @@ def test_kill_mid_take_debris_swept_by_survivor(tmp_path):
     removed, _, _ = ma.gc_detail(apply=True)
     assert removed in ([], [1])  # [] if the crash preceded the step dir
     assert ma.restore_points() == []
+    # The prescribed remediation converged: the store holds no orphan or
+    # quarantined chunks anymore, so postmortem stops reporting debris.
+    after = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert after["store"]["chunks"]["orphan"] == 0, after["store"]
+    assert after["store"]["quarantined"] == [], after["store"]
+    assert after["debris"]["orphan_steps"] == [], after["debris"]
 
 
 def test_kill_mid_sweep_lease_adopted(tmp_path):
@@ -251,7 +288,30 @@ def test_kill_mid_sweep_lease_adopted(tmp_path):
     # Touches of sweep/epoch.json in a sweep: report read, bump read,
     # bump WRITE — crashing on the third dies right after the lease
     # acquire, with the lease durably on storage.
-    _run_child(_CHILD_SWEEP, [store], "ledger:3:crash@sweep/epoch.json")
+    bb = tmp_path / "bb"
+    _run_child(
+        _CHILD_SWEEP,
+        [store],
+        "ledger:3:crash@sweep/epoch.json",
+        blackbox_dir=bb,
+    )
+    # Postmortem places the kill INSIDE the two-phase GC (fault on a
+    # sweep/ control path; store_sweep lease acquired, never released)
+    # and prescribes the adopting sweep the rest of this test performs.
+    from torchsnapshot_tpu.telemetry import postmortem
+
+    report = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert report["classification"] == "killed_mid_sweep", report
+    assert report["first_dead"]["verdict"] == "crash_fault", report
+    assert report["store"]["sweep_lease"] is not None, report["store"]
+    sweep_actions = [
+        a
+        for a in report["remediation"]["actions"]
+        if a["action"] == "store_sweep"
+    ]
+    assert sweep_actions and sweep_actions[0]["force"], report["remediation"]
     # The dead sweeper's lease is fresh for a grace: busy.
     with pytest.raises(store_mod.StoreSweepBusyError):
         store_mod.sweep(str(store))
@@ -263,6 +323,16 @@ def test_kill_mid_sweep_lease_adopted(tmp_path):
         assert report["adopted_lease"]
     _assert_store_invariants(store, [ra])
     assert _restore_ok(ra, store) == 1.0
+    # Adoption converged: the dead sweeper's lease is gone, so postmortem
+    # stops prescribing a sweep.
+    after = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert after["store"]["sweep_lease"] is None, after["store"]
+    assert not any(
+        a["action"] == "store_sweep"
+        for a in after["remediation"]["actions"]
+    ), after["remediation"]
 
 
 def test_kill_mid_condemn_quarantine_converges(tmp_path):
@@ -285,7 +355,26 @@ def test_kill_mid_condemn_quarantine_converges(tmp_path):
         storage.sync_close()
     # First quarantine write is the .condemned stamp; crashing on the
     # SECOND quarantine write dies between stamp and chunk move.
-    _run_child(_CHILD_SWEEP, [store], "ledger:2:crash@quarantine/*")
+    bb = tmp_path / "bb"
+    _run_child(
+        _CHILD_SWEEP, [store], "ledger:2:crash@quarantine/*", blackbox_dir=bb
+    )
+    # Postmortem distinguishes this kill window from mid-sweep: the fault
+    # landed on a quarantine/ path — between the condemn stamp and the
+    # chunk moves.
+    from torchsnapshot_tpu.telemetry import postmortem
+
+    report = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert report["classification"] == "killed_mid_condemn", report
+    assert report["first_dead"]["fault"]["path"].startswith(
+        "quarantine/"
+    ), report["first_dead"]
+    assert any(
+        a["action"] == "store_sweep" and a["force"]
+        for a in report["remediation"]["actions"]
+    ), report["remediation"]
     with knobs.override_lease_interval_s(0.05), knobs.override_lease_grace_s(
         0.3
     ), knobs.override_store_quarantine_s(0.0):
@@ -296,6 +385,12 @@ def test_kill_mid_condemn_quarantine_converges(tmp_path):
         # adopting sweep; nothing referenced was harmed.
         _assert_store_invariants(store, [ra])
     assert _restore_ok(ra, store) == 1.0
+    # Convergence: the quarantine drained and the lease is gone.
+    after = postmortem.analyze_root(
+        str(ra), store_url=str(store), blackbox_dir=str(bb)
+    )
+    assert after["store"]["quarantined"] == [], after["store"]
+    assert after["store"]["sweep_lease"] is None, after["store"]
 
 
 # -------------------------------------------------------------------- soak
@@ -359,3 +454,18 @@ def test_store_chaos_soak(tmp_path, seed):
         _assert_store_invariants(store, roots)
         for root in roots:
             _restore_ok(root, store)
+        # Classifier per round: no process died (faults here are raised
+        # errors, not kills), so postmortem must never invent a death.
+        from torchsnapshot_tpu.telemetry import postmortem
+
+        for root in roots:
+            verdict = postmortem.analyze_root(
+                str(root),
+                store_url=str(store),
+                blackbox_dir=str(tmp_path / "bb"),
+            )
+            assert verdict["classification"] == "no_failure", (
+                seed,
+                spec,
+                verdict["classification"],
+            )

@@ -213,6 +213,25 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Flight-recorder spill rate (records/s through the blackbox
+        # ring's positioned pwrite): the always-on forensics budget.  Its
+        # own gated series so a change that slows the spill path (a sync
+        # or fsync creeping in, lock contention) fails the trajectory gate
+        # — the <1% overhead claim in docs/observability.md is only true
+        # while this number holds.
+        bb_probe = aux.get("blackbox_probe") or {}
+        bb_rate = bb_probe.get("records_per_s")
+        if isinstance(bb_rate, (int, float)):
+            records.append(
+                {
+                    "series": f"{bank}:blackbox_records_per_s:{backend}",
+                    "round": rnd,
+                    "value": float(bb_rate),
+                    "unit": "records/s",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
     return records
 
 

@@ -100,6 +100,16 @@ timeout -k 10 300 python -m pytest \
   tests/test_store_chaos.py::test_kill_mid_take_debris_swept_by_survivor -q \
   -p no:cacheprovider || fail=1
 
+# Postmortem smoke: the crash-forensics contract — a child killed
+# mid-take by the crash fault must be NAMED by `tpusnap postmortem`
+# (dead pid, op and phase at death, the injected kill point) from its
+# flight-recorder ring, and the prescribed remediation must converge
+# when applied.  Also covers the ring's crash-survival properties and
+# the peerd ServerTracer idle-flush regression.
+step "postmortem smoke (flight recorder + crash classification)"
+timeout -k 10 300 python -m pytest tests/test_postmortem.py -q \
+  -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

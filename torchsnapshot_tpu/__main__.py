@@ -836,6 +836,38 @@ def cmd_history(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_postmortem(args: argparse.Namespace) -> int:
+    """Crash forensics for a SnapshotManager root: stitch the black-box
+    flight-recorder rings, frozen heartbeat, coordination-store lease
+    stamps, in-flight markers, shared-store ledger/sweep state, journal
+    segments, and stale fleet-spool entries into one skew-corrected
+    timeline; name the first-dead pid/rank, the op and pipeline phase at
+    death, and the debris; print the remediation that converges."""
+    import json
+
+    from .telemetry import postmortem
+
+    report = postmortem.analyze_root(
+        args.path,
+        store_url=args.store,
+        coord_dir=args.coord,
+        heartbeat_path=args.heartbeat,
+        blackbox_dir=args.blackbox,
+    )
+    if args.perfetto:
+        doc = postmortem.to_perfetto(report)
+        out = args.out or "postmortem.perfetto.json"
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        print(f"wrote {len(doc['traceEvents'])} timeline event(s) to {out}")
+        return 0
+    if args.json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(postmortem.format_report(report))
+    return 0
+
+
 def cmd_top(args: argparse.Namespace) -> int:
     """Live cross-process fleet view (telemetry/fleet.py): every op
     publishing into the ``TPUSNAP_FLEET_TELEMETRY`` spool renders as one
@@ -1499,6 +1531,51 @@ def main(argv=None) -> int:
         help="age-out seconds (default: TPUSNAP_FLEET_TELEMETRY_STALE_S)",
     )
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser(
+        "postmortem",
+        help="crash forensics: stitch flight-recorder rings, leases, and "
+        "store state into a causal timeline with remediation",
+    )
+    p.add_argument("path", help="SnapshotManager root to analyze")
+    p.add_argument(
+        "--store",
+        default=None,
+        help="shared CAS store URL (default: TPUSNAP_STORE or the root's "
+        ".store pointer)",
+    )
+    p.add_argument(
+        "--coord",
+        default=None,
+        help="FileStore coordination dir holding oplease stamps "
+        "(default: TPUSNAP_STORE_PATH)",
+    )
+    p.add_argument(
+        "--heartbeat",
+        default=None,
+        help="heartbeat file to fold in (default: TPUSNAP_HEARTBEAT_FILE)",
+    )
+    p.add_argument(
+        "--blackbox",
+        default=None,
+        help="flight-recorder ring dir "
+        "(default: TPUSNAP_BLACKBOX or <root>/telemetry/blackbox)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    p.add_argument(
+        "--perfetto",
+        action="store_true",
+        help="export the stitched timeline as Chrome/Perfetto instant "
+        "events instead of the text report",
+    )
+    p.add_argument(
+        "--out",
+        default=None,
+        help="output path for --perfetto (default: postmortem.perfetto.json)",
+    )
+    p.set_defaults(fn=cmd_postmortem)
 
     for name, fn, extra_help in (
         (

@@ -373,6 +373,26 @@ class FaultyStoragePlugin(StoragePlugin):
             logger.warning(
                 "fault injected: CRASH at %s %s (os._exit)", op, path
             )
+            # Flight-recorder ground truth: spill the kill point (storage
+            # op, path, pipeline phase) before dying.  os.pwrite hands the
+            # bytes to the kernel, so the record survives os._exit — this
+            # is the slot `tpusnap postmortem` names the death from, and
+            # the chaos suites assert it matches the injected schedule.
+            try:
+                from . import phase_stats
+                from .telemetry import blackbox
+
+                blackbox.record(
+                    "fault",
+                    "crash",
+                    {
+                        "op": op,
+                        "path": path,
+                        "phase": phase_stats.last_phase(),
+                    },
+                )
+            except Exception:
+                pass
             import os
 
             os._exit(1)

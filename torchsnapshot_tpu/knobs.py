@@ -100,6 +100,12 @@ PEERD_ACCESS_LOG_MAX_BYTES_ENV_VAR = _ENV_PREFIX + "PEERD_ACCESS_LOG_MAX_BYTES"
 # (dist_store.py).  TPUSNAP_STORE points at chunk storage shared by roots.
 STORE_ENV_VAR = _ENV_PREFIX + "STORE"
 STORE_QUARANTINE_S_ENV_VAR = _ENV_PREFIX + "STORE_QUARANTINE_S"
+# Crash-surviving flight recorder (telemetry/blackbox.py): directory the
+# per-process event ring spills into (convention <root>/telemetry/blackbox),
+# plus the ring geometry — slot count x fixed slot size.
+BLACKBOX_DIR_ENV_VAR = _ENV_PREFIX + "BLACKBOX"
+BLACKBOX_SLOTS_ENV_VAR = _ENV_PREFIX + "BLACKBOX_SLOTS"
+BLACKBOX_SLOT_BYTES_ENV_VAR = _ENV_PREFIX + "BLACKBOX_SLOT_BYTES"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -140,6 +146,12 @@ _DEFAULT_JOURNAL_MAX_BYTES = 0
 # their size is unknown at plan time) and skip per-chunk codec overhead
 # that dwarfs any saving at that scale.
 _DEFAULT_COMPRESSION_MIN_BYTES = 64 * 1024
+# Flight-recorder ring geometry: 512 slots x 512 bytes = one 256 KiB file
+# per process.  Records are single pwrite()s of exactly one slot, so a
+# kill -9 loses at most the slot being written; 512 recent records cover
+# several minutes of op/phase/lease transitions at the recorder's cadence.
+_DEFAULT_BLACKBOX_SLOTS = 512
+_DEFAULT_BLACKBOX_SLOT_BYTES = 512
 # Max payloads the fs plugin's micro-batcher groups into ONE native
 # write+hash batch call.  8 stays below the default 16-slot io
 # concurrency, so a full batch can form from in-flight producers while
@@ -429,6 +441,50 @@ def get_heartbeat_file() -> Optional[str]:
     process.  None (default) disables."""
     val = os.environ.get(HEARTBEAT_FILE_ENV_VAR, "").strip()
     return val or None
+
+
+def get_blackbox_dir() -> Optional[str]:
+    """Directory the crash-surviving flight recorder
+    (``telemetry/blackbox.py``) spills its per-process event ring into, or
+    None — recording disabled (the default).  The convention is
+    ``<root>/telemetry/blackbox`` so ``tpusnap postmortem <root>`` finds the
+    rings without extra flags; each process owns one
+    ``<host>-<pid>.ring`` file of fixed-size slots."""
+    val = os.environ.get(BLACKBOX_DIR_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_blackbox_slots() -> int:
+    """Slot count of the flight-recorder ring: how many recent records a
+    process retains (older records are overwritten in place)."""
+    return max(8, _get_int_env(BLACKBOX_SLOTS_ENV_VAR, _DEFAULT_BLACKBOX_SLOTS))
+
+
+def get_blackbox_slot_bytes() -> int:
+    """Fixed byte size of one flight-recorder slot.  A record is one
+    ``pwrite`` of exactly this many bytes at a seq-derived offset — atomic
+    enough that a reader drops at most the slot torn by a kill -9."""
+    return max(
+        128, _get_int_env(BLACKBOX_SLOT_BYTES_ENV_VAR, _DEFAULT_BLACKBOX_SLOT_BYTES)
+    )
+
+
+@contextmanager
+def override_blackbox_dir(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(BLACKBOX_DIR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_blackbox_slots(value: int) -> Generator[None, None, None]:
+    with _override_env(BLACKBOX_SLOTS_ENV_VAR, str(value)):
+        yield
+
+
+@contextmanager
+def override_blackbox_slot_bytes(value: int) -> Generator[None, None, None]:
+    with _override_env(BLACKBOX_SLOT_BYTES_ENV_VAR, str(value)):
+        yield
 
 
 def get_regression_factor() -> float:

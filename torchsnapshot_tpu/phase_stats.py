@@ -63,10 +63,33 @@ _COMPACT_THRESHOLD = 512
 # read per add().  Installed/removed under the tracer's own lock.
 _trace_hook: Optional[object] = None
 
+# Flight-recorder observer hook (telemetry/blackbox.py): a second, always-on
+# observer slot with the same contract as the trace hook — forwarded
+# (phase, begin, end, nbytes) after the lock, exceptions swallowed.  Kept
+# separate from _trace_hook because tracing is per-operation (installed and
+# removed around each traced op) while the recorder observes for the whole
+# process lifetime.
+_observer_hook: Optional[object] = None
+
+# Name of the most recently recorded phase: the "where was the pipeline"
+# answer a heartbeat or a crash record wants, without holding any state in
+# the caller.  Written under _lock, read without it (a str swap is atomic).
+_last_phase: Optional[str] = None
+
 
 def set_trace_hook(hook) -> None:
     global _trace_hook
     _trace_hook = hook
+
+
+def set_observer_hook(hook) -> None:
+    global _observer_hook
+    _observer_hook = hook
+
+
+def last_phase() -> Optional[str]:
+    """Name of the most recently recorded phase (None before any)."""
+    return _last_phase
 
 
 def add(
@@ -81,9 +104,11 @@ def add(
     wall-union computation.  ``_release_token`` (timed() internal) retires
     the block's active-begin registration in the same critical section as
     the append, so compaction can never observe the gap between them."""
+    global _last_phase
     if end is None:
         end = time.monotonic()
     begin = end - seconds
+    _last_phase = phase
     with _lock:
         if _release_token is not None:
             actives = _active_begins.get(phase)
@@ -141,6 +166,12 @@ def add(
     if hook is not None:
         try:
             hook(phase, begin, end, nbytes)
+        except Exception:
+            pass  # telemetry must never break the pipeline
+    observer = _observer_hook
+    if observer is not None:
+        try:
+            observer(phase, begin, end, nbytes)
         except Exception:
             pass  # telemetry must never break the pipeline
 

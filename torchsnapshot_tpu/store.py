@@ -292,6 +292,13 @@ class StoreWriterContext:
         # defers epoch<=E deletions until this take ends.
         self.observed_epoch = read_epoch(self._storage)
         self._write_lease()
+        from .telemetry import blackbox
+
+        blackbox.record(
+            "lease",
+            "store_writer.start",
+            {"tenant": self.tenant, "epoch": self.observed_epoch},
+        )
         interval = max(0.05, knobs.get_lease_interval_s())
         self._thread = threading.Thread(
             target=self._refresh_loop,
@@ -354,6 +361,11 @@ class StoreWriterContext:
             self._storage.sync_delete(self._lease_relpath)
         except Exception:
             pass
+        from .telemetry import blackbox
+
+        blackbox.record(
+            "lease", "store_writer.close", {"tenant": self.tenant}
+        )
 
 
 # ------------------------------------------------------------------- ledger
@@ -567,8 +579,14 @@ class _SweepLease:
                     doc.get("phase"),
                 )
         from . import knobs
+        from .telemetry import blackbox
 
         self._write()
+        blackbox.record(
+            "lease",
+            "store_sweep.acquire",
+            {"epoch": self.epoch, "adopted": self.adopted},
+        )
         self._thread = threading.Thread(
             target=self._refresh_loop,
             args=(max(0.05, knobs.get_lease_interval_s()),),
@@ -585,6 +603,11 @@ class _SweepLease:
             self._write()
         except Exception:
             logger.debug("sweep lease update failed", exc_info=True)
+        from .telemetry import blackbox
+
+        blackbox.record(
+            "lease", f"store_sweep.{phase}", {"epoch": self.epoch}
+        )
 
     def _refresh_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
@@ -602,6 +625,13 @@ class _SweepLease:
             self._storage.sync_delete(SWEEP_LEASE_FNAME)
         except Exception:
             pass
+        from .telemetry import blackbox
+
+        blackbox.record(
+            "lease",
+            "store_sweep.release",
+            {"phase": self.phase, "epoch": self.epoch},
+        )
 
 
 def foreign_sweep_live(storage: StoragePlugin) -> bool:

@@ -46,7 +46,7 @@ monitoring) showed per-phase timelines and longitudinal metrics are
 prerequisites for tuning, which is what this package persists.
 """
 
-from . import analyze, fleet, history, metrics, monitor, sidecar, trace
+from . import analyze, blackbox, fleet, history, metrics, monitor, sidecar, trace
 
 __all__ = [
     "trace",
@@ -56,4 +56,5 @@ __all__ = [
     "analyze",
     "history",
     "fleet",
+    "blackbox",
 ]

@@ -744,6 +744,37 @@ def record_codec(codec: str, uncompressed: int, compressed: int) -> None:
     ).inc(compressed, codec=codec)
 
 
+def record_blackbox_record() -> None:
+    """One record spilled to the flight-recorder ring (blackbox.py)."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_blackbox_records_total",
+        "Records spilled to the crash-surviving flight-recorder ring",
+    ).inc()
+
+
+def record_blackbox_spill_error() -> None:
+    """A flight-recorder spill failed (ring unopenable, pwrite error).
+    The recorder swallows the exception — this counter is the evidence."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_blackbox_spill_errors_total",
+        "Failed flight-recorder spills (the recorder never raises)",
+    ).inc()
+
+
+def record_postmortem_report(classification: str) -> None:
+    """One `tpusnap postmortem` run, by failure classification."""
+    if not enabled():
+        return
+    counter(
+        "tpusnap_postmortem_reports_total",
+        "Postmortem analyses run, by failure classification",
+    ).inc(classification=classification)
+
+
 # ------------------------------------------------------------- event bridge
 
 # The bridge's contract with the event stream, exported for the tier-1
@@ -792,6 +823,8 @@ DIRECT_METRIC_EVENTS = frozenset(
         "peer.demoted",  # record_peer_demoted
         "rollout.wave",  # record_rollout_wave
         "store.sweep",  # record_gc("chunk_condemned"/"chunk_restored"/...)
+        "blackbox.spill_error",  # record_blackbox_spill_error
+        "postmortem.report",  # record_postmortem_report
     }
 )
 

@@ -43,7 +43,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import knobs, phase_stats, rss_profiler
-from . import fleet
+from . import blackbox, fleet
 from ..event import Event
 from ..event_handlers import log_event
 
@@ -102,9 +102,18 @@ class OpMonitor:
         # read_object can never clobber an in-flight save's entry.
         self._fleet = fleet.enabled()
         self._fleet_next = 0.0
+        # Flight recorder (blackbox.py): when enabled, the tick thread also
+        # spills a periodic progress record — the "how far did it get"
+        # signal a postmortem reads after a kill -9.
+        self._blackbox = blackbox.enabled()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        if self._stall_timeout_s > 0 or self._heartbeat_path or self._fleet:
+        if (
+            self._stall_timeout_s > 0
+            or self._heartbeat_path
+            or self._fleet
+            or self._blackbox
+        ):
             self._thread = threading.Thread(
                 target=self._run,
                 name=f"tpusnap-monitor-{kind}",
@@ -234,6 +243,8 @@ class OpMonitor:
             candidates.append(min(knobs.get_progress_interval_s() or 5.0, 5.0))
         if self._fleet:
             candidates.append(knobs.get_fleet_telemetry_interval_s())
+        if self._blackbox:
+            candidates.append(min(knobs.get_progress_interval_s() or 5.0, 5.0))
         return max(_MIN_TICK_S, min(min(candidates), _MAX_TICK_S))
 
     def _run(self) -> None:
@@ -245,6 +256,8 @@ class OpMonitor:
             self.watermark.sample()
             if self._heartbeat_path:
                 self._write_heartbeat()
+            if self._blackbox:
+                self._record_blackbox_progress()
             if self._fleet:
                 from .. import preemption
 
@@ -435,6 +448,30 @@ class OpMonitor:
             lines.append("(no scheduler event loop attached)")
         return lines
 
+    def _trace_id(self) -> str:
+        from . import trace as ttrace
+
+        return ttrace.trace_id_for(self.op_id)
+
+    def _record_blackbox_progress(self) -> None:
+        """Spill a compact progress record to the flight-recorder ring —
+        the last one before a kill -9 is postmortem's "how far did the op
+        get" evidence (bytes staged vs written, phase, stall count)."""
+        doc = self.progress()
+        blackbox.record(
+            "progress",
+            self.kind,
+            {
+                "op_id": self.op_id,
+                "rank": self.rank,
+                "elapsed_s": doc["elapsed_s"],
+                "phase": phase_stats.last_phase(),
+                "requests": doc["requests"],
+                "bytes": doc["bytes"],
+                "stalls": doc["stalls"],
+            },
+        )
+
     def _write_heartbeat(self) -> None:
         path = self._heartbeat_path
         if not path:
@@ -442,6 +479,12 @@ class OpMonitor:
         try:
             doc = self.progress()
             doc["heartbeat_time"] = time.time()
+            # Correlation keys for postmortem and external watchdogs: a
+            # frozen heartbeat names the op kind, its distributed trace id,
+            # and the pipeline phase it froze in — not just done/success.
+            doc["op_kind"] = self.kind
+            doc["trace_id"] = self._trace_id()
+            doc["phase"] = phase_stats.last_phase()
             # Per-thread tmp name: concurrent ops' monitor threads share
             # one heartbeat path (and one pid) — interleaved writes into
             # a shared tmp would rename torn JSON into place.
@@ -491,9 +534,13 @@ def op_started(
     """Register (and return) the monitor for one operation.  ``watchdog``
     False (read_object) keeps the progress registry correct without a
     stall thread — the watchdog belongs to take/async_take/restore."""
+    blackbox.maybe_install()
     mon = OpMonitor(kind, op_id, rank, watchdog=watchdog)
     with _LOCK:
         _ACTIVE.append(mon)
+    blackbox.record(
+        "op", f"{kind}.start", {"op_id": op_id, "rank": rank}
+    )
     return mon
 
 
@@ -509,6 +556,11 @@ def op_finished(mon: Optional[OpMonitor], success: bool = True) -> None:
         except ValueError:
             return  # already finished
     mon.finish(success)
+    blackbox.record(
+        "op",
+        f"{mon.kind}.end",
+        {"op_id": mon.op_id, "rank": mon.rank, "success": success},
+    )
 
 
 def active_ops() -> List[OpMonitor]:

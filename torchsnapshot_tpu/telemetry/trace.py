@@ -420,10 +420,14 @@ class ServerTracer:
     dropped when ``TPUSNAP_PEER_TRACE_MAX_SPANS`` is exceeded — the drop
     count is carried in ``otherData.dropped_spans``, never silently) and
     the buffer is rewritten to one trace file at most every
-    ``TPUSNAP_PEER_TRACE_FLUSH_S`` seconds (piggybacked on span recording;
-    no flush thread) plus once at :meth:`close`.  Each span carries its own
-    ``args.trace`` id parsed from the request's ``traceparent`` header, so
-    one daemon file contributes to many stitched client traces.
+    ``TPUSNAP_PEER_TRACE_FLUSH_S`` seconds plus once at :meth:`close`.
+    A background flusher thread covers the idle tail: with record-time
+    flushing alone, spans recorded after the last flush sat invisible
+    until the NEXT request arrived — a daemon that served one burst and
+    went quiet never exposed it, and a postmortem read an empty file.
+    Each span carries its own ``args.trace`` id parsed from the request's
+    ``traceparent`` header, so one daemon file contributes to many
+    stitched client traces.
     """
 
     def __init__(self, trace_dir: str, ident: str, kind: str = "peerd") -> None:
@@ -433,12 +437,27 @@ class ServerTracer:
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._dropped = 0
+        self._dirty = False
         self._max_spans = knobs.get_peer_trace_max_spans()
         self._flush_s = knobs.get_peer_trace_flush_s()
         self._last_flush = time.monotonic()
         self.path = os.path.join(
             trace_dir, f"{kind}-{ident[:8]}-rank0{TRACE_FILE_SUFFIX}"
         )
+        self._stop = threading.Event()
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="tpusnap-peerd-flush", daemon=True
+        )
+        self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        """Time-based flush independent of request arrival: spans become
+        visible within one flush interval even when the daemon goes idle."""
+        while not self._stop.wait(self._flush_s):
+            with self._lock:
+                dirty = self._dirty
+            if dirty:
+                self.flush()
 
     def record_span(
         self,
@@ -469,6 +488,7 @@ class ServerTracer:
                 overflow = len(self._events) - self._max_spans
                 del self._events[:overflow]
                 self._dropped += overflow
+            self._dirty = True
             now = time.monotonic()
             if now - self._last_flush >= self._flush_s:
                 self._last_flush = now
@@ -481,6 +501,7 @@ class ServerTracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
+            self._dirty = False
         payload = {
             "traceEvents": events
             + [
@@ -518,6 +539,8 @@ class ServerTracer:
             return None
 
     def close(self) -> Optional[str]:
+        self._stop.set()
+        self._flusher.join(timeout=5.0)
         return self.flush()
 
 
