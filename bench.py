@@ -1383,6 +1383,7 @@ def main() -> None:
     # probe, and D2H would burn watchdog budget the async/restore sections
     # need.  Byte identity between the two legs is asserted, not assumed.
     native_ab_probe = None
+    profiler_probe = None
     if "--native-ab" in argv:
         _PARTIAL["phase"] = "native_ab_probe"
         import hashlib
@@ -1432,11 +1433,16 @@ def main() -> None:
             r = resource.getrusage(resource.RUSAGE_SELF)
             return r.ru_utime + r.ru_stime
 
-        def _ab_leg(root, native_on):
+        def _ab_leg(root, native_on, profile_dir=None):
             from torchsnapshot_tpu import knobs as _kn
 
             shutil.rmtree(root, ignore_errors=True)
-            with _kn.override_native(native_on):
+            # profile_dir set -> the leg's take+restore run under the
+            # continuous profiler (telemetry/profiler.py), one profile
+            # file per op; None unsets the knob (warm legs unprofiled).
+            with _kn.override_profile_dir(profile_dir), _kn.override_native(
+                native_on
+            ):
                 _drain_writeback()
                 phase_stats.reset()
                 c0, t0 = _proc_cpu_s(), time.monotonic()
@@ -1488,9 +1494,40 @@ def main() -> None:
         _ab_leg(os.path.join(workdir, "ab_warm"), True)
         _ab_leg(os.path.join(workdir, "ab_warm"), False)
         shutil.rmtree(os.path.join(workdir, "ab_warm"), ignore_errors=True)
-        leg_native = _ab_leg(ab_native_root, True)
-        leg_py = _ab_leg(ab_py_root, False)
+        # Measured legs run profiled: the differential profile between
+        # them names the checksum/decode frames the native plane moves.
+        ab_prof_native = os.path.join(workdir, "ab_prof_native")
+        ab_prof_py = os.path.join(workdir, "ab_prof_fallback")
+        leg_native = _ab_leg(ab_native_root, True, profile_dir=ab_prof_native)
+        leg_py = _ab_leg(ab_py_root, False, profile_dir=ab_prof_py)
         identical = _ab_dir_digest(ab_native_root) == _ab_dir_digest(ab_py_root)
+
+        from torchsnapshot_tpu.telemetry import profiler as _profiler
+
+        def _leg_profile_meta(prof_dir, kind=None):
+            """Merged profile meta of one leg's dir (optionally one op
+            kind only), or None if that leg produced no profiles."""
+            try:
+                docs = _profiler.load_profile_dir(prof_dir)
+            except ValueError:
+                return None
+            metas = [
+                d["tpusnap"]
+                for d in docs
+                if kind is None or d["tpusnap"].get("kind") == kind
+            ]
+            return _profiler.merge_metas(metas) if metas else None
+
+        def _diff_summary(meta_a, meta_b, top=5):
+            """Compact top-regressed/improved frame rows for aux."""
+            if meta_a is None or meta_b is None:
+                return None
+            diff = _profiler.diff_profiles(meta_a, meta_b, top=top)
+            return {
+                "delta_oncpu_s": diff["delta_oncpu_s"],
+                "top_regressed": diff["top_regressed"],
+                "top_improved": diff["top_improved"],
+            }
 
         # --- --direct-io A/B: the same native save through the direct-I/O
         # ladder (io_uring / O_DIRECT pwrite / buffered fallback) vs the
@@ -1502,8 +1539,11 @@ def main() -> None:
             from torchsnapshot_tpu.native_io import NativeFileIO as _NIO
 
             ab_direct_root = os.path.join(workdir, "ab_direct")
+            ab_prof_direct = os.path.join(workdir, "ab_prof_direct")
             with _kn.override_direct_io(True):
-                leg_direct = _ab_leg(ab_direct_root, True)
+                leg_direct = _ab_leg(
+                    ab_direct_root, True, profile_dir=ab_prof_direct
+                )
                 _nio = _NIO.maybe_create()
                 dio_mode = _nio.direct_io_mode() if _nio is not None else 0
             if _nio is not None:
@@ -1525,6 +1565,12 @@ def main() -> None:
                 )
                 if leg_direct["save_s"]
                 else None,
+                # Differential profile buffered (A) -> direct (B): which
+                # frames the submission-path change moves.
+                "profile_diff": _diff_summary(
+                    _leg_profile_meta(ab_prof_native),
+                    _leg_profile_meta(ab_prof_direct),
+                ),
             }
             log(
                 f"direct-io A/B: mode={direct_io_probe['mode']}, save "
@@ -1571,6 +1617,73 @@ def main() -> None:
         )
         if direct_io_probe is not None:
             native_ab_probe["direct_io_probe"] = direct_io_probe
+
+        # --- continuous-profiler probe: the A/B differential profile
+        # (native A -> fallback B names the checksum/decode frames the
+        # native plane eliminates) plus the sampler's own calibrated
+        # overhead and attribution health, banked as profiler_probe and
+        # gated by tools/bench_trajectory.py (profiler_overhead_pct).
+        meta_native = _leg_profile_meta(ab_prof_native)
+        meta_py = _leg_profile_meta(ab_prof_py)
+        native_ab_probe["profile_diff"] = _diff_summary(meta_native, meta_py)
+        if native_ab_probe["profile_diff"] is not None:
+            log(
+                "A/B differential profile (native -> fallback): "
+                f"delta on-CPU "
+                f"{native_ab_probe['profile_diff']['delta_oncpu_s']}s; "
+                "top regressed "
+                + ", ".join(
+                    f"{r['frame']} {r['delta_s']:+.2f}s"
+                    for r in native_ab_probe["profile_diff"]["top_regressed"][:3]
+                )
+            )
+        prof_cal = _profiler.calibrated_overhead_s(samples=200)
+        prof_hz = _kn.get_profile_hz() or 99.0
+        # Overhead as % of op wall is wall-independent at a fixed rate:
+        # per-tick cost x ticks/second.  Floored so the trajectory series
+        # never banks a hard 0 (which would read as a missing value).
+        prof_overhead_pct = max(prof_cal["per_tick_s"] * prof_hz * 100, 1e-4)
+        meta_restore = _leg_profile_meta(ab_prof_native, kind="restore")
+        restore_attr = None
+        if meta_restore is not None and leg_native["restore_proc_cpu_s"]:
+            tagged_oncpu_s = (
+                meta_restore["oncpu_samples"] - meta_restore["untagged_oncpu"]
+            ) * (meta_restore.get("weight_s") or 0.0)
+            restore_attr = round(
+                tagged_oncpu_s / leg_native["restore_proc_cpu_s"], 4
+            )
+        profiler_probe = {
+            "hz": prof_hz,
+            "per_tick_s": round(prof_cal["per_tick_s"], 9),
+            "overhead_pct": round(prof_overhead_pct, 4),
+            # THE acceptance bar: sampling at the default rate must cost
+            # less than 1% of any op it profiles.
+            "overhead_below_1pct": prof_overhead_pct < 1.0,
+            "samples_total": meta_native["samples_total"]
+            if meta_native
+            else 0,
+            "untagged_oncpu_share": round(
+                meta_native["untagged_oncpu"] / meta_native["oncpu_samples"],
+                4,
+            )
+            if meta_native and meta_native["oncpu_samples"]
+            else None,
+            # Share of the restore leg's getrusage process CPU landing in
+            # named (phase, frame) buckets (acceptance: >= 0.8).
+            "restore_cpu_attribution": restore_attr,
+        }
+        _PARTIAL["banked"]["sync"]["profiler_probe"] = profiler_probe
+        log(
+            f"profiler probe: {prof_cal['per_tick_s'] * 1e6:.1f} us/tick @ "
+            f"{prof_hz:g} Hz -> {prof_overhead_pct:.3f}% of wall "
+            f"(below_1pct={profiler_probe['overhead_below_1pct']}); "
+            f"untagged on-CPU share "
+            f"{profiler_probe['untagged_oncpu_share']}; restore CPU "
+            f"attribution {restore_attr}"
+        )
+        shutil.rmtree(ab_prof_native, ignore_errors=True)
+        shutil.rmtree(ab_prof_py, ignore_errors=True)
+        shutil.rmtree(os.path.join(workdir, "ab_prof_direct"), ignore_errors=True)
 
         # --- compressed leg: the requested codec (zstd) through the native
         # encode-into-frame path vs TPUSNAP_NATIVE=0 resolution.  Per-leg
@@ -2303,6 +2416,7 @@ def main() -> None:
             "store_probe": store_probe,
             "journal_probe": journal_probe,
             "native_ab_probe": native_ab_probe,
+            "profiler_probe": profiler_probe,
             "serve_probe": serve_probe,
             "sync_save_s": round(save_s, 2),
             "sync_save_worst_s": round(max(save_attempts_s), 2),

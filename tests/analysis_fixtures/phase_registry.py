@@ -4,7 +4,7 @@ from torchsnapshot_tpu import phase_stats
 
 
 def bad_phases(data):
-    with phase_stats.timed("warp_drive", len(data)):  # LINT-EXPECT: phase-registry
+    with phase_stats.timed("warp_core", len(data)):  # LINT-EXPECT: phase-registry
         pass
     phase_stats.add("mystery_phase", 0.1, 42)  # LINT-EXPECT: phase-registry
 
@@ -15,5 +15,6 @@ def ok_phases(data, dynamic):
     with phase_stats.timed("checksum", len(data)):
         pass
     phase_stats.add("mem_write", 0.1, 42)  # storage _write suffix
+    phase_stats.add("take_drive", 0.1)  # op-driver _drive suffix
     phase_stats.add("budget_wait", 0.1)
     phase_stats.add(dynamic, 0.1, 42)  # non-literal: runtime's job

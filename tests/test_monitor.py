@@ -139,6 +139,12 @@ def test_watchdog_fires_on_injected_hang(tmp_path, monkeypatch):
     assert "pending asyncio tasks" in text
     assert "thread stacks (faulthandler)" in text
     assert "Thread" in text or "thread" in text
+    # The bundle also carries a phase-tagged SAMPLED profile (clamped to
+    # the stall timeout): collapsed phase;state;stack lines showing what
+    # the stuck process is doing over time, not just one-shot stacks.
+    assert "--- sampled profile" in text
+    profile_body = text.split("--- sampled profile", 1)[1]
+    assert ";offcpu;" in profile_body or ";oncpu;" in profile_body
 
 
 def test_watchdog_no_false_positive_when_advancing(tmp_path, monkeypatch):

@@ -232,7 +232,37 @@ def collect_rounds(root: str) -> List[Dict[str, Any]]:
                     "file": fname,
                 }
             )
+        # Continuous-profiler self-overhead (% of op wall at the default
+        # sampling rate: calibrated per-tick cost x ticks/second).  A
+        # LOWER-is-better series — analyze_trajectory special-cases the
+        # "overhead_pct" name to use the value itself as the cost and to
+        # hard-fail any round above the absolute 1% budget, so a change
+        # that makes the sampler tick expensive (stack walking, /proc
+        # parsing, lock contention) fails the gate even if it creeps in
+        # slowly enough to dodge the trailing-median check.
+        prof_probe = aux.get("profiler_probe") or {}
+        prof_overhead = prof_probe.get("overhead_pct")
+        if isinstance(prof_overhead, (int, float)):
+            records.append(
+                {
+                    "series": f"{bank}:profiler_overhead_pct:{backend}",
+                    "round": rnd,
+                    "value": float(prof_overhead),
+                    "unit": "%",
+                    "incomplete": incomplete,
+                    "file": fname,
+                }
+            )
     return records
+
+
+# Absolute ceiling for profiler_overhead_pct series (percent of op wall):
+# the documented <1% sampling budget.
+_OVERHEAD_PCT_LIMIT = 1.0
+
+
+def _is_overhead_series(name: str) -> bool:
+    return "overhead_pct" in name
 
 
 def analyze_trajectory(records: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -255,7 +285,26 @@ def analyze_trajectory(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             if not usable:
                 rec["verdict"] = "skipped" if rec["incomplete"] else "no-value"
                 continue
-            candidate = {"action": name, "duration_s": 1.0 / rec["value"]}
+            # Most series are higher-is-better (GB/s, ratios): cost is
+            # 1/value.  Overhead series are lower-is-better: the value IS
+            # the cost, and an absolute budget applies on top of the
+            # relative trailing-median check.
+            if _is_overhead_series(name):
+                candidate = {"action": name, "duration_s": rec["value"]}
+                if rec["value"] > _OVERHEAD_PCT_LIMIT:
+                    rec["verdict"] = "REGRESSION"
+                    rec["regression"] = {
+                        "ratio": round(
+                            rec["value"] / _OVERHEAD_PCT_LIMIT, 2
+                        ),
+                        "factor": _OVERHEAD_PCT_LIMIT,
+                        "absolute_limit_pct": _OVERHEAD_PCT_LIMIT,
+                    }
+                    n_regressions += 1
+                    prior.append(candidate)
+                    continue
+            else:
+                candidate = {"action": name, "duration_s": 1.0 / rec["value"]}
             regression = history.detect_regression(prior, candidate)
             if regression is not None:
                 rec["verdict"] = "REGRESSION"

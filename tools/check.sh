@@ -110,6 +110,17 @@ step "postmortem smoke (flight recorder + crash classification)"
 timeout -k 10 300 python -m pytest tests/test_postmortem.py -q \
   -p no:cacheprovider || fail=1
 
+# Profile smoke: the continuous-profiling contract — a profiled take
+# writes schema-valid *.profile.json files (speedscope-loadable, tpusnap
+# meta embedded) and `analyze --profile` folds them into the report and
+# exits 0; also covers the <5% untagged-on-CPU attribution bar on a
+# profiled fs take (the phase-inheriting executor regression test).
+step "profile smoke (profiled take -> analyze --profile, schema valid)"
+timeout -k 10 300 python -m pytest \
+  tests/test_profiler.py::test_profile_smoke_gate \
+  tests/test_profiler.py::test_untagged_share_under_5pct_on_profiled_fs_take \
+  -q -p no:cacheprovider || fail=1
+
 # Sanitizer smoke: only worth the build when the compiler supports
 # -fsanitize=thread; the suite itself still skips per-test when the
 # runtime can't host the instrumented library.

@@ -12,7 +12,9 @@
     python -m torchsnapshot_tpu stats <snapshot-url> [--json] [--metrics]
     python -m torchsnapshot_tpu trace <trace-dir> [--out merged.json]
     python -m torchsnapshot_tpu analyze <trace-dir> [--snapshot URL] [--json]
+    python -m torchsnapshot_tpu analyze <trace-dir> --profile [--json]
     python -m torchsnapshot_tpu analyze <snapshot-url> --barrier [--json]
+    python -m torchsnapshot_tpu profile diff <a> <b> [--top N] [--json]
     python -m torchsnapshot_tpu history <manager-root-url> [--json]
     python -m torchsnapshot_tpu lint [root] [--external] [--json]
     python -m torchsnapshot_tpu warm <root-or-snapshot> [--step N | --time T]
@@ -774,7 +776,10 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     is then the snapshot URL itself.  ``--peer`` switches to the
     serving-plane report: per-peer fetch latency (p50/p99), hit / reject
     / fallback rates, and the TTFB-vs-transfer split from ``peer_fetch``
-    and ``peerd_handle`` spans."""
+    and ``peerd_handle`` spans.  ``--profile`` folds the continuous-
+    profiling plane (telemetry/profiler.py files in the same dir) into
+    the report: per-phase CPU seconds, hottest frames, on/off-CPU split,
+    and the dominant CPU sink."""
     import json
 
     from .telemetry import analyze, trace
@@ -789,13 +794,25 @@ def cmd_analyze(args: argparse.Namespace) -> int:
             print(analyze.render_barrier(reports))
         return 0 if reports else 2
 
+    profile_docs = None
+    if args.profile:
+        try:
+            profile_docs = analyze.load_profile_dir(args.trace_dir)
+        except ValueError as e:
+            print(f"invalid profile input: {e}")
+            return 1
     try:
         docs = analyze.load_trace_dir(args.trace_dir)
     except ValueError as e:
         print(f"invalid trace input: {e}")
         return 1
-    if not docs:
-        print(f"no *{trace.TRACE_FILE_SUFFIX} files under {args.trace_dir}")
+    if not docs and not profile_docs:
+        suffixes = f"*{trace.TRACE_FILE_SUFFIX}"
+        if args.profile:
+            from .telemetry import profiler
+
+            suffixes += f" / *{profiler.PROFILE_FILE_SUFFIX}"
+        print(f"no {suffixes} files under {args.trace_dir}")
         return 2
     if args.peer:
         report = analyze.peer_report(docs)
@@ -808,10 +825,62 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     if args.snapshot:
         sidecars = analyze.load_sidecars(args.snapshot)
     analysis = analyze.analyze_traces(docs, sidecars)
+    if profile_docs is not None:
+        analysis["profiles"] = analyze.profile_report(profile_docs)[
+            "profiles"
+        ]
     if args.json:
         print(json.dumps(analysis, indent=1))
     else:
-        print(analyze.render(analysis))
+        if docs:
+            print(analyze.render(analysis))
+        if profile_docs is not None:
+            if docs:
+                print()
+            print(
+                analyze.render_profile(
+                    {"profiles": analysis.get("profiles", [])}
+                )
+            )
+    return 0
+
+
+def cmd_profile_diff(args: argparse.Namespace) -> int:
+    """Differential profile between two runs (telemetry/profiler.py):
+    which frames gained/lost self on-CPU seconds from A to B.  Each
+    argument is a ``*.profile.json`` file or a profile dir (dirs merge
+    per-rank/per-op files first).  Schema-invalid input exits 1, an
+    empty dir exits 2 — mirroring the ``trace`` CLI."""
+    import json
+    import os as _os
+
+    from .telemetry import profiler
+
+    def _load(path: str):
+        if _os.path.isdir(path):
+            docs = profiler.load_profile_dir(path)
+            if not docs:
+                raise FileNotFoundError(
+                    f"no *{profiler.PROFILE_FILE_SUFFIX} files under {path}"
+                )
+            return profiler.merge_metas([d["tpusnap"] for d in docs])
+        return profiler.load_profile_file(path)["tpusnap"]
+
+    try:
+        meta_a = _load(args.a)
+        meta_b = _load(args.b)
+    except FileNotFoundError as e:
+        print(e)
+        return 2
+    except ValueError as e:
+        print(f"invalid profile input: {e}")
+        return 1
+    diff = profiler.diff_profiles(meta_a, meta_b, top=args.top)
+    if args.json:
+        print(json.dumps(diff, indent=1))
+    else:
+        print(f"profile diff: A={args.a}  B={args.b}")
+        print(profiler.render_diff(diff))
     return 0
 
 
@@ -1493,8 +1562,35 @@ def main(argv=None) -> int:
         "per-peer p50/p99 latency, hit/reject/fallback rates, "
         "TTFB-vs-transfer split",
     )
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="fold continuous-profiling files (*.profile.json in the "
+        "same dir) into the report: per-phase CPU seconds, hottest "
+        "frames, on/off-CPU split, dominant CPU sink",
+    )
     p.add_argument("--json", action="store_true", help="machine-readable output")
     p.set_defaults(fn=cmd_analyze)
+
+    p = sub.add_parser(
+        "profile",
+        help="continuous-profiling tools over *.profile.json files",
+    )
+    psub = p.add_subparsers(dest="profile_cmd", required=True)
+    pd = psub.add_parser(
+        "diff",
+        help="differential profile: which frames gained/lost CPU "
+        "seconds between run A and run B",
+    )
+    pd.add_argument("a", help="profile file or dir (baseline)")
+    pd.add_argument("b", help="profile file or dir (comparison)")
+    pd.add_argument(
+        "--top", type=int, default=10, help="rows per direction"
+    )
+    pd.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    pd.set_defaults(fn=cmd_profile_diff)
 
     p = sub.add_parser(
         "top",

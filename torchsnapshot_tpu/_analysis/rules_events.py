@@ -124,7 +124,8 @@ class PhaseRegistryRule(Rule):
                 message=(
                     f"phase {arg.value!r} is unclassified: add it to "
                     "PHASE_GROUPS in telemetry/analyze.py (or name it with "
-                    "a _write/_read suffix for storage phases) so analyze "
-                    "attributes it to a resource group"
+                    "a _write/_read suffix for storage phases, _drive for "
+                    "op-driver tags) so analyze attributes it to a resource "
+                    "group"
                 ),
             )

@@ -106,6 +106,12 @@ STORE_QUARANTINE_S_ENV_VAR = _ENV_PREFIX + "STORE_QUARANTINE_S"
 BLACKBOX_DIR_ENV_VAR = _ENV_PREFIX + "BLACKBOX"
 BLACKBOX_SLOTS_ENV_VAR = _ENV_PREFIX + "BLACKBOX_SLOTS"
 BLACKBOX_SLOT_BYTES_ENV_VAR = _ENV_PREFIX + "BLACKBOX_SLOT_BYTES"
+# Continuous profiling plane (telemetry/profiler.py): directory the
+# per-op sampled profiles land in (next to traces by convention), plus
+# the wall-clock sampling frequency of the in-process statistical
+# sampler (0 disables sampling even when the directory is set).
+PROFILE_DIR_ENV_VAR = _ENV_PREFIX + "PROFILE"
+PROFILE_HZ_ENV_VAR = _ENV_PREFIX + "PROFILE_HZ"
 
 # Sanitizer build modes _native/build.py understands; each produces its own
 # libtpusnap-<mode>.so so the normal library is never clobbered by an
@@ -152,6 +158,11 @@ _DEFAULT_COMPRESSION_MIN_BYTES = 64 * 1024
 # several minutes of op/phase/lease transitions at the recorder's cadence.
 _DEFAULT_BLACKBOX_SLOTS = 512
 _DEFAULT_BLACKBOX_SLOT_BYTES = 512
+# Statistical-sampler frequency: 99 Hz is the profiling folk standard
+# (just off 100 so the sampler never phase-locks with 100 Hz kernel
+# ticks or periodic work), and one sys._current_frames() walk per 10 ms
+# keeps calibrated overhead well under 1% of op wall.
+_DEFAULT_PROFILE_HZ = 99.0
 # Max payloads the fs plugin's micro-batcher groups into ONE native
 # write+hash batch call.  8 stays below the default 16-slot io
 # concurrency, so a full batch can form from in-flight producers while
@@ -467,6 +478,45 @@ def get_blackbox_slot_bytes() -> int:
     return max(
         128, _get_int_env(BLACKBOX_SLOT_BYTES_ENV_VAR, _DEFAULT_BLACKBOX_SLOT_BYTES)
     )
+
+
+def get_profile_dir() -> Optional[str]:
+    """Directory for per-operation sampled CPU profiles
+    (``telemetry/profiler.py``), or None — profiling disabled (the
+    default).  Each monitored take/async_take/restore writes one
+    ``<kind>-<op>-rank<r>.profile.json`` (speedscope-loadable, with the
+    tpusnap schema embedded) plus a ``.profile.collapsed`` flamegraph
+    text under it; by convention the same directory as
+    ``TPUSNAP_TRACE_DIR`` so analyze folds both."""
+    val = os.environ.get(PROFILE_DIR_ENV_VAR, "").strip()
+    return val or None
+
+
+def get_profile_hz() -> float:
+    """Wall-clock sampling frequency of the statistical profiler in Hz
+    (default 99).  0 disables sampling cleanly even when
+    ``TPUSNAP_PROFILE`` is set — no sampler thread is started and no
+    profile files are written.  Clamped to at most 1000."""
+    val = os.environ.get(PROFILE_HZ_ENV_VAR)
+    if val is None or not val.strip():
+        return _DEFAULT_PROFILE_HZ
+    try:
+        hz = float(val)
+    except ValueError:
+        return _DEFAULT_PROFILE_HZ
+    return 0.0 if hz <= 0 else min(hz, 1000.0)
+
+
+@contextmanager
+def override_profile_dir(value: Optional[str]) -> Generator[None, None, None]:
+    with _override_env(PROFILE_DIR_ENV_VAR, value):
+        yield
+
+
+@contextmanager
+def override_profile_hz(value: float) -> Generator[None, None, None]:
+    with _override_env(PROFILE_HZ_ENV_VAR, str(value)):
+        yield
 
 
 @contextmanager

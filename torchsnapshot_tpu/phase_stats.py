@@ -76,6 +76,24 @@ _observer_hook: Optional[object] = None
 # the caller.  Written under _lock, read without it (a str swap is atomic).
 _last_phase: Optional[str] = None
 
+# Per-thread stack of phases CURRENTLY active on that thread (innermost
+# last), keyed by thread ident.  Maintained by timed() (exact: the block
+# is running right now) and tagged() (scope tag only, no time recorded —
+# the mechanism executor workers use to inherit the submitting thread's
+# phase).  Read by the sampling profiler (telemetry/profiler.py) to
+# attribute a thread's stack sample to a phase; all mutations are single
+# list/dict operations (GIL-atomic), and readers tolerate a stack
+# emptying between lookup and index.
+_thread_phases: Dict[int, List[str]] = {}
+
+# Fallback tag per op-DRIVING thread (ident -> stack of tags): the thread
+# running an operation's event loop / commit path spends real CPU in
+# dispatch work that no timed() block covers.  monitor.op_started
+# registers the driver ident with a "<kind>_drive" tag; thread_phases()
+# falls back to it so those samples classify as driver work instead of
+# landing in the profiler's <untagged> bucket.
+_driver_tags: Dict[int, List[str]] = {}
+
 
 def set_trace_hook(hook) -> None:
     global _trace_hook
@@ -90,6 +108,87 @@ def set_observer_hook(hook) -> None:
 def last_phase() -> Optional[str]:
     """Name of the most recently recorded phase (None before any)."""
     return _last_phase
+
+
+def _push_thread_phase(phase: str) -> None:
+    _thread_phases.setdefault(threading.get_ident(), []).append(phase)
+
+
+def _pop_thread_phase() -> None:
+    ident = threading.get_ident()
+    stack = _thread_phases.get(ident)
+    if stack:
+        stack.pop()
+        if not stack:
+            _thread_phases.pop(ident, None)
+
+
+def current_phase() -> Optional[str]:
+    """Innermost phase active on the CALLING thread (timed() block or
+    tagged() scope), or None.  The tag an executor wrapper captures at
+    submit time so pool workers inherit the submitting phase."""
+    stack = _thread_phases.get(threading.get_ident())
+    try:
+        return stack[-1] if stack else None
+    except IndexError:
+        return None
+
+
+@contextmanager
+def tagged(phase: str) -> Generator[None, None, None]:
+    """Tag the calling thread as working on ``phase`` WITHOUT recording
+    any time: pure attribution scope for the sampling profiler (pool
+    callbacks inheriting the submitting phase, op-drive loops).  Unlike
+    timed(), nothing lands in the stats tables."""
+    _push_thread_phase(phase)
+    try:
+        yield
+    finally:
+        _pop_thread_phase()
+
+
+def register_driver(ident: int, tag: str) -> None:
+    """Register ``tag`` as the fallback phase for op-driving thread
+    ``ident`` (see _driver_tags)."""
+    _driver_tags.setdefault(ident, []).append(tag)
+
+
+def unregister_driver(ident: int, tag: str) -> None:
+    """Remove one occurrence of ``tag`` from ``ident``'s driver stack —
+    callable from any thread (an async op's finish may run on the commit
+    thread, not the thread that registered)."""
+    stack = _driver_tags.get(ident)
+    if not stack:
+        return
+    try:
+        stack.reverse()
+        stack.remove(tag)
+    except ValueError:
+        pass
+    finally:
+        stack.reverse()
+    if not stack:
+        _driver_tags.pop(ident, None)
+
+
+def thread_phases() -> Dict[int, str]:
+    """Snapshot of every thread's current phase attribution: the
+    innermost timed()/tagged() phase, else the thread's op-driver tag.
+    Read by the sampling profiler once per tick; tolerates concurrent
+    mutation (worst case a sample attributes to the phase that just
+    ended — one sample of noise, never an error)."""
+    out: Dict[int, str] = {}
+    for ident, stack in list(_driver_tags.items()):
+        try:
+            out[ident] = stack[-1]
+        except IndexError:
+            pass
+    for ident, stack in list(_thread_phases.items()):
+        try:
+            out[ident] = stack[-1]
+        except IndexError:
+            pass
+    return out
 
 
 def add(
@@ -182,9 +281,11 @@ def timed(phase: str, nbytes: int = 0) -> Generator[None, None, None]:
     token = object()
     with _lock:
         _active_begins.setdefault(phase, {})[token] = begin
+    _push_thread_phase(phase)
     try:
         yield
     finally:
+        _pop_thread_phase()
         end = time.monotonic()
         add(phase, end - begin, nbytes, end=end, _release_token=token)
 

@@ -142,6 +142,32 @@ def _read_executor_workers(read_reqs: List[ReadReq]) -> int:
     return _NUM_EXECUTOR_THREADS
 
 
+class _PhaseInheritingExecutor(ThreadPoolExecutor):
+    """ThreadPoolExecutor whose workers inherit the submitter's phase tag.
+
+    Pool callbacks that run phase work WITHOUT their own phase_stats
+    timer (codec encode closures, consume callbacks, plugin helpers)
+    would sample as ``<untagged>`` in the continuous profiler even
+    though the submitting coroutine knows exactly which phase they
+    belong to.  ``submit`` captures the submitter's innermost phase (or
+    its op-driver tag) and wraps the callable in a ``tagged`` scope —
+    pure attribution, no time recorded, so phase_stats walls are
+    unchanged."""
+
+    def submit(self, fn, /, *args, **kwargs):
+        tag = phase_stats.current_phase()
+        if tag is None:
+            tag = phase_stats.thread_phases().get(threading.get_ident())
+        if tag is None:
+            return super().submit(fn, *args, **kwargs)
+
+        def _run_tagged():
+            with phase_stats.tagged(tag):
+                return fn(*args, **kwargs)
+
+        return super().submit(_run_tagged)
+
+
 def get_local_world_size(pg: PGWrapper) -> int:
     """Number of ranks on this host (reference scheduler.py:35-44) — reduced
     at rank 0 to a {hostname: count} dict and broadcast, O(world) store ops
@@ -348,9 +374,12 @@ class PendingIOWork:
         begin = time.monotonic()
         try:
             if self._io_tasks:
+                # tagged(): profiler attribution only — the drain thread
+                # driving async I/O between phases must not sample as
+                # <untagged>.  The existing io_drain span records the wall.
                 with ttrace.span(
                     "io_drain", cat="scheduler", n_tasks=len(self._io_tasks)
-                ):
+                ), phase_stats.tagged("io_drain_drive"):
                     self._loop.run_until_complete(self._drain())
         except BaseException:
             # First failure propagates; cancel and drain the rest so the loop
@@ -438,7 +467,9 @@ async def execute_write_reqs(
     loop = asyncio.get_running_loop()
     own_executor = executor is None
     if executor is None:
-        executor = ThreadPoolExecutor(max_workers=_staging_executor_workers())
+        executor = _PhaseInheritingExecutor(
+            max_workers=_staging_executor_workers()
+        )
     _count_dispatched("write", len(write_reqs))
 
     budget = _BudgetTracker(memory_budget_bytes)
@@ -822,7 +853,9 @@ async def execute_read_reqs(
     rank: int,
 ) -> None:
     """Budget-gated read → consume pipeline (reference scheduler.py:386-447)."""
-    executor = ThreadPoolExecutor(max_workers=_read_executor_workers(read_reqs))
+    executor = _PhaseInheritingExecutor(
+        max_workers=_read_executor_workers(read_reqs)
+    )
     _count_dispatched("read", len(read_reqs))
     budget = _BudgetTracker(memory_budget_bytes)
     ready_for_io: deque[_ReadPipeline] = deque(

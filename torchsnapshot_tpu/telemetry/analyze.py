@@ -109,6 +109,13 @@ def classify_phase(phase: str) -> str:
             return group
     if phase.endswith(_STORAGE_SUFFIXES):
         return "storage_io"
+    # Op-driver attribution tags (<kind>_drive from OpMonitor,
+    # io_drain_drive from the scheduler's background drain): wall the
+    # driving thread spends between explicit phases — plan building,
+    # event-loop turns, future plumbing.  Profiler-only pseudo-phases;
+    # they never appear as trace spans.
+    if phase.endswith("_drive"):
+        return "driver"
     return "other"
 
 
@@ -363,6 +370,169 @@ def analyze_traces(
             }
         ops.append(entry)
     return {"ops": ops}
+
+
+# ------------------------------------------------------------ profile report
+
+
+def load_profile_dir(profile_dir: str) -> List[Dict[str, Any]]:
+    """Load + schema-validate every profile file under ``profile_dir``
+    (delegates to telemetry/profiler.py; ValueError on garbage, same
+    contract as load_trace_dir)."""
+    from . import profiler
+
+    return profiler.load_profile_dir(profile_dir)
+
+
+def profile_report(
+    docs: List[Dict[str, Any]], top: int = 5
+) -> Dict[str, Any]:
+    """Fold per-rank profile documents into the analyzer's view.
+
+    Per (kind, op), merged across ranks: per-phase on/off-CPU seconds
+    cross-checked against PHASE_GROUPS (each phase carries its resource
+    group, so profile CPU and trace wall line up row for row), the
+    top-N hottest frames per phase by self CPU, the on-vs-off-CPU
+    split, the untagged on-CPU share (the attribution-health signal),
+    the calibrated sampler overhead, and a **dominant CPU sink**
+    verdict — the (phase, frame) bucket burning the most CPU, the
+    profile-plane counterpart of the trace report's limiting-resource
+    classification."""
+    from . import profiler
+
+    by_op: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for doc in docs:
+        meta = doc.get("tpusnap") or {}
+        key = (str(meta.get("kind", "?")), str(meta.get("op", "?")))
+        by_op.setdefault(key, []).append(meta)
+
+    profiles: List[Dict[str, Any]] = []
+    for (kind, op), metas in sorted(by_op.items()):
+        merged = profiler.merge_metas(metas)
+        weight = float(merged.get("weight_s") or 0.0)
+        phases: Dict[str, Any] = {}
+        sink = None  # (cpu_s, phase, frame)
+        for phase, states in sorted((merged.get("stacks") or {}).items()):
+            on = states.get("on") or {}
+            off = states.get("off") or {}
+            frame_cpu: Dict[str, float] = {}
+            for stack, n in on.items():
+                leaf = stack.rsplit(";", 1)[-1]
+                frame_cpu[leaf] = frame_cpu.get(leaf, 0.0) + n * weight
+            hottest = [
+                {"frame": f, "cpu_s": round(s, 4)}
+                for f, s in sorted(
+                    frame_cpu.items(), key=lambda kv: -kv[1]
+                )[:top]
+            ]
+            cpu_s = sum(on.values()) * weight
+            phases[phase] = {
+                "cpu_s": round(cpu_s, 4),
+                "offcpu_s": round(sum(off.values()) * weight, 4),
+                "group": classify_phase(phase),
+                "hottest": hottest,
+            }
+            if hottest and (sink is None or cpu_s > sink[0]):
+                sink = (cpu_s, phase, hottest[0]["frame"])
+        group_cpu: Dict[str, float] = {}
+        for info in phases.values():
+            group_cpu[info["group"]] = (
+                group_cpu.get(info["group"], 0.0) + info["cpu_s"]
+            )
+        oncpu_s = merged["oncpu_samples"] * weight
+        untagged_share = (
+            merged["untagged_oncpu"] / merged["oncpu_samples"]
+            if merged["oncpu_samples"]
+            else 0.0
+        )
+        cal = merged.get("calibration") or {}
+        profiles.append(
+            {
+                "kind": kind,
+                "op": op,
+                "ranks": sorted(
+                    {m.get("rank") for m in metas if m.get("rank") is not None}
+                ),
+                "hz": merged.get("hz"),
+                "duration_s": merged.get("duration_s"),
+                "samples_total": merged["samples_total"],
+                "oncpu_s": round(oncpu_s, 4),
+                "offcpu_s": round(
+                    (merged["samples_total"] - merged["oncpu_samples"])
+                    * weight,
+                    4,
+                ),
+                "untagged_oncpu_share": round(untagged_share, 4),
+                "phases": phases,
+                "groups_cpu_s": {
+                    g: round(s, 4) for g, s in sorted(group_cpu.items())
+                },
+                "dominant_cpu_sink": (
+                    {
+                        "phase": sink[1],
+                        "frame": sink[2],
+                        "cpu_s": round(sink[0], 4),
+                    }
+                    if sink
+                    else None
+                ),
+                "overhead": {
+                    "per_tick_s": cal.get("per_tick_s"),
+                    "estimated_s": cal.get("estimated_s"),
+                },
+            }
+        )
+    return {"profiles": profiles}
+
+
+def render_profile(report: Dict[str, Any]) -> str:
+    """Human-readable continuous-profiling report."""
+    profiles = report.get("profiles", [])
+    if not profiles:
+        return "no profiles found (TPUSNAP_PROFILE unset during the run?)"
+    lines: List[str] = []
+    for prof in profiles:
+        ranks = ",".join(str(r) for r in prof["ranks"])
+        lines.append(
+            f"{prof['kind']} {prof['op'][:8]} — profile, rank(s) {ranks}, "
+            f"{prof['samples_total']} samples @ {prof['hz']:g} Hz "
+            f"({prof['duration_s']:.2f}s)"
+        )
+        lines.append(
+            f"  CPU: {prof['oncpu_s']:.2f}s on-CPU, "
+            f"{prof['offcpu_s']:.2f}s off-CPU; untagged on-CPU share "
+            f"{prof['untagged_oncpu_share']:.1%}"
+        )
+        sink = prof.get("dominant_cpu_sink")
+        if sink:
+            lines.append(
+                f"  dominant CPU sink: {sink['phase']} / {sink['frame']} "
+                f"({sink['cpu_s']:.2f}s)"
+            )
+        over = prof.get("overhead") or {}
+        if over.get("estimated_s") is not None:
+            lines.append(
+                f"  sampler overhead: {over['estimated_s']:.4f}s estimated "
+                f"({(over.get('per_tick_s') or 0) * 1e6:.0f}us/tick)"
+            )
+        lines.append(
+            f"  {'phase':<16} {'cpu':>8} {'off-cpu':>8}  "
+            f"{'group':<13} hottest frames"
+        )
+        ranked = sorted(
+            prof["phases"].items(), key=lambda kv: -kv[1]["cpu_s"]
+        )
+        for name, info in ranked:
+            hot = ", ".join(
+                f"{h['frame']} {h['cpu_s']:.2f}s"
+                for h in info["hottest"][:3]
+            )
+            lines.append(
+                f"  {name:<16} {info['cpu_s']:>7.2f}s "
+                f"{info['offcpu_s']:>7.2f}s  {info['group']:<13} {hot}"
+            )
+        lines.append("")
+    return "\n".join(lines).rstrip()
 
 
 # ------------------------------------------------------------ barrier blame

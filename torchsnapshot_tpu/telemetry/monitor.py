@@ -43,7 +43,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import knobs, phase_stats, rss_profiler
-from . import blackbox, fleet
+from . import blackbox, fleet, profiler
 from ..event import Event
 from ..event_handlers import log_event
 
@@ -57,6 +57,10 @@ _ACTIVE: List["OpMonitor"] = []
 _MIN_TICK_S = 0.02
 _MAX_TICK_S = 60.0
 STALL_BUNDLE_PREFIX = "stall-"
+# Sampled-profile burst length inside a stall bundle.  Clamped to the
+# stall timeout so short-timeout test configs don't hang the watchdog
+# thread for 5 s per stall.
+_STALL_PROFILE_S = 5.0
 
 # phase_stats phases that accumulate occurrences while the pipeline is
 # going NOWHERE (the scheduler records one budget_wait interval per
@@ -106,6 +110,20 @@ class OpMonitor:
         # spills a periodic progress record — the "how far did it get"
         # signal a postmortem reads after a kill -9.
         self._blackbox = blackbox.enabled()
+        # Driver-tag fallback for phase attribution: the thread that
+        # registered this op is *driving* it — any sample the profiler
+        # takes of it outside an explicit timed()/tagged() scope (plan
+        # building, asyncio loop turns between phases) is still this
+        # op's work, not <untagged>.  Keyed by the registering thread's
+        # ident because finish() may run on a different thread (the
+        # async_take commit thread).
+        self._driver_ident = threading.get_ident()
+        self._driver_tag = f"{kind}_drive"
+        phase_stats.register_driver(self._driver_ident, self._driver_tag)
+        # Continuous profiling (telemetry/profiler.py): one sampler slice
+        # per monitored op, written next to traces when TPUSNAP_PROFILE
+        # is set.  None when profiling is off.
+        self._profile_op = profiler.begin_op(kind, op_id, rank)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         if (
@@ -372,12 +390,40 @@ class OpMonitor:
                 f.write("\n--- thread stacks (faulthandler) ---\n")
                 f.flush()
                 faulthandler.dump_traceback(file=f)
+                f.write(self._sampled_profile_section())
             return path
         except OSError:
             logger.warning(
                 "failed to write stall bundle %s", path, exc_info=True
             )
             return None
+
+    def _sampled_profile_section(self) -> str:
+        """A short phase-tagged sampled profile — unlike faulthandler's
+        one-shot stacks this shows what the stuck process is *doing over
+        time* (spinning on-CPU in a frame vs parked off-CPU in a wait),
+        per phase.  Best-effort: a sampling failure costs this section,
+        never the bundle."""
+        burst_s = min(_STALL_PROFILE_S, self._stall_timeout_s or _STALL_PROFILE_S)
+        try:
+            meta = profiler.sample_burst(burst_s)
+            lines = profiler.collapsed_lines(meta)
+        except Exception:
+            logger.warning("stall profile burst failed", exc_info=True)
+            return "\n--- sampled profile ---\n(sampling failed)\n"
+        shown = lines[:60]
+        out = [
+            "",
+            "--- sampled profile "
+            f"({meta['duration_s']:.1f}s @ {meta['hz']:g} Hz, "
+            f"{meta['samples_total']} samples, "
+            f"{meta['oncpu_samples']} on-CPU; "
+            "phase;state;stack count) ---",
+        ]
+        out.extend(shown)
+        if len(lines) > len(shown):
+            out.append(f"(+{len(lines) - len(shown)} more stacks)")
+        return "\n".join(out) + "\n"
 
     def _pipeline_state_lines(self) -> List[str]:
         lines: List[str] = []
@@ -506,6 +552,9 @@ class OpMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
+        phase_stats.unregister_driver(self._driver_ident, self._driver_tag)
+        profiler.end_op(self._profile_op, success)
+        self._profile_op = None
         # Terminal fleet publish: the entry flips to done/success and the
         # op's final byte counts fold into the process totals (exactly
         # once).  Runs for every monitored op — short read ops that never
